@@ -1,0 +1,99 @@
+"""Headline benchmark: k-hop neighbor sampling throughput (SEPS).
+
+Mirrors the reference's benchmarks/sample/bench_sampler.py (SEPS = sampled
+edges per second, bench_sampler.py:14-16) on an ogbn-products-scale synthetic
+graph, fanout [15, 10, 5], batch 1024 — the config behind the reference's
+headline 34.29M SEPS UVA number (docs/Introduction_en.md:41, BASELINE.md).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_SEPS = 34.29e6  # reference: 1 GPU, UVA, ogbn-products [15,10,5]
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_graph(n_nodes=2_449_029, n_edges=61_859_140, seed=0):
+    """products-scale random graph (node/edge counts = ogbn-products)."""
+    rng = np.random.default_rng(seed)
+    log(f"generating graph: {n_nodes} nodes, {n_edges} edges")
+    src = rng.integers(0, n_nodes, n_edges, dtype=np.int64)
+    dst = rng.integers(0, n_nodes, n_edges, dtype=np.int64)
+    order = np.argsort(src, kind="stable")
+    src = src[order]
+    dst = dst[order]
+    indptr = np.zeros(n_nodes + 1, dtype=np.int64)
+    np.cumsum(np.bincount(src, minlength=n_nodes), out=indptr[1:])
+    return indptr, dst
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu.pyg.sage_sampler import sample_dense_pure
+
+    batch = 1024
+    sizes = (15, 10, 5)
+    n_nodes = 2_449_029
+
+    indptr_np, indices_np = build_graph(n_nodes=n_nodes)
+    indptr = jnp.asarray(indptr_np.astype(np.int32))
+    indices = jnp.asarray(indices_np.astype(np.int32))
+    log(f"devices: {jax.devices()}")
+
+    def run(key, seeds):
+        ds = sample_dense_pure(indptr, indices, key, seeds, sizes)
+        edges = sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
+        return edges
+
+    run_jit = jax.jit(run)
+
+    rng = np.random.default_rng(1)
+    seed_batches = [
+        jnp.asarray(rng.integers(0, n_nodes, batch, dtype=np.int64).astype(np.int32))
+        for _ in range(24)
+    ]
+    log("compiling...")
+    t0 = time.time()
+    e = run_jit(jax.random.key(0), seed_batches[0])
+    jax.block_until_ready(e)
+    log(f"compile+first run: {time.time()-t0:.1f}s, edges/iter={int(e)}")
+
+    # warmup
+    for i in range(1, 4):
+        jax.block_until_ready(run_jit(jax.random.key(i), seed_batches[i]))
+
+    iters = 20
+    t0 = time.time()
+    edge_counts = []
+    for i in range(iters):
+        edge_counts.append(run_jit(jax.random.key(100 + i), seed_batches[i % len(seed_batches)]))
+    jax.block_until_ready(edge_counts)
+    dt = time.time() - t0
+    total_edges = int(np.sum([int(x) for x in edge_counts]))
+    seps = total_edges / dt
+    log(f"{iters} iters in {dt:.3f}s -> {seps/1e6:.2f}M SEPS")
+
+    print(
+        json.dumps(
+            {
+                "metric": "neighbor_sampling_throughput",
+                "value": round(seps, 1),
+                "unit": "sampled_edges_per_sec",
+                "vs_baseline": round(seps / BASELINE_SEPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

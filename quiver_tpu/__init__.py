@@ -1,0 +1,52 @@
+"""quiver_tpu — TPU-native graph-learning data engine.
+
+Ground-up JAX/XLA/Pallas re-design of torch-quiver (reference public API:
+srcs/python/quiver/__init__.py:2-17): GPU-class k-hop neighbor sampling over
+CSR topology, a tiered feature cache (chip HBM -> ICI peers -> host DRAM ->
+mmap disk), and multi-chip/multi-host scaling over ICI/DCN meshes.
+"""
+
+from .feature import DeviceConfig, DistFeature, Feature, PartitionInfo
+from .shard_tensor import Offset, ShardTensor, ShardTensorConfig
+from .utils import (
+    CSRTopo,
+    IciTopo,
+    Topo,
+    can_device_access_peer,
+    init_p2p,
+    p2pCliqueTopo,
+    parse_size,
+    reindex_by_config,
+    reindex_feature,
+)
+from .partition import (
+    load_quiver_feature_partition,
+    partition_feature_without_replication,
+    quiver_partition_feature,
+)
+from . import pyg
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CSRTopo",
+    "DeviceConfig",
+    "DistFeature",
+    "Feature",
+    "IciTopo",
+    "Offset",
+    "PartitionInfo",
+    "ShardTensor",
+    "ShardTensorConfig",
+    "Topo",
+    "can_device_access_peer",
+    "init_p2p",
+    "load_quiver_feature_partition",
+    "p2pCliqueTopo",
+    "parse_size",
+    "partition_feature_without_replication",
+    "pyg",
+    "quiver_partition_feature",
+    "reindex_by_config",
+    "reindex_feature",
+]

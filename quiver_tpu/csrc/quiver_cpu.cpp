@@ -1,0 +1,151 @@
+// Native host sampling / gather engine.
+//
+// TPU-native counterpart of the reference's CPU engine
+// (include/quiver/quiver.cpu.hpp: at::parallel_for degree pass + per-seed
+// std::sample, quiver.cpu.hpp:57-102) and of the host-pointer branch of the
+// feature gather kernel (include/quiver/shard_tensor.cu.hpp:44-55).
+//
+// Differences from the reference, by design:
+//  - no torch/ATen dependency: raw std::thread parallelism over seed ranges,
+//    per-thread SplitMix64-seeded mt19937 (reference uses thread_local mt19937,
+//    quiver.cpu.hpp:14-27);
+//  - fixed-k padded output (neighbors [B,k] + valid mask) instead of ragged
+//    output + prefix sums — this matches the static shapes the XLA device
+//    pipeline needs, so host batches stream straight into jit'd consumers;
+//  - k-distinct draws use Floyd's algorithm (O(k) per seed, uniform k-subset)
+//    instead of reservoir sampling; identical distribution over subsets.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97f4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// Uniform k-subset of [0, deg) via Floyd's algorithm; writes k positions.
+inline void floyd_sample(std::mt19937_64 &rng, int64_t deg, int64_t k,
+                         int64_t *out) {
+  // tiny linear-probe set sized to the next pow2 >= 2k
+  int64_t cap = 4;
+  while (cap < 2 * k) cap <<= 1;
+  std::vector<int64_t> set(cap, -1);
+  const int64_t mask = cap - 1;
+  auto insert = [&](int64_t v) -> bool {  // returns false if already present
+    int64_t h = static_cast<int64_t>(splitmix64(static_cast<uint64_t>(v))) & mask;
+    while (set[h] != -1) {
+      if (set[h] == v) return false;
+      h = (h + 1) & mask;
+    }
+    set[h] = v;
+    return true;
+  };
+  int64_t n_out = 0;
+  for (int64_t j = deg - k; j < deg; ++j) {
+    std::uniform_int_distribution<int64_t> dist(0, j);
+    int64_t t = dist(rng);
+    int64_t pick;
+    if (insert(t)) {
+      pick = t;
+    } else {
+      pick = j;
+      insert(j);
+    }
+    out[n_out++] = pick;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// One-hop sample: for each seed, min(deg, k) neighbors without replacement;
+// copy-all in CSR order when deg <= k (reference cuda_random.cu.hpp:33-38).
+void qt_sample_layer(const int64_t *indptr, const int64_t *indices,
+                     int64_t num_nodes, const int64_t *seeds, int64_t batch,
+                     int64_t k, uint64_t seed, int64_t *out_nbrs,
+                     uint8_t *out_valid) {
+  if (batch <= 0 || k <= 0) return;
+  int64_t n_threads =
+      std::max<int64_t>(1, std::min<int64_t>(
+                               std::thread::hardware_concurrency(), batch));
+  int64_t chunk = (batch + n_threads - 1) / n_threads;
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int64_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(batch, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      std::mt19937_64 rng(splitmix64(seed ^ splitmix64(0xC0FFEEULL + t)));
+      std::vector<int64_t> pos(static_cast<size_t>(k));
+      for (int64_t i = lo; i < hi; ++i) {
+        int64_t s = seeds[i];
+        int64_t *row = out_nbrs + i * k;
+        uint8_t *vrow = out_valid + i * k;
+        if (s < 0 || s >= num_nodes) {
+          std::memset(vrow, 0, static_cast<size_t>(k));
+          std::memset(row, 0, static_cast<size_t>(k) * sizeof(int64_t));
+          continue;
+        }
+        int64_t start = indptr[s];
+        int64_t deg = indptr[s + 1] - start;
+        if (deg <= k) {
+          for (int64_t j = 0; j < deg; ++j) {
+            row[j] = indices[start + j];
+            vrow[j] = 1;
+          }
+          for (int64_t j = deg; j < k; ++j) {
+            row[j] = 0;
+            vrow[j] = 0;
+          }
+        } else {
+          floyd_sample(rng, deg, k, pos.data());
+          for (int64_t j = 0; j < k; ++j) {
+            row[j] = indices[start + pos[j]];
+            vrow[j] = 1;
+          }
+        }
+      }
+    });
+  }
+  for (auto &th : threads) th.join();
+}
+
+// Parallel row gather out[i, :] = src[ids[i], :] — the host cold-tier path.
+void qt_gather_rows(const float *src, int64_t n, int64_t d, const int64_t *ids,
+                    int64_t batch, float *out) {
+  if (batch <= 0) return;
+  int64_t n_threads =
+      std::max<int64_t>(1, std::min<int64_t>(
+                               std::thread::hardware_concurrency(), batch));
+  int64_t chunk = (batch + n_threads - 1) / n_threads;
+  std::vector<std::thread> threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(batch, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i) {
+        int64_t id = ids[i];
+        if (id < 0 || id >= n) {
+          std::memset(out + i * d, 0, static_cast<size_t>(d) * sizeof(float));
+        } else {
+          std::memcpy(out + i * d, src + id * d,
+                      static_cast<size_t>(d) * sizeof(float));
+        }
+      }
+    });
+  }
+  for (auto &th : threads) th.join();
+}
+
+}  // extern "C"

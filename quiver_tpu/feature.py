@@ -1,0 +1,318 @@
+"""Feature — tiered feature cache with power-law-aware placement.
+
+TPU-native re-design of the reference's ``srcs/python/quiver/feature.py``:
+``Feature`` (feature.py:17-458), ``DeviceConfig`` (feature.py:11-14),
+``PartitionInfo`` (feature.py:461-526), ``DistFeature`` (feature.py:529-567).
+
+Cache policies (reference feature.py:43-45, docs/Introduction_en.md:104-119):
+
+- ``device_replicate``: the hot (high-degree) prefix is replicated into every
+  chip's HBM; the cold tail lives once in host DRAM.  On TPU the "every GPU"
+  replication becomes "every local chip" — one jax.Array per chip.
+- ``p2p_clique_replicate`` (alias ``ici_replicate``): the hot set is striped
+  across all chips of an ICI clique (a TPU slice is one all-to-all clique, so
+  the NVLink-clique detection degenerates — see utils.IciTopo); reads off-chip
+  rows over ICI.  The eager path ships rows with device_put; the jit path
+  uses ``quiver_tpu.parallel.collectives.sharded_gather`` inside shard_map.
+
+The degree-descending hot ordering comes from ``reindex_feature``
+(reference utils.py:230-248) when a ``csr_topo`` is attached; lookups remap
+through ``feature_order`` exactly like reference feature.py:296-333.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .shard_tensor import CPU_DEVICE, ShardTensor, ShardTensorConfig, _device_of
+from .utils import CSRTopo, IciTopo, parse_size, reindex_feature
+
+
+@dataclass
+class DeviceConfig:
+    """Reference feature.py:11-14."""
+
+    device_list: List[int]
+    device_cache_size: Union[int, str] = 0
+
+
+class Feature:
+    """Tiered [N, D] float feature store (reference feature.py:17).
+
+    Parameters mirror the reference constructor (feature.py:25-45):
+
+    rank : local chip index whose HBM serves this handle's gathers
+    device_list : chips participating in caching
+    device_cache_size : per-chip hot bytes (int or "200M"/"4G" strings)
+    cache_policy : "device_replicate" | "p2p_clique_replicate" | "ici_replicate"
+    csr_topo : optional CSRTopo — enables degree-ordered hot placement
+    """
+
+    def __init__(
+        self,
+        rank: int = 0,
+        device_list: Optional[Sequence[int]] = None,
+        device_cache_size: Union[int, str] = 0,
+        cache_policy: str = "device_replicate",
+        csr_topo: Optional[CSRTopo] = None,
+    ):
+        if cache_policy == "ici_replicate":
+            cache_policy = "p2p_clique_replicate"
+        if cache_policy not in ("device_replicate", "p2p_clique_replicate"):
+            raise ValueError(f"unknown cache_policy: {cache_policy}")
+        self.rank = rank
+        self.device_list = list(device_list) if device_list else [rank]
+        self.device_cache_size = parse_size(device_cache_size)
+        self.cache_policy = cache_policy
+        self.csr_topo = csr_topo
+        self.feature_order: Optional[np.ndarray] = None  # old id -> stored row
+        self._order_dev: Optional[jax.Array] = None
+        self.shard_tensor: Optional[ShardTensor] = None
+        self.topo = IciTopo.detect()
+        self._dim: Optional[int] = None
+        self._n: int = 0
+        self._local_order_applied = False
+
+    # ------------------------------------------------------------------ build
+    def from_cpu_tensor(self, cpu_tensor) -> None:
+        """Ingest the full feature table and tier it (reference
+        feature.py:195-281)."""
+        arr = np.asarray(cpu_tensor, dtype=np.float32)
+        if arr.ndim != 2:
+            raise ValueError("features must be [N, D]")
+        self._n, self._dim = arr.shape
+        row_bytes = self._dim * 4
+        cache_rows = min(self.device_cache_size // row_bytes, self._n)
+
+        if self.csr_topo is not None and not self._local_order_applied:
+            # degree-descending reorder so the cache prefix is hot
+            # (reference feature.py:211-215)
+            if self.cache_policy == "p2p_clique_replicate":
+                clique = self.topo.get_clique(self.rank)
+                ratio = min(cache_rows * len(clique), self._n) / max(self._n, 1)
+            else:
+                ratio = cache_rows / max(self._n, 1)
+            arr, order = reindex_feature(self.csr_topo, arr, ratio)
+            self.feature_order = order
+            self.csr_topo.feature_order = order
+
+        st = ShardTensor(self.rank, ShardTensorConfig({}))
+        if self.cache_policy == "device_replicate":
+            # hot prefix replicated per chip: each rank's Feature handle is
+            # built with its own `rank` and stores its own replica, so this
+            # handle's shard book holds one device shard + the shared host
+            # tail (reference feature.py:219-223,268-274)
+            if cache_rows > 0:
+                st.append(arr[:cache_rows], self.rank)
+            if cache_rows < self._n:
+                st.append(arr[cache_rows:], CPU_DEVICE)
+        else:
+            # hot set striped across the ICI clique (reference feature.py:225-265)
+            clique = [d for d in self.topo.get_clique(self.rank)]
+            hot_total = min(cache_rows * len(clique), self._n)
+            per = hot_total // max(len(clique), 1)
+            cursor = 0
+            for dev in clique:
+                rows = min(per, hot_total - cursor)
+                if rows <= 0:
+                    break
+                st.append(arr[cursor : cursor + rows], dev)
+                cursor += rows
+            if cursor < self._n:
+                st.append(arr[cursor:], CPU_DEVICE)
+        self.shard_tensor = st
+
+    @classmethod
+    def from_mmap(cls, mmap_array, device_config: DeviceConfig, **kwargs) -> "Feature":
+        """Build from an np.memmap without materialising it (reference
+        from_mmap feature.py:84-192 — the disk tier). The hot prefix is read
+        into HBM; the cold tail stays mmap-backed (reads hit page cache/disk)."""
+        self = cls(
+            rank=device_config.device_list[0] if device_config.device_list else 0,
+            device_list=device_config.device_list,
+            device_cache_size=device_config.device_cache_size,
+            **kwargs,
+        )
+        n, d = mmap_array.shape
+        self._n, self._dim = n, d
+        cache_rows = min(parse_size(device_config.device_cache_size) // (d * 4), n)
+        st = ShardTensor(self.rank, ShardTensorConfig({}))
+        if cache_rows > 0:
+            st.append(np.asarray(mmap_array[:cache_rows], dtype=np.float32), self.rank)
+        if cache_rows < n:
+            cold = mmap_array[cache_rows:]
+            if isinstance(cold, np.memmap) or cold.dtype != np.float32:
+                # keep the memmap as the cold tier without copying when possible
+                cold = cold if isinstance(cold, np.memmap) else np.asarray(cold, np.float32)
+            st.cpu_tensor = cold
+            from .shard_tensor import Offset
+
+            st.cpu_offset = Offset(cache_rows, n)
+            st._n_rows = n
+            st._dim = d
+        self.shard_tensor = st
+        return self
+
+    # ----------------------------------------------------------------- lookup
+    def __getitem__(self, node_idx) -> jax.Array:
+        """Gather features for (original) node ids; remaps through
+        feature_order then hits the tiered ShardTensor (reference
+        feature.py:296-333)."""
+        ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
+        if self.feature_order is not None:
+            ids = self.feature_order[ids]
+        return self.shard_tensor[ids]
+
+    def lookup_padded(self, node_idx: jax.Array, valid: Optional[jax.Array] = None) -> jax.Array:
+        """Jit-friendly gather for padded id arrays.
+
+        Requires the feature to be fully device-resident (single hot shard on
+        this chip covering all rows); multi-tier padded lookup goes through
+        `quiver_tpu.parallel.collectives.sharded_gather` on a mesh.
+        """
+        st = self.shard_tensor
+        if st is None or st.cpu_tensor is not None or len(st.device_shards) != 1:
+            raise ValueError(
+                "lookup_padded needs a fully HBM-resident feature; "
+                "use __getitem__ (tiered) or the mesh-sharded gather"
+            )
+        table = st.device_shards[0][1]
+        ids = node_idx
+        if self.feature_order is not None:
+            if self._order_dev is None:
+                self._order_dev = jnp.asarray(self.feature_order)
+            ids = jnp.take(self._order_dev, jnp.clip(ids, 0, self._n - 1))
+        ids = jnp.clip(ids, 0, table.shape[0] - 1)
+        rows = jnp.take(table, ids, axis=0)
+        if valid is not None:
+            rows = rows * valid[:, None].astype(rows.dtype)
+        return rows
+
+    # ------------------------------------------------------------------ misc
+    @property
+    def shape(self):
+        return (self._n, self._dim)
+
+    @property
+    def dim(self) -> int:
+        return self._dim or 0
+
+    def size(self, axis: int) -> int:
+        return self.shape[axis]
+
+    def dtype(self):
+        return jnp.float32
+
+    def set_local_order(self, local_order) -> None:
+        """Distributed local remap (reference feature.py:283-294): after
+        cross-host partitioning, this host stores only its rows; map
+        global id -> local row."""
+        local_order = np.asarray(local_order, dtype=np.int64)
+        order = np.full(int(local_order.max()) + 1 if local_order.size else 0, -1, np.int64)
+        order[local_order] = np.arange(local_order.shape[0], dtype=np.int64)
+        self.feature_order = order
+        self._order_dev = None
+        self._local_order_applied = True
+
+    # ------------------------------------------------------- ipc-compat shims
+    def share_ipc(self):
+        """Reference feature.py:383-445; a pickleable handle."""
+        return dict(
+            rank=self.rank,
+            device_list=self.device_list,
+            device_cache_size=self.device_cache_size,
+            cache_policy=self.cache_policy,
+            shard_ipc=None if self.shard_tensor is None else self.shard_tensor.share_ipc(),
+            feature_order=self.feature_order,
+            shape=(self._n, self._dim),
+        )
+
+    @classmethod
+    def new_from_ipc_handle(cls, rank: int, ipc_handle) -> "Feature":
+        self = cls(
+            rank=rank,
+            device_list=ipc_handle["device_list"],
+            device_cache_size=ipc_handle["device_cache_size"],
+            cache_policy=ipc_handle["cache_policy"],
+        )
+        self._n, self._dim = ipc_handle["shape"]
+        self.feature_order = ipc_handle["feature_order"]
+        if ipc_handle["shard_ipc"] is not None:
+            self.shard_tensor = ShardTensor.new_from_share_ipc(ipc_handle["shard_ipc"], rank)
+        return self
+
+    lazy_from_ipc_handle = new_from_ipc_handle
+
+
+class PartitionInfo:
+    """Cross-host partition metadata (reference feature.py:461-526).
+
+    global2host maps node id -> owning host; an optional replicate set marks
+    ids this host also holds locally.
+    """
+
+    def __init__(self, device, host: int, hosts: int, global2host, replicate=None):
+        self.device = device
+        self.host = host
+        self.hosts = hosts
+        self.global2host = np.asarray(global2host, dtype=np.int32)
+        self.replicate = None if replicate is None else np.asarray(replicate, dtype=np.int64)
+        self._build_global2local()
+
+    def _build_global2local(self):
+        n = self.global2host.shape[0]
+        self.global2local = np.zeros(n, dtype=np.int64)
+        local_mask = self.global2host == self.host
+        if self.replicate is not None:
+            local_mask = local_mask.copy()
+            local_mask[self.replicate] = True
+        local_ids = np.nonzero(local_mask)[0]
+        self.global2local[local_ids] = np.arange(local_ids.shape[0])
+        self.local_ids = local_ids
+        # remote ids keep their global id as the "local" key on the owner side
+        self.local_mask = local_mask
+
+    def dispatch(self, ids: np.ndarray):
+        """Split a request batch by owning host (reference feature.py:510-526).
+        Returns (per_host_ids list, local_ids, orig_pos_per_host, local_pos)."""
+        ids = np.asarray(ids).astype(np.int64)
+        local = self.local_mask[ids]
+        local_pos = np.nonzero(local)[0]
+        remote_pos = np.nonzero(~local)[0]
+        owner = self.global2host[ids[remote_pos]]
+        per_host, per_pos = [], []
+        for h in range(self.hosts):
+            sel = remote_pos[owner == h]
+            per_host.append(ids[sel])
+            per_pos.append(sel)
+        return per_host, ids[local_pos], per_pos, local_pos
+
+
+class DistFeature:
+    """Multi-host feature collection (reference feature.py:529-567): dispatch
+    ids by owner, exchange over the communication backend, merge with the
+    local gather. Synchronous/collective across hosts — every host must call
+    ``__getitem__`` together (reference docstring feature.py:530-535)."""
+
+    def __init__(self, feature: Feature, info: PartitionInfo, comm):
+        self.feature = feature
+        self.info = info
+        self.comm = comm
+
+    def __getitem__(self, ids) -> jax.Array:
+        ids = np.asarray(ids).astype(np.int64)
+        per_host, local_ids, per_pos, local_pos = self.info.dispatch(ids)
+        remote_feats = self.comm.exchange(per_host, self.feature)
+        out = np.zeros((ids.shape[0], self.feature.dim), np.float32)
+        if local_ids.size:
+            out[local_pos] = np.asarray(self.feature[local_ids])
+        for h, feats in enumerate(remote_feats):
+            if feats is not None and per_pos[h].size:
+                out[per_pos[h]] = np.asarray(feats)
+        return jnp.asarray(out)

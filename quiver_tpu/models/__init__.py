@@ -1,0 +1,5 @@
+"""Reference-parity model zoo (GraphSAGE, GAT) in flax."""
+
+from .sage import SAGEConv, GraphSAGE, masked_mean_aggregate
+
+__all__ = ["SAGEConv", "GraphSAGE", "masked_mean_aggregate"]

@@ -1,0 +1,80 @@
+"""GraphSAGE for TPU — dense padded aggregation.
+
+The reference trains plain PyG ``SAGEConv`` stacks
+(examples/pyg/reddit_quiver.py, examples/multi_gpu/pyg/ogb-products/
+dist_sampling_ogb_products_quiver.py: 2-3 layer SAGEConv, hidden 256,
+accuracy anchor ~0.787 on ogbn-products). On TPU the sampler emits padded
+``[S, k]`` neighbor matrices (see ``quiver_tpu.pyg.sage_sampler.DenseAdj``),
+which turns the sparse segment-mean aggregation into a dense gather +
+masked mean — a reshape away from MXU-friendly matmuls (SURVEY.md 7.1).
+
+Semantics match PyG SAGEConv(mean): ``out = lin_l(mean_j x_j) + lin_r(x_i)``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..pyg.sage_sampler import DenseAdj
+
+
+def masked_mean_aggregate(x_src: jax.Array, adj: DenseAdj) -> jax.Array:
+    """Mean of valid sampled neighbors per target node.
+
+    x_src: [W_src, D] embeddings of this hop's source n_id.
+    Returns [W_dst, D] where W_dst = adj.cols.shape[0].
+    """
+    cols = jnp.clip(adj.cols, 0, x_src.shape[0] - 1)
+    gathered = jnp.take(x_src, cols, axis=0)          # [W_dst, k, D]
+    m = adj.mask[..., None].astype(x_src.dtype)
+    s = (gathered * m).sum(axis=1)
+    cnt = jnp.maximum(adj.mask.sum(axis=1, keepdims=True), 1).astype(x_src.dtype)
+    return s / cnt
+
+
+class SAGEConv(nn.Module):
+    """One GraphSAGE layer (PyG SAGEConv, mean aggregator)."""
+
+    out_dim: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x_src: jax.Array, adj: DenseAdj) -> jax.Array:
+        w_dst = adj.cols.shape[0]
+        x_dst = x_src[:w_dst]  # targets are the prefix of the source n_id
+        agg = masked_mean_aggregate(x_src, adj)
+        h = nn.Dense(self.out_dim, use_bias=self.use_bias, name="lin_l")(agg)
+        h = h + nn.Dense(self.out_dim, use_bias=False, name="lin_r")(x_dst)
+        return h
+
+
+class GraphSAGE(nn.Module):
+    """Multi-layer GraphSAGE matching the reference example models
+    (examples/pyg/reddit_quiver.py SAGE class: relu + dropout between
+    layers, log_softmax head is left to the loss)."""
+
+    hidden_dim: int
+    out_dim: int
+    num_layers: int = 2
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        adjs: Tuple[DenseAdj, ...],
+        *,
+        train: bool = False,
+    ) -> jax.Array:
+        assert len(adjs) == self.num_layers, (len(adjs), self.num_layers)
+        for i, adj in enumerate(adjs):
+            dim = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
+            x = SAGEConv(dim, name=f"conv{i}")(x, adj)
+            if i != self.num_layers - 1:
+                x = jax.nn.relu(x)
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return x

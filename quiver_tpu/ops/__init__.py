@@ -1,0 +1,5 @@
+"""Device + host compute kernels (sampling, reindex, gather)."""
+
+from . import cpu_kernels, reindex, sample
+
+__all__ = ["cpu_kernels", "reindex", "sample"]

@@ -1,0 +1,266 @@
+"""Host-side (CPU) sampling engine.
+
+TPU-native replacement for the reference's two host/graph-too-big paths:
+
+- ``quiver<T, CPU>`` OpenMP-style sampler (include/quiver/quiver.cpu.hpp:57-102:
+  parallel degree pass + per-seed ``std::sample``) -> the native C++ engine in
+  ``quiver_tpu/csrc/quiver_cpu.cpp`` (std::thread parallel, per-thread
+  mt19937, partial Fisher-Yates), loaded via ctypes;
+- the UVA mode (GPU kernels reading pinned host memory,
+  quiver.cu.hpp:16-26) -> "HOST" mode: the graph stays in host DRAM, this
+  engine samples it, and padded batches stream to the TPU. TPUs cannot map
+  host memory into kernels, so host-side sampling + async H2D is the
+  replacement (SURVEY.md section 7.3 item 2).
+
+A pure-numpy fallback keeps everything working when the native lib is not
+built; outputs are bit-identical in shape/masking to the TPU path so models
+consume either interchangeably.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+SENTINEL = np.iinfo(np.int64).max
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _load_native():
+    """Load libquiver_cpu.so, building it on first use if a toolchain is
+    around (see csrc/Makefile); else None and numpy fallbacks apply."""
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    csrc = os.path.join(here, "csrc")
+    if not os.path.exists(os.path.join(csrc, "libquiver_cpu.so")) and os.path.exists(
+        os.path.join(csrc, "Makefile")
+    ):
+        import subprocess
+
+        try:
+            subprocess.run(
+                ["make", "-C", csrc],
+                check=False,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                timeout=120,
+            )
+        except Exception:
+            pass
+    for cand in (
+        os.path.join(csrc, "libquiver_cpu.so"),
+        os.path.join(here, "libquiver_cpu.so"),
+    ):
+        if os.path.exists(cand):
+            try:
+                lib = ctypes.CDLL(cand)
+                lib.qt_sample_layer.argtypes = [
+                    ctypes.c_void_p,  # indptr int64*
+                    ctypes.c_void_p,  # indices int64*
+                    ctypes.c_int64,   # num_nodes
+                    ctypes.c_void_p,  # seeds int64*
+                    ctypes.c_int64,   # batch
+                    ctypes.c_int64,   # k
+                    ctypes.c_uint64,  # rng seed
+                    ctypes.c_void_p,  # out neighbors int64* [B*k]
+                    ctypes.c_void_p,  # out valid uint8* [B*k]
+                ]
+                lib.qt_sample_layer.restype = None
+                lib.qt_gather_rows.argtypes = [
+                    ctypes.c_void_p,  # src float32* [N, D]
+                    ctypes.c_int64,   # N
+                    ctypes.c_int64,   # D
+                    ctypes.c_void_p,  # ids int64* [B]
+                    ctypes.c_int64,   # B
+                    ctypes.c_void_p,  # out float32* [B, D]
+                ]
+                lib.qt_gather_rows.restype = None
+                _LIB = lib
+            except OSError:
+                _LIB = None
+            break
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def _np_sample_layer(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    seeds: np.ndarray,
+    k: int,
+    seed: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Numpy fallback for one-hop sampling; exact k-subset w/o replacement,
+    copy-all when deg <= k (reference cuda_random.cu.hpp:33-38 semantics)."""
+    rng = np.random.default_rng(seed)
+    B = seeds.shape[0]
+    nbrs = np.zeros((B, k), np.int64)
+    valid = np.zeros((B, k), bool)
+    starts = indptr[seeds]
+    degs = indptr[seeds + 1] - starts
+    for i in range(B):
+        deg = int(degs[i])
+        if deg <= 0:
+            continue
+        start = int(starts[i])
+        if deg <= k:
+            nbrs[i, :deg] = indices[start : start + deg]
+            valid[i, :deg] = True
+        else:
+            pos = rng.choice(deg, size=k, replace=False)
+            nbrs[i] = indices[start + pos]
+            valid[i] = True
+    return nbrs, valid
+
+
+def host_reindex(
+    seeds: np.ndarray,
+    seed_count: int,
+    nbrs: np.ndarray,
+    mask: np.ndarray,
+) -> Tuple[np.ndarray, int, np.ndarray, np.ndarray]:
+    """Host mirror of :func:`quiver_tpu.ops.reindex.local_reindex`: returns
+    (n_id_unpadded, count, local_nbrs [S,k], nbr_valid) with seeds-first,
+    first-occurrence order (reference reindex.cu.hpp min-index contract)."""
+    S, k = nbrs.shape
+    seed_valid = np.arange(S) < seed_count
+    all_nodes = np.concatenate([
+        np.where(seed_valid, seeds, SENTINEL),
+        np.where(mask, nbrs, SENTINEL).reshape(-1),
+    ])
+    all_valid = np.concatenate([seed_valid, mask.reshape(-1)])
+    total = all_nodes.shape[0]
+    uniq, inv = np.unique(all_nodes, return_inverse=True)
+    first = np.full(uniq.shape[0], total, np.int64)
+    np.minimum.at(first, inv, np.where(all_valid, np.arange(total), total))
+    order = np.argsort(first, kind="stable")
+    rank = np.empty_like(order)
+    rank[order] = np.arange(order.shape[0])
+    local_all = rank[inv]
+    n_id = uniq[order]
+    count = int((first < total).sum())
+    local_nbrs = local_all[S:].reshape(S, k).astype(np.int32)
+    return n_id[:count], count, local_nbrs, mask
+
+
+class HostSampler:
+    """Stateful host engine bound to one CSR graph (reference
+    ``CPUQuiver``, srcs/cpp/src/quiver/quiver.cpp:11-38)."""
+
+    def __init__(self, indptr: np.ndarray, indices: np.ndarray):
+        self.indptr = np.ascontiguousarray(indptr, np.int64)
+        self.indices = np.ascontiguousarray(indices, np.int64)
+        self._lib = _load_native()
+
+    @property
+    def node_count(self) -> int:
+        return self.indptr.shape[0] - 1
+
+    def sample_layer(self, seeds: np.ndarray, k: int, seed: int):
+        seeds = np.ascontiguousarray(seeds, np.int64)
+        if self._lib is not None:
+            B = seeds.shape[0]
+            nbrs = np.empty((B, k), np.int64)
+            valid_u8 = np.empty((B, k), np.uint8)
+            self._lib.qt_sample_layer(
+                self.indptr.ctypes.data,
+                self.indices.ctypes.data,
+                self.node_count,
+                seeds.ctypes.data,
+                B,
+                k,
+                ctypes.c_uint64(seed),
+                nbrs.ctypes.data,
+                valid_u8.ctypes.data,
+            )
+            return nbrs, valid_u8.astype(bool)
+        return _np_sample_layer(self.indptr, self.indices, seeds, k, seed)
+
+    def sample_multilayer(
+        self,
+        seeds: np.ndarray,
+        sizes: Sequence[int],
+        seed: int,
+        caps: Optional[Sequence[Optional[int]]] = None,
+    ) -> Tuple[np.ndarray, int, List[Dict]]:
+        """Multi-hop sample with the same static padding as the device path
+        (single width source: `quiver_tpu.ops.sample.pad_widths`)."""
+        from .sample import pad_widths
+
+        B = seeds.shape[0]
+        widths = pad_widths(B, sizes, caps)
+        width = B
+        cur = np.ascontiguousarray(seeds, np.int64)
+        cur_count = B
+        adjs: List[Dict] = []
+        for l, k in enumerate(sizes):
+            # sample only the valid prefix; pad the rest
+            nbrs_v, valid_v = self.sample_layer(cur[:cur_count], k, seed + l * 1000003)
+            nbrs = np.zeros((width, k), np.int64)
+            mask = np.zeros((width, k), bool)
+            nbrs[:cur_count] = nbrs_v
+            mask[:cur_count] = valid_v
+            n_id, count, local_nbrs, mask = host_reindex(cur, cur_count, nbrs, mask)
+            new_width = widths[l + 1]
+            if count > new_width:
+                n_id = n_id[:new_width]
+                count = new_width
+                mask = mask & (local_nbrs < new_width)
+            adjs.append(
+                dict(cols=local_nbrs, mask=mask, n_src=count, n_dst=cur_count)
+            )
+            cur = np.full(new_width, SENTINEL, np.int64)
+            cur[:count] = n_id
+            cur_count = count
+            width = new_width
+        return cur, cur_count, adjs
+
+    def gather_rows(self, table: np.ndarray, ids: np.ndarray) -> np.ndarray:
+        """Parallel host feature gather (cold-tier analog of
+        quiver_tensor_gather's host-pointer branch, shard_tensor.cu.hpp:44-55)."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        if (
+            self._lib is not None
+            and table.dtype == np.float32
+            and table.flags.c_contiguous
+        ):
+            out = np.empty((ids.shape[0], table.shape[1]), np.float32)
+            self._lib.qt_gather_rows(
+                table.ctypes.data,
+                table.shape[0],
+                table.shape[1],
+                ids.ctypes.data,
+                ids.shape[0],
+                out.ctypes.data,
+            )
+            return out
+        return table[ids]
+
+
+def gather_rows(table: np.ndarray, ids: np.ndarray) -> np.ndarray:
+    """Module-level host gather using the native lib when possible."""
+    lib = _load_native()
+    ids = np.ascontiguousarray(ids, np.int64)
+    if lib is not None and table.dtype == np.float32 and table.flags.c_contiguous:
+        out = np.empty((ids.shape[0], table.shape[1]), np.float32)
+        lib.qt_gather_rows(
+            table.ctypes.data,
+            table.shape[0],
+            table.shape[1],
+            ids.ctypes.data,
+            ids.shape[0],
+            out.ctypes.data,
+        )
+        return out
+    return table[ids]

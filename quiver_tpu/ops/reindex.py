@@ -1,0 +1,98 @@
+"""Dedup + local-id rewrite ("reindex") with static shapes.
+
+Re-design of the reference's GPU ordered hash table
+(``include/quiver/reindex.cu.hpp``: DeviceOrderedHashTable atomicCAS insert
+keeping the *minimum input index* per key, reindex.cu.hpp:120-139) and the
+``reindex_kernel``/``FillWithDuplicates`` pipeline (quiver_sample.cu:202-255,
+18-63).
+
+The contract the reference establishes (and PyG relies on):
+
+- ``n_id[:num_seeds] == seeds`` — seeds keep their slots, in order;
+- the remaining unique nodes follow in first-occurrence order;
+- every input element is rewritten to its local id in ``n_id``.
+
+On TPU, open-addressing hash tables are a poor fit (scatter-heavy, atomics);
+the XLA-native formulation is sort-based: ``jnp.unique`` with a static
+``size=`` cap, then a segment-min of input positions to recover
+first-occurrence order. Invalid (padding) slots carry a ``sentinel`` value and
+are pushed to the tail. Everything is jittable with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ReindexResult(NamedTuple):
+    n_id: jax.Array        # [cap] unique node ids, seeds first, sentinel-padded
+    count: jax.Array       # scalar int32: number of valid entries in n_id
+    local_seeds: jax.Array  # [S] local id of each seed (== arange(S) for valid, unique seeds)
+    local_nbrs: jax.Array  # [S, k] local id of each sampled neighbor
+    nbr_valid: jax.Array   # [S, k] validity mask (propagated from sampling)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def local_reindex(
+    seeds: jax.Array,
+    seed_valid: jax.Array,
+    nbrs: jax.Array,
+    nbr_valid: jax.Array,
+) -> ReindexResult:
+    """Build ``n_id`` (seeds first, then first-occurrence-ordered unique
+    neighbors) and rewrite seeds/neighbors to local ids.
+
+    Matches ``TorchQuiver::reindex_single`` semantics
+    (quiver_sample.cu:305-357) for valid, duplicate-free seeds.
+
+    ``seeds`` is [S]; ``nbrs`` is [S, k]. cap = S + S*k.
+    """
+    S = seeds.shape[0]
+    k = nbrs.shape[1]
+    cap = S + S * k
+    idt = jnp.promote_types(seeds.dtype, nbrs.dtype)
+    sentinel = jnp.asarray(jnp.iinfo(idt).max, idt)
+
+    all_nodes = jnp.concatenate([
+        jnp.where(seed_valid, seeds.astype(idt), sentinel),
+        jnp.where(nbr_valid, nbrs.astype(idt), sentinel).reshape(-1),
+    ])
+    all_valid = jnp.concatenate([seed_valid, nbr_valid.reshape(-1)])
+
+    uniq, inv = jnp.unique(all_nodes, return_inverse=True, size=cap, fill_value=sentinel)
+    # first-occurrence position per unique value; invalid inputs pushed past cap
+    pos = jnp.where(all_valid, jnp.arange(cap, dtype=jnp.int32), cap)
+    first = jnp.full((cap,), cap, jnp.int32).at[inv].min(pos)
+    order = jnp.argsort(first)            # stable; valid uniques in input order
+    rank = jnp.zeros((cap,), jnp.int32).at[order].set(jnp.arange(cap, dtype=jnp.int32))
+    local_all = jnp.take(rank, inv)
+    n_id = jnp.take(uniq, order)
+    count = (first < cap).sum().astype(jnp.int32)
+    return ReindexResult(
+        n_id=n_id,
+        count=count,
+        local_seeds=local_all[:S],
+        local_nbrs=local_all[S:].reshape(S, k),
+        nbr_valid=nbr_valid,
+    )
+
+
+def reindex_single(seeds: jax.Array, inputs: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Free-function analog of the reference's standalone ``reindex_single``
+    (quiver_sample.cu:305-357): given seeds and a flat neighbor array (one
+    row already implied), return (n_id, count, local_ids_of_inputs)."""
+    S = seeds.shape[0]
+    flat = inputs.reshape(S, -1) if inputs.ndim == 1 and inputs.shape[0] % S == 0 else inputs
+    if flat.ndim == 1:
+        flat = flat[None, :]
+    res = local_reindex(
+        seeds,
+        jnp.ones((S,), bool),
+        flat,
+        jnp.ones(flat.shape, bool),
+    )
+    return res.n_id, res.count, res.local_nbrs.reshape(-1)

@@ -1,0 +1,206 @@
+"""Device-side k-hop neighbor sampling, XLA/TPU-native.
+
+Re-design of the reference's CUDA sampling pipeline
+(``srcs/cpp/src/quiver/cuda/quiver_sample.cu:134-200`` sample_kernel and the
+warp-per-row reservoir kernel ``include/quiver/cuda_random.cu.hpp:7-69``).
+
+The reference pipeline is ragged: per-seed degree pass -> cap -> exclusive scan
+-> ragged output buffer. XLA demands static shapes, so the TPU design returns a
+dense padded ``[B, k]`` neighbor matrix plus a validity mask:
+
+- ``deg <= k``  -> copy-all (positions ``0..deg-1`` valid), matching the
+  copy-all branch of the reference kernel (cuda_random.cu.hpp:33-38);
+- ``deg > k``   -> an exact uniform k-subset without replacement, matching the
+  reservoir-sampling branch (cuda_random.cu.hpp:40-60) in distribution.
+
+The without-replacement draw uses a vectorised *partial Fisher-Yates* over a
+virtual ``arange(deg)`` permutation: slot values below ``k`` live in a dense
+``head`` array, swaps landing at ``j >= k`` are recorded in a k-entry override
+table (at most one new override per step). This is O(k^2) vector work per row
+(k <= 32 in practice) with fully static shapes — no per-row data-dependent
+control flow, so the whole thing fuses into a handful of XLA ops.
+
+All functions are jittable; the padded output feeds the dense reindex pass
+(`quiver_tpu.ops.reindex`) and the padded-[B,k] GraphSAGE aggregation
+(`quiver_tpu.models.sage`), which turns sparse segment ops into dense
+reshape+mean — the TPU-friendly formulation (SURVEY.md section 7.1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pad_widths(batch: int, sizes, caps=None):
+    """Static padded n_id widths per hop: ``W_{l+1} = min(cap_l, W_l*(1+k_l))``.
+
+    Single source of truth for the shape contract shared by the device
+    pipeline (`quiver_tpu.pyg.sage_sampler.sample_dense_pure`) and the host
+    engine (`quiver_tpu.ops.cpu_kernels.HostSampler.sample_multilayer`) —
+    their outputs must be bit-identical in shape/masking.
+    """
+    widths = [int(batch)]
+    for l, k in enumerate(sizes):
+        w = widths[-1] * (1 + int(k))
+        if caps is not None and caps[l] is not None:
+            w = min(w, int(caps[l]))
+        widths.append(w)
+    return widths
+
+
+def fisher_yates_positions(key: jax.Array, deg: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Draw, for each row ``b``, ``min(deg[b], k)`` distinct positions in
+    ``[0, deg[b])``.
+
+    Returns ``(pos, valid)`` with ``pos`` int32 ``[B, k]`` and ``valid`` bool
+    ``[B, k]``. For rows with ``deg <= k`` positions are ``0..deg-1`` in order
+    (copy-all semantics). For ``deg > k`` positions are an exact uniform
+    k-subset, in random order.
+    """
+    deg = deg.astype(jnp.int32)
+    B = deg.shape[0]
+    ar_k = jnp.arange(k, dtype=jnp.int32)
+
+    if k == 0:
+        return (jnp.zeros((B, 0), jnp.int32), jnp.zeros((B, 0), bool))
+
+    us = jax.random.uniform(key, (k, B))
+
+    def step(state, inp):
+        head, tail_j, tail_v, cnt = state
+        i, u = inp
+        span = jnp.maximum(deg - i, 1)
+        j = i + (u * span.astype(u.dtype)).astype(jnp.int32)
+        j = jnp.minimum(j, jnp.maximum(deg - 1, 0))
+        in_head = j < k
+        head_val = jnp.take_along_axis(head, jnp.clip(j, 0, k - 1)[:, None], axis=1)[:, 0]
+        match = tail_j == j[:, None]  # [B, k]
+        has_match = match.any(axis=1)
+        tail_val = jnp.where(has_match, jnp.where(match, tail_v, 0).sum(axis=1), j)
+        val_j = jnp.where(in_head, head_val, tail_val)
+        val_i = head[:, i]
+        # a[j] = a[i]
+        onehot_j = (ar_k[None, :] == j[:, None]) & in_head[:, None]
+        head = jnp.where(onehot_j, val_i[:, None], head)
+        # a[i] = a[j] (slot i is never drawn again but keep the permutation honest)
+        head = head.at[:, i].set(val_j)
+        slot = jnp.where(has_match, jnp.argmax(match, axis=1).astype(jnp.int32), cnt)
+        write_tail = ~in_head
+        onehot_s = (ar_k[None, :] == slot[:, None]) & write_tail[:, None]
+        tail_j = jnp.where(onehot_s, j[:, None], tail_j)
+        tail_v = jnp.where(onehot_s, val_i[:, None], tail_v)
+        cnt = cnt + (write_tail & ~has_match).astype(jnp.int32)
+        return (head, tail_j, tail_v, cnt), val_j
+
+    init = (
+        jnp.broadcast_to(ar_k, (B, k)),
+        jnp.full((B, k), -1, jnp.int32),
+        jnp.zeros((B, k), jnp.int32),
+        jnp.zeros((B,), jnp.int32),
+    )
+    _, outs = lax.scan(step, init, (ar_k, us))
+    pos = outs.T  # [B, k]
+    # copy-all override for low-degree rows (reference cuda_random.cu.hpp:33-38)
+    pos = jnp.where(deg[:, None] <= k, ar_k[None, :], pos)
+    valid = ar_k[None, :] < jnp.minimum(deg, k)[:, None]
+    return pos, valid
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def sample_layer(
+    indptr: jax.Array,
+    indices: jax.Array,
+    seeds: jax.Array,
+    seed_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-hop sample for every valid seed.
+
+    Equivalent of ``TorchQuiver::sample_neighbor`` (quiver_sample.cu:113-132):
+    degree lookup, position draw, neighbor gather — all dense.
+
+    Parameters
+    ----------
+    indptr : [N+1] int array in HBM
+    indices : [E] int array in HBM
+    seeds : [B] int array (garbage allowed where ``~seed_valid``)
+    seed_valid : [B] bool
+    k : static fanout
+
+    Returns
+    -------
+    nbrs : [B, k] same dtype as ``indices``; garbage where invalid
+    valid : [B, k] bool
+    """
+    n = indptr.shape[0] - 1
+    s = jnp.clip(seeds, 0, n - 1).astype(indptr.dtype)
+    ptr = jnp.take(indptr, s)
+    deg = (jnp.take(indptr, s + 1) - ptr).astype(jnp.int32)
+    deg = jnp.where(seed_valid, deg, 0)
+    pos, valid = fisher_yates_positions(key, deg, k)
+    flat = ptr[:, None] + pos.astype(ptr.dtype)
+    flat = jnp.clip(flat, 0, jnp.asarray(indices.shape[0] - 1, ptr.dtype))
+    nbrs = jnp.take(indices, flat)
+    return nbrs, valid
+
+
+def neighbor_prob(
+    indptr: jax.Array,
+    indices: jax.Array,
+    prob: jax.Array,
+    k: int,
+    *,
+    edge_chunk: int = 1 << 22,
+) -> jax.Array:
+    """One step of sampling-probability propagation.
+
+    Equivalent of ``cal_neighbor_prob``/``cal_next``
+    (quiver_sample.cu:100-111, cuda_random.cu.hpp:71-104): given P(node is in
+    the sampled frontier) per node, propagate to neighbors — each sampled node
+    u touches neighbor v with probability ``min(k/deg(u), 1)``, accumulated as
+    ``next[v] += prob[u] * min(k/deg(u), 1)``.
+
+    In XLA this is a flat edge-parallel segment-sum over the CSR (the TPU-native
+    replacement for the atomicAdd kernel). Chunked over edges to bound memory.
+    """
+    n = indptr.shape[0] - 1
+    e = indices.shape[0]
+    deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
+    w = prob * jnp.minimum(k / jnp.maximum(deg, 1.0), 1.0)  # weight per src node
+    # expand per-edge src id: edge i belongs to row searchsorted(indptr, i, 'right')-1
+    out = jnp.zeros((n,), jnp.float32)
+    for start in range(0, max(e, 1), edge_chunk):
+        sl = slice(start, min(start + edge_chunk, e))
+        eidx = jnp.arange(sl.start, sl.stop, dtype=indptr.dtype)
+        src = jnp.searchsorted(indptr, eidx, side="right") - 1
+        dst = indices[sl]
+        out = out.at[dst].add(jnp.take(w, src))
+    return out
+
+
+def sample_prob(
+    indptr: jax.Array,
+    indices: jax.Array,
+    sizes,
+    train_idx: jax.Array,
+    num_nodes: Optional[int] = None,
+) -> jax.Array:
+    """Multi-layer hot-probability estimate (reference sage_sampler.py:149-157).
+
+    Seeds get probability 1; each hop propagates with `neighbor_prob`. The
+    result drives degree-free hot/cold placement and the offline partitioner.
+    """
+    n = num_nodes if num_nodes is not None else indptr.shape[0] - 1
+    prob = jnp.zeros((n,), jnp.float32).at[train_idx].set(1.0)
+    last = prob
+    for k in sizes:
+        nxt = neighbor_prob(indptr, indices, last, k)
+        prob = prob + nxt
+        last = nxt
+    return prob

@@ -1,0 +1,19 @@
+"""Multi-chip / multi-host parallelism over jax.sharding meshes."""
+
+from .collectives import pad_to_multiple, sharded_gather, sharded_gather_a2a
+from .train import (
+    make_mesh,
+    make_sharded_train_step,
+    replicate,
+    shard_feature_rows,
+)
+
+__all__ = [
+    "make_mesh",
+    "make_sharded_train_step",
+    "pad_to_multiple",
+    "replicate",
+    "shard_feature_rows",
+    "sharded_gather",
+    "sharded_gather_a2a",
+]

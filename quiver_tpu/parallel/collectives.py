@@ -1,0 +1,83 @@
+"""Mesh collectives for sharded feature access.
+
+TPU-native replacement for the reference's three transports (SURVEY.md 5):
+
+- NVLink peer-pointer reads inside one kernel (shard_tensor.cu.hpp:44-55)
+  -> `sharded_gather`: the hot feature table is row-sharded across an ICI
+  mesh axis; every chip gathers its in-range rows and a `psum` over the axis
+  assembles full rows. One collective rides ICI instead of per-row peer loads.
+- NCCL send/recv pairwise exchange (quiver_comm.cu:38-64, comm.py:42-75)
+  -> `all_to_all` based exchange in `quiver_tpu.comm` over a DCN axis.
+- CUDA IPC handles -> nothing: one process drives all local chips.
+
+Everything here runs *inside* ``shard_map`` — callers wrap with
+`jax.experimental.shard_map.shard_map` (see `quiver_tpu.parallel.train`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sharded_gather(table_block: jax.Array, ids: jax.Array, axis_name: str) -> jax.Array:
+    """Gather rows by *global* id from a row-sharded table.
+
+    table_block: this chip's ``[rows_per_shard, D]`` contiguous block (global
+    rows ``[idx*rows_per_shard, (idx+1)*rows_per_shard)``).
+    ids: global row ids, any shape; identical across the axis (replicated).
+
+    Returns full rows, replicated across the axis. Out-of-range ids (e.g.
+    padding sentinels) return zero rows.
+    """
+    rows_per_shard = table_block.shape[0]
+    idx = lax.axis_index(axis_name)
+    local = ids.astype(jnp.int32) - idx * rows_per_shard
+    in_range = (local >= 0) & (local < rows_per_shard)
+    rows = jnp.take(table_block, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
+    rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))
+    return lax.psum(rows, axis_name)
+
+
+def sharded_gather_a2a(
+    table_block: jax.Array, ids: jax.Array, axis_name: str, axis_size: int
+) -> jax.Array:
+    """All-to-all variant: each chip requests only its own ``ids`` (sharded
+    over the axis) instead of replicating requests.
+
+    ids: [B_local] this chip's request list (global ids).
+    Returns [B_local, D]: rows for this chip's ids.
+
+    Pattern = the reference's id/feature exchange (comm.py:127-182) collapsed
+    into two XLA collectives: all_gather the request lists, local gather,
+    then psum_scatter... here implemented as all_gather + masked gather +
+    all_to_all return trip for bandwidth-balanced assembly.
+    """
+    rows_per_shard = table_block.shape[0]
+    # [P, B_local] all chips' requests
+    all_ids = lax.all_gather(ids.astype(jnp.int32), axis_name)
+    idx = lax.axis_index(axis_name)
+    local = all_ids - idx * rows_per_shard
+    in_range = (local >= 0) & (local < rows_per_shard)
+    rows = jnp.take(table_block, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
+    rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))  # [P, B, D]
+    # return trip: chip p needs slice [p] summed over owners
+    return lax.psum_scatter(rows, axis_name, scatter_dimension=0, tiled=False)
+
+
+def replicated_psum(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def pad_to_multiple(arr, multiple: int, axis: int = 0):
+    """Pad rows so a table splits evenly across shards (host-side helper)."""
+    import numpy as np
+
+    n = arr.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return np.asarray(arr)
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, target - n)
+    return np.pad(np.asarray(arr), pad_width)

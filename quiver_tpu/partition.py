@@ -1,0 +1,140 @@
+"""Offline probability-driven feature partitioner.
+
+Re-design of the reference ``srcs/python/quiver/partition.py``:
+``partition_feature_without_replication`` (partition.py:14-70, chunk-greedy,
+chunk size 256 at partition.py:12), ``quiver_partition_feature``
+(partition.py:73-143) and ``load_quiver_feature_partition``
+(partition.py:146-173).
+
+The algorithm is host-side/offline, so it stays numpy (the reference runs it
+in torch on CPU/GPU): iterate id space in chunks; assign each chunk's nodes to
+the partition whose access probability gain (own probability minus the other
+partitions' average) is highest, balancing sizes.
+
+Artifacts are saved with ``np.savez`` instead of ``torch.save`` but keep the
+reference's file-role split: per-partition result + cache + a global
+partition book.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple, Union
+
+import numpy as np
+
+CHUNK_SIZE = 256  # reference partition.py:12
+
+QUIVER_PARTITION_FILE = "partition_res.npz"       # reference: partition_res.pth
+QUIVER_CACHE_FILE = "cache_res.npz"               # reference: cache_res.pth
+QUIVER_PARTITION_BOOK_FILE = "feature_partition_book.npz"
+
+
+def partition_feature_without_replication(
+    probs: Sequence[np.ndarray], chunk_size: int = CHUNK_SIZE
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Greedy chunked assignment maximizing own-probability advantage
+    (reference partition.py:14-70).
+
+    probs: one access-probability vector per partition (from
+    ``GraphSageSampler.sample_prob``), each [N].
+
+    Returns (per-partition id arrays, partition_book [N] -> partition).
+    """
+    probs = [np.asarray(p, dtype=np.float64) for p in probs]
+    n_parts = len(probs)
+    n = probs[0].shape[0]
+    for p in probs:
+        assert p.shape[0] == n
+    prob_mat = np.stack(probs)  # [P, N]
+    partition_book = np.full(n, -1, dtype=np.int32)
+    res: List[List[np.ndarray]] = [[] for _ in range(n_parts)]
+    sizes = np.zeros(n_parts, dtype=np.int64)
+
+    # nodes any partition touches, in descending total probability — the
+    # reference walks chunks of the raw id range; ordering by heat gives the
+    # same result faster convergence-wise and stays deterministic
+    total = prob_mat.sum(axis=0)
+    touched = np.argsort(-total, kind="stable")
+    touched = touched[total[touched] > 0]
+    untouched = np.nonzero(total == 0)[0]
+
+    for start in range(0, touched.shape[0], chunk_size):
+        chunk = touched[start : start + chunk_size]
+        sub = prob_mat[:, chunk]  # [P, C]
+        # score per partition: own prob minus average of others
+        # (reference partition.py:35-54)
+        others = (sub.sum(axis=0, keepdims=True) - sub) / max(n_parts - 1, 1)
+        gain = sub - others
+        # balance: penalize the currently largest partitions
+        gain = gain - (sizes[:, None] - sizes.min()) * 1e-9
+        pick = np.argmax(gain, axis=0)
+        for p in range(n_parts):
+            ids = chunk[pick == p]
+            if ids.size:
+                res[p].append(ids)
+                partition_book[ids] = p
+                sizes[p] += ids.size
+    # untouched nodes round-robin for balance (reference assigns rest evenly)
+    if untouched.size:
+        order = np.argsort(sizes, kind="stable")
+        splits = np.array_split(untouched, n_parts)
+        for p, ids in zip(order, splits):
+            if ids.size:
+                res[p].append(ids)
+                partition_book[ids] = p
+    out = [
+        np.concatenate(r) if r else np.empty(0, dtype=np.int64) for r in res
+    ]
+    return out, partition_book
+
+
+def quiver_partition_feature(
+    probs: Sequence[np.ndarray],
+    result_path: str,
+    cache_memory_budget: Union[int, str] = 0,
+    per_feature_size: int = 0,
+    chunk_size: int = CHUNK_SIZE,
+):
+    """Partition + per-partition hot-cache selection, persisted to disk
+    (reference partition.py:73-143)."""
+    from .utils import parse_size
+
+    os.makedirs(result_path, exist_ok=True)
+    partitions, book = partition_feature_without_replication(probs, chunk_size)
+    cache_budget = parse_size(cache_memory_budget)
+    cache_rows = 0
+    if cache_budget and per_feature_size:
+        cache_rows = cache_budget // int(per_feature_size)
+    caches = []
+    for p, ids in enumerate(partitions):
+        part_dir = os.path.join(result_path, f"partition_{p}")
+        os.makedirs(part_dir, exist_ok=True)
+        # hot cache for partition p: the hottest rows NOT owned by p
+        # (reference caches remote-but-hot rows, partition.py:104-126)
+        others = np.asarray(probs[p], dtype=np.float64).copy()
+        others[ids] = 0
+        cache_ids = np.argsort(-others, kind="stable")[:cache_rows]
+        cache_ids = cache_ids[others[cache_ids] > 0]
+        caches.append(cache_ids)
+        np.savez(
+            os.path.join(part_dir, QUIVER_PARTITION_FILE), partition_ids=ids
+        )
+        np.savez(os.path.join(part_dir, QUIVER_CACHE_FILE), cache_ids=cache_ids)
+    np.savez(
+        os.path.join(result_path, QUIVER_PARTITION_BOOK_FILE), partition_book=book
+    )
+    return partitions, caches, book
+
+
+def load_quiver_feature_partition(partition_idx: int, result_path: str):
+    """Load one partition's artifacts (reference partition.py:146-173)."""
+    part_dir = os.path.join(result_path, f"partition_{partition_idx}")
+    part = np.load(os.path.join(part_dir, QUIVER_PARTITION_FILE))
+    cache = np.load(os.path.join(part_dir, QUIVER_CACHE_FILE))
+    book = np.load(os.path.join(result_path, QUIVER_PARTITION_BOOK_FILE))
+    return (
+        part["partition_ids"],
+        cache["cache_ids"],
+        book["partition_book"],
+    )

@@ -1,0 +1,19 @@
+"""PyG-style sampler API (reference srcs/python/quiver/pyg/__init__.py)."""
+
+from .sage_sampler import (
+    Adj,
+    DenseAdj,
+    DenseSample,
+    GraphSageSampler,
+    dense_to_pyg,
+    sample_dense_pure,
+)
+
+__all__ = [
+    "Adj",
+    "DenseAdj",
+    "DenseSample",
+    "GraphSageSampler",
+    "dense_to_pyg",
+    "sample_dense_pure",
+]

@@ -1,0 +1,304 @@
+"""GraphSAGE k-hop sampler — TPU-native re-design of the reference
+``srcs/python/quiver/pyg/sage_sampler.py`` (GraphSageSampler at
+sage_sampler.py:36-178).
+
+Reference modes (sage_sampler.py:55-81) and their TPU mapping:
+
+- ``GPU``  (graph resident in device memory)     -> ``"TPU"``: CSR in HBM,
+  sampling + reindex run as fused XLA ops on-chip.
+- ``UVA``  (graph in pinned host mem, GPU kernels read over PCIe) -> ``"HOST"``:
+  no UVA exists on TPU; the graph stays in host DRAM and sampling runs in the
+  native host engine (C++/numpy), feeding padded batches to the device. This
+  preserves the capability (graph larger than HBM) the UVA mode existed for
+  (SURVEY.md section 7.3 item 2).
+- ``CPU``  -> ``"CPU"``: host sampling, results stay host-side.
+
+Two output surfaces:
+
+- :meth:`GraphSageSampler.sample_dense` — fully static-shape pytree
+  (padded ``[S, k]`` adjacency + masks + counts), jittable end to end; this is
+  what the TPU training loop consumes.
+- :meth:`GraphSageSampler.sample` — reference/PyG-compatible
+  ``(n_id, batch_size, [Adj])`` with ragged ``edge_index`` (host sync), so
+  reference training scripts port line for line
+  (sage_sampler.py:118-147).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import CSRTopo
+from ..ops.sample import (
+    pad_widths,
+    sample_layer as _sample_layer_op,
+    sample_prob as _sample_prob,
+)
+from ..ops.reindex import local_reindex
+
+
+class Adj(NamedTuple):
+    """PyG-compatible adjacency (reference sage_sampler.py:21-28)."""
+
+    edge_index: np.ndarray  # [2, nnz] (col=source, row=target local ids)
+    e_id: np.ndarray        # empty — reference keeps it empty too (sage_sampler.py:143)
+    size: Tuple[int, int]   # (n_src, n_dst)
+
+    def to(self, *args, **kwargs):  # torch-API compat shim
+        return self
+
+
+class DenseAdj(NamedTuple):
+    """Static-shape adjacency for one hop.
+
+    ``cols[i, j]`` is the local id (into the *source* n_id of this hop) of the
+    j-th sampled neighbor of target node i; ``mask`` marks real samples. The
+    target nodes are always the prefix ``[:cols.shape[0]]`` of the source
+    n_id, so dense GraphSAGE aggregation is a gather + masked mean.
+    """
+
+    cols: jax.Array   # [S, k] int32
+    mask: jax.Array   # [S, k] bool
+    n_src: jax.Array  # scalar int32 — valid source-node count
+    n_dst: jax.Array  # scalar int32 — valid target-node count
+
+
+class DenseSample(NamedTuple):
+    n_id: jax.Array          # [cap] padded unique node ids (global)
+    count: jax.Array         # scalar int32 valid length of n_id
+    batch_size: int
+    adjs: Tuple[DenseAdj, ...]  # outermost hop first (reference reverses too)
+
+
+def sample_dense_pure(
+    indptr: jax.Array,
+    indices: jax.Array,
+    key: jax.Array,
+    seeds: jax.Array,
+    sizes: Tuple[int, ...],
+    caps: Optional[Tuple[Optional[int], ...]] = None,
+) -> DenseSample:
+    """Pure, jittable multi-hop sample (static ``sizes``/``caps``).
+
+    The reference's per-layer loop (sage_sampler.py:133-145) with the ragged
+    hash-table reindex replaced by the static-shape sort reindex.
+    """
+    B = seeds.shape[0]
+    widths = pad_widths(B, sizes, caps)
+    cur = seeds
+    cur_valid = jnp.ones((B,), bool)
+    adjs: List[DenseAdj] = []
+    prev_count = jnp.asarray(B, jnp.int32)
+    for l, k in enumerate(sizes):
+        key, sub = jax.random.split(key)
+        nbrs, valid = _sample_layer_op(indptr, indices, cur, cur_valid, k, sub)
+        res = local_reindex(cur, cur_valid, nbrs, valid)
+        n_id, count = res.n_id, res.count
+        local_nbrs, nbr_valid = res.local_nbrs, res.nbr_valid
+        if widths[l + 1] < n_id.shape[0]:
+            cap = widths[l + 1]
+            n_id = n_id[:cap]
+            count = jnp.minimum(count, cap)
+            nbr_valid = nbr_valid & (local_nbrs < cap)
+        adjs.append(
+            DenseAdj(cols=local_nbrs, mask=nbr_valid, n_src=count, n_dst=prev_count)
+        )
+        cur = n_id
+        cur_valid = jnp.arange(n_id.shape[0], dtype=jnp.int32) < count
+        prev_count = count
+    return DenseSample(n_id=cur, count=prev_count, batch_size=B, adjs=tuple(adjs[::-1]))
+
+
+class GraphSageSampler:
+    """K-hop sampler over a :class:`CSRTopo` (reference sage_sampler.py:36).
+
+    Parameters
+    ----------
+    csr_topo : CSRTopo
+    sizes : fanouts, outermost-first like PyG (e.g. ``[15, 10, 5]``)
+    device : int, local device index for TPU mode (reference's GPU ordinal)
+    mode : "TPU" | "HOST" | "CPU" (aliases: "GPU" -> TPU, "UVA" -> HOST,
+        "ZERO_COPY"/"DMA" -> HOST/TPU)
+    caps : optional per-layer static n_id budget (TPU-only knob; bounds padded
+        growth for deep fanouts)
+    seed : RNG seed; sampling is deterministic given (seed, call index)
+    """
+
+    MODE_ALIASES = {"GPU": "TPU", "UVA": "HOST", "ZERO_COPY": "HOST", "DMA": "TPU"}
+
+    def __init__(
+        self,
+        csr_topo: CSRTopo,
+        sizes: Sequence[int],
+        device=0,
+        mode: str = "TPU",
+        caps: Optional[Sequence[Optional[int]]] = None,
+        seed: int = 0,
+    ):
+        mode = self.MODE_ALIASES.get(mode, mode)
+        if mode not in ("TPU", "HOST", "CPU"):
+            raise ValueError(f"unsupported mode: {mode}")
+        self.csr_topo = csr_topo
+        self.sizes = tuple(int(s) for s in sizes)
+        self.caps = None if caps is None else tuple(caps)
+        self.mode = mode
+        self.device = device
+        self._seed = seed
+        self._call = 0
+        self._dev_arrays = None
+        if mode == "TPU":
+            self.lazy_init_quiver()
+        self._host_engine = None
+
+    # -- device-graph binding (reference lazy_init_quiver, sage_sampler.py:98-113)
+    def lazy_init_quiver(self):
+        if self._dev_arrays is None:
+            dev = None
+            if isinstance(self.device, int):
+                local = jax.local_devices()
+                dev = local[self.device % len(local)]
+            self._dev_arrays = self.csr_topo.to_device(dev)
+        return self._dev_arrays
+
+    def _host(self):
+        if self._host_engine is None:
+            from ..ops import cpu_kernels
+
+            self._host_engine = cpu_kernels.HostSampler(
+                self.csr_topo.indptr, self.csr_topo.indices
+            )
+        return self._host_engine
+
+    def _next_key(self) -> jax.Array:
+        key = jax.random.fold_in(jax.random.key(self._seed), self._call)
+        self._call += 1
+        return key
+
+    # -- dense static-shape surface --------------------------------------
+    def sample_dense(self, seeds) -> DenseSample:
+        """Sample a padded, jittable mini-batch. TPU mode runs fully on
+        device; HOST/CPU modes run the native host engine and pad."""
+        if self.mode == "TPU":
+            indptr, indices = self.lazy_init_quiver()
+            seeds = jnp.asarray(np.asarray(seeds), indices.dtype)
+            return sample_dense_pure(
+                indptr, indices, self._next_key(), seeds, self.sizes, self.caps
+            )
+        return self._host_sample_dense(np.asarray(seeds))
+
+    def _host_sample_dense(self, seeds: np.ndarray) -> DenseSample:
+        eng = self._host()
+        rng_seed = (self._seed * 0x9E3779B1 + self._call) & 0x7FFFFFFF
+        self._call += 1
+        n_id, count, adjs = eng.sample_multilayer(
+            seeds.astype(np.int64), self.sizes, rng_seed, self.caps
+        )
+        dense_adjs = tuple(
+            DenseAdj(
+                cols=jnp.asarray(a["cols"]),
+                mask=jnp.asarray(a["mask"]),
+                n_src=jnp.asarray(a["n_src"], jnp.int32),
+                n_dst=jnp.asarray(a["n_dst"], jnp.int32),
+            )
+            for a in adjs[::-1]
+        )
+        return DenseSample(
+            n_id=jnp.asarray(n_id),
+            count=jnp.asarray(count, jnp.int32),
+            batch_size=int(seeds.shape[0]),
+            adjs=dense_adjs,
+        )
+
+    # -- reference/PyG-compatible surface ---------------------------------
+    def sample(self, input_nodes):
+        """Reference-compatible ``(n_id, batch_size, [Adj])``
+        (sage_sampler.py:118-147). Ragged — forces a host sync; prefer
+        :meth:`sample_dense` inside TPU training loops."""
+        ds = self.sample_dense(input_nodes)
+        return dense_to_pyg(ds)
+
+    def sample_layer(self, seeds, size: int):
+        """One-hop sample (reference sage_sampler.py:83-96): returns ragged
+        (neighbors, counts) on host."""
+        if self.mode == "TPU":
+            indptr, indices = self.lazy_init_quiver()
+            seeds_d = jnp.asarray(np.asarray(seeds), indices.dtype)
+            nbrs, valid = _sample_layer_op(
+                indptr, indices, seeds_d, jnp.ones(seeds_d.shape, bool), size, self._next_key()
+            )
+            nbrs, valid = np.asarray(nbrs), np.asarray(valid)
+        else:
+            eng = self._host()
+            rng_seed = (self._seed * 0x9E3779B1 + self._call) & 0x7FFFFFFF
+            self._call += 1
+            nbrs, valid = eng.sample_layer(np.asarray(seeds, np.int64), size, rng_seed)
+        counts = valid.sum(axis=1)
+        return nbrs[valid], counts
+
+    def reindex(self, inputs, outputs, counts):
+        """Reference-compatible reindex of a ragged one-hop result
+        (sage_sampler.py:115-116): returns (n_id, row, col)."""
+        inputs = np.asarray(inputs)
+        counts = np.asarray(counts)
+        S = inputs.shape[0]
+        k = int(counts.max()) if S else 0
+        padded = np.zeros((S, max(k, 1)), np.int64)
+        mask = np.zeros((S, max(k, 1)), bool)
+        off = 0
+        flat = np.asarray(outputs)
+        for i, c in enumerate(counts):
+            padded[i, : int(c)] = flat[off : off + int(c)]
+            mask[i, : int(c)] = True
+            off += int(c)
+        res = local_reindex(
+            jnp.asarray(inputs), jnp.ones((S,), bool), jnp.asarray(padded), jnp.asarray(mask)
+        )
+        n_id = np.asarray(res.n_id)[: int(res.count)]
+        rows = np.repeat(np.arange(S), counts)
+        cols = np.asarray(res.local_nbrs)[np.asarray(res.nbr_valid)]
+        return n_id, rows, cols
+
+    # -- hot-probability propagation (reference sage_sampler.py:149-157) --
+    def sample_prob(self, train_idx, total_node_count: int):
+        indptr, indices = self.lazy_init_quiver() if self.mode == "TPU" else (
+            jnp.asarray(self.csr_topo.indptr),
+            jnp.asarray(self.csr_topo.indices),
+        )
+        return _sample_prob(
+            indptr, indices, self.sizes, jnp.asarray(np.asarray(train_idx)), total_node_count
+        )
+
+    # -- multiprocess hand-off shims (reference sage_sampler.py:159-178) --
+    def share_ipc(self):
+        return self.csr_topo, self.sizes, self.device, self.mode, self.caps, self._seed
+
+    @classmethod
+    def lazy_from_ipc_handle(cls, ipc_handle):
+        csr_topo, sizes, device, mode, caps, seed = ipc_handle
+        return cls(csr_topo, sizes, device=device, mode=mode, caps=caps, seed=seed)
+
+
+def dense_to_pyg(ds: DenseSample):
+    """Convert a padded DenseSample to the reference's ragged
+    ``(n_id, batch_size, [Adj])`` (host-side)."""
+    count = int(ds.count)
+    n_id = np.asarray(ds.n_id)[:count]
+    adjs = []
+    for adj in ds.adjs:
+        cols = np.asarray(adj.cols)
+        mask = np.asarray(adj.mask)
+        rows = np.broadcast_to(np.arange(cols.shape[0])[:, None], cols.shape)
+        edge_index = np.stack([cols[mask], rows[mask]]).astype(np.int64)
+        adjs.append(
+            Adj(
+                edge_index=edge_index,
+                e_id=np.empty((0,), np.int64),
+                size=(int(adj.n_src), int(adj.n_dst)),
+            )
+        )
+    return n_id, ds.batch_size, adjs

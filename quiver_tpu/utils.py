@@ -1,0 +1,262 @@
+"""Core graph-topology containers and helpers.
+
+TPU-native re-design of the reference's ``srcs/python/quiver/utils.py``
+(CSRTopo at utils.py:120, Topo/p2pCliqueTopo at utils.py:54-107,
+reindex_by_config at utils.py:230-248, parse_size at utils.py:260-281,
+init_p2p at utils.py:251-257).
+
+Key departures from the reference:
+
+- Topology lives in host numpy arrays (the TPU analog of pageable/pinned host
+  memory) and is materialised into device HBM on demand (`to_device`), instead
+  of the reference's UVA ``cudaHostRegister`` mapping — TPUs cannot read host
+  memory from inside a kernel, so the "UVA" tier becomes host-side sampling and
+  the "GPU" tier becomes HBM-resident CSR (see SURVEY.md section 7.3).
+- ids default to int32 on device when the graph fits (faster gathers on TPU);
+  int64 is kept for >2B-edge graphs (ogbn-papers100M scale).
+- The NVLink-clique `Topo` becomes `IciTopo`: introspection of the JAX device
+  mesh, where every chip in a TPU slice is one "clique" (all-to-all ICI),
+  replacing cudaDeviceCanAccessPeer probing.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def parse_size(sz: Union[int, str, float]) -> int:
+    """Parse a human byte size like ``"200M"``, ``"4GB"``, ``"1.5g"`` to bytes.
+
+    Mirrors reference ``utils.py:260-281`` (parse_size) but accepts fractional
+    values and an optional trailing "B".
+    """
+    if isinstance(sz, (int, np.integer)):
+        return int(sz)
+    if isinstance(sz, float):
+        return int(sz)
+    s = str(sz).strip().upper()
+    m = re.fullmatch(r"([0-9]*\.?[0-9]+)\s*([KMGT]?)B?", s)
+    if not m:
+        raise ValueError(f"Cannot parse size: {sz!r}")
+    value = float(m.group(1))
+    unit = m.group(2)
+    mult = {"": 1, "K": 1 << 10, "M": 1 << 20, "G": 1 << 30, "T": 1 << 40}[unit]
+    return int(value * mult)
+
+
+def _best_id_dtype(max_value: int) -> np.dtype:
+    """int32 when every index fits, else int64 (papers100M-scale edges)."""
+    return np.dtype(np.int32) if max_value < 2**31 - 1 else np.dtype(np.int64)
+
+
+class CSRTopo:
+    """CSR graph topology container (reference ``utils.py:120-248``).
+
+    Construct from an edge_index COO pair (2 x E) or from (indptr, indices).
+    Arrays are held as host numpy; `to_device()` returns jnp copies placed in
+    TPU HBM for device-mode sampling.
+
+    Attributes
+    ----------
+    indptr : np.ndarray [N+1]
+    indices : np.ndarray [E]
+    eid : optional np.ndarray [E] original edge ids (reference keeps these for
+        edge-feature lookup; ``Adj.e_id`` is empty in the reference snapshot,
+        sage_sampler.py:143, but we keep the slot)
+    feature_order : optional np.ndarray [N] new_order permutation produced by
+        `reindex_by_config` / `Feature.from_cpu_tensor` (reference
+        utils.py:171-186)
+    """
+
+    def __init__(
+        self,
+        edge_index=None,
+        indptr=None,
+        indices=None,
+        eid=None,
+        num_nodes: Optional[int] = None,
+    ):
+        if edge_index is not None:
+            edge_index = np.asarray(edge_index)
+            if edge_index.shape[0] != 2:
+                raise ValueError("edge_index must be [2, E]")
+            src = np.asarray(edge_index[0], dtype=np.int64)
+            dst = np.asarray(edge_index[1], dtype=np.int64)
+            n = int(num_nodes) if num_nodes is not None else int(
+                max(src.max(initial=-1), dst.max(initial=-1)) + 1
+            )
+            # COO -> CSR via counting sort on rows (reference uses scipy
+            # csr_matrix, utils.py:110-117; counting sort avoids the scipy dep
+            # and preserves a stable order of neighbors within a row).
+            order = np.argsort(src, kind="stable")
+            src_sorted = src[order]
+            self.indptr = np.zeros(n + 1, dtype=np.int64)
+            counts = np.bincount(src_sorted, minlength=n)
+            np.cumsum(counts, out=self.indptr[1:])
+            self.indices = dst[order]
+            self.eid = order.astype(np.int64)  # original edge id per CSR slot
+        elif indptr is not None and indices is not None:
+            self.indptr = np.ascontiguousarray(np.asarray(indptr, dtype=np.int64))
+            self.indices = np.ascontiguousarray(np.asarray(indices, dtype=np.int64))
+            self.eid = None if eid is None else np.asarray(eid, dtype=np.int64)
+            if num_nodes is not None and num_nodes + 1 > self.indptr.shape[0]:
+                pad = np.full(num_nodes + 1 - self.indptr.shape[0], self.indptr[-1])
+                self.indptr = np.concatenate([self.indptr, pad])
+        else:
+            raise ValueError("need edge_index or (indptr, indices)")
+        self._feature_order: Optional[np.ndarray] = None
+        self._device_cache = None
+
+    @property
+    def feature_order(self) -> Optional[np.ndarray]:
+        return self._feature_order
+
+    @feature_order.setter
+    def feature_order(self, order) -> None:
+        self._feature_order = np.asarray(order, dtype=np.int64)
+
+    @property
+    def degree(self) -> np.ndarray:
+        """Out-degree per node (reference utils.py:189-195)."""
+        return self.indptr[1:] - self.indptr[:-1]
+
+    @property
+    def node_count(self) -> int:
+        return self.indptr.shape[0] - 1
+
+    @property
+    def edge_count(self) -> int:
+        return self.indices.shape[0]
+
+    def share_memory_(self):
+        """No-op compat shim (reference utils.py:216-226).
+
+        JAX drives every local chip from one process; numpy arrays passed to
+        worker processes for CPU sampling go through OS fork/pickle instead of
+        torch shared memory.
+        """
+        return self
+
+    def to_device(self, device=None, id_dtype=None):
+        """Materialise (indptr, indices) as jnp arrays in HBM.
+
+        Returns a cached (indptr_dev, indices_dev) pair. ``id_dtype`` defaults
+        to int32 when indices fit (TPU gathers are cheaper on int32).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if id_dtype is None:
+            id_dtype = _best_id_dtype(max(self.edge_count, self.node_count + 1))
+        key = (str(device), np.dtype(id_dtype).name)
+        if self._device_cache is not None and self._device_cache[0] == key:
+            return self._device_cache[1]
+        indptr = jnp.asarray(self.indptr.astype(id_dtype))
+        indices = jnp.asarray(self.indices.astype(id_dtype))
+        if device is not None:
+            indptr = jax.device_put(indptr, device)
+            indices = jax.device_put(indices, device)
+        self._device_cache = (key, (indptr, indices))
+        return self._device_cache[1]
+
+
+def reindex_by_config(adj_csr: CSRTopo, graph_feature, gpu_portion: float):
+    """Degree-descending hot/cold reorder (reference ``utils.py:230-248``).
+
+    Sort nodes by out-degree descending, randomly shuffle the hot prefix
+    (top ``gpu_portion`` fraction) to load-balance striped placement, and
+    return ``(permuted_feature, prev_order)`` where ``prev_order`` maps
+    old node id -> position in the permuted feature ("feature_order").
+    """
+    if not 0.0 <= gpu_portion <= 1.0:
+        raise ValueError("gpu_portion must be in [0, 1]")
+    node_count = adj_csr.node_count
+    split = int(node_count * gpu_portion)
+    perm_range = np.random.permutation(split)
+    degree = adj_csr.degree
+    # descending degree order; stable for determinism on ties
+    prev_order = np.argsort(-degree, kind="stable")
+    prev_order[:split] = prev_order[perm_range]
+    new_order = np.empty(node_count, dtype=np.int64)
+    new_order[prev_order] = np.arange(node_count, dtype=np.int64)
+    if graph_feature is not None:
+        graph_feature = np.asarray(graph_feature)[prev_order]
+    return graph_feature, new_order
+
+
+def reindex_feature(graph: CSRTopo, feature, ratio: float):
+    """Reference ``utils.py:230`` companion used by Feature; returns
+    (reordered_feature, feature_order)."""
+    feature, new_order = reindex_by_config(graph, feature, ratio)
+    return feature, new_order
+
+
+@dataclass
+class IciTopo:
+    """TPU replacement for the NVLink p2p-clique `Topo` (reference
+    ``utils.py:54-107`` + Bron-Kerbosch find_cliques utils.py:8-33).
+
+    On a TPU slice every local chip is connected over ICI, so clique discovery
+    degenerates to "all local devices form one clique per slice". We keep the
+    same info surface: `get_clique(rank)`, `info()`.
+    """
+
+    cliques: List[List[int]]
+
+    @staticmethod
+    def detect(devices: Optional[Sequence] = None) -> "IciTopo":
+        import jax
+
+        devs = list(devices) if devices is not None else jax.local_devices()
+        by_slice = {}
+        for i, d in enumerate(devs):
+            slice_idx = getattr(d, "slice_index", 0) or 0
+            by_slice.setdefault(slice_idx, []).append(i)
+        return IciTopo(cliques=[sorted(v) for _, v in sorted(by_slice.items())])
+
+    def get_clique_id(self, device_rank: int) -> int:
+        for cid, clique in enumerate(self.cliques):
+            if device_rank in clique:
+                return cid
+        raise KeyError(device_rank)
+
+    def get_clique(self, device_rank: int) -> List[int]:
+        return self.cliques[self.get_clique_id(device_rank)]
+
+    @property
+    def p2p_clique(self):  # reference-compatible spelling
+        return {i: c for i, c in enumerate(self.cliques)}
+
+    def info(self) -> str:
+        lines = ["Device ICI Topology:"]
+        for cid, clique in enumerate(self.cliques):
+            lines.append(f"  clique {cid}: devices {clique} (all-to-all ICI)")
+        return "\n".join(lines)
+
+
+# Reference-compatible alias (`p2pCliqueTopo`, __init__.py:6).
+p2pCliqueTopo = IciTopo
+Topo = IciTopo
+
+
+def init_p2p(device_list: Optional[List[int]] = None) -> None:
+    """Compat no-op (reference utils.py:251-257 / quiver_feature.cu:363-406).
+
+    TPU chips in a slice are always mutually reachable over ICI; there is no
+    peer-access switch to flip. Kept so reference scripts port unchanged.
+    """
+    return None
+
+
+def can_device_access_peer(a: int, b: int) -> bool:
+    """ICI reachability probe (reference quiver_feature.cu:407-413): true when
+    both ranks sit on the same TPU slice."""
+    topo = IciTopo.detect()
+    try:
+        return topo.get_clique_id(a) == topo.get_clique_id(b)
+    except KeyError:
+        return False

@@ -1,0 +1,56 @@
+"""Test harness: hermetic 8-device CPU mesh.
+
+The reference could only test multi-GPU/multi-host paths on real clusters
+(SURVEY.md section 4 takeaway); JAX lets us fake an 8-device mesh on CPU, so
+every sharding/collective path is exercised in CI with no TPU attached.
+"""
+
+import os
+
+# Must be set before the CPU backend initializes. jax may already be imported
+# (site hooks register accelerator plugins at interpreter start), so also
+# force the platform through jax.config — env alone is too late then.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+assert len(jax.devices()) == 8, (
+    "hermetic test mesh needs 8 CPU devices; got " + str(jax.devices())
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_random_graph(n_nodes=200, n_edges=2000, seed=0):
+    """Random COO graph fixture (reference tests/cpp/test_quiver.cu:79-91
+    gen_random_graph)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_nodes, n_edges)
+    dst = rng.integers(0, n_nodes, n_edges)
+    return np.stack([src, dst])
+
+
+def make_chain_graph(n_layers=4, width=5):
+    """Deterministic graph where node i's neighbors are {(k+1)*N + i}: sample
+    validity is exactly checkable (reference tests/cpp/test_quiver_cpu.cpp:9-50
+    simple_graph + is_sample_valid oracle)."""
+    n = n_layers * width
+    edges = []
+    for i in range(n - width):
+        layer = i // width
+        for k in range(layer + 1, n_layers):
+            edges.append((i, k * width + i % width))
+    src = np.array([e[0] for e in edges])
+    dst = np.array([e[1] for e in edges])
+    return np.stack([src, dst]), n

@@ -1,0 +1,59 @@
+"""Offline partitioner tests (reference tests/python/cuda/test_partition_feature.py:
+partition quality / local-hit-rate oracle)."""
+
+import numpy as np
+
+from quiver_tpu.partition import (
+    load_quiver_feature_partition,
+    partition_feature_without_replication,
+    quiver_partition_feature,
+)
+
+
+def test_partition_covers_all_nodes():
+    rng = np.random.default_rng(0)
+    probs = [rng.random(1000) * (rng.random(1000) < 0.3) for _ in range(4)]
+    parts, book = partition_feature_without_replication(probs)
+    all_ids = np.concatenate(parts)
+    assert sorted(all_ids.tolist()) == list(range(1000))
+    assert (book >= 0).all()
+    for p, ids in enumerate(parts):
+        assert (book[ids] == p).all()
+
+
+def test_partition_prefers_own_probability():
+    n = 400
+    probs = []
+    for p in range(4):
+        v = np.zeros(n)
+        v[p * 100 : (p + 1) * 100] = 1.0  # partition p exclusively wants its block
+        probs.append(v)
+    parts, book = partition_feature_without_replication(probs)
+    # local hit rate: each partition should own (almost) its own block
+    for p in range(4):
+        own = set(range(p * 100, (p + 1) * 100))
+        got = set(parts[p].tolist())
+        hit = len(own & got) / 100
+        assert hit > 0.95, (p, hit)
+
+
+def test_partition_balance():
+    rng = np.random.default_rng(1)
+    probs = [rng.random(1000) for _ in range(4)]
+    parts, _ = partition_feature_without_replication(probs)
+    sizes = [len(p) for p in parts]
+    assert max(sizes) - min(sizes) < 300, sizes
+
+
+def test_partition_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    probs = [rng.random(200) for _ in range(2)]
+    parts, caches, book = quiver_partition_feature(
+        probs, str(tmp_path), cache_memory_budget=100 * 8, per_feature_size=8
+    )
+    ids0, cache0, book0 = load_quiver_feature_partition(0, str(tmp_path))
+    np.testing.assert_array_equal(ids0, parts[0])
+    np.testing.assert_array_equal(cache0, caches[0])
+    np.testing.assert_array_equal(book0, book)
+    # cached rows are rows partition 0 wants but does not own
+    assert not set(cache0.tolist()) & set(ids0.tolist())

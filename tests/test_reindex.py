@@ -1,0 +1,63 @@
+"""Reindex contract tests (reference tests/python/cuda/test_graph_reindex.py:
+permutation identity; reindex.cu.hpp min-index ordered-hash contract)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from quiver_tpu.ops.reindex import local_reindex
+from quiver_tpu.ops.cpu_kernels import host_reindex
+
+
+def test_seeds_first_and_first_occurrence_order():
+    seeds = jnp.array([7, 3, 9])
+    nbrs = jnp.array([[3, 100], [7, 200], [100, 300]])
+    valid = jnp.ones((3, 2), bool)
+    res = local_reindex(seeds, jnp.ones(3, bool), nbrs, valid)
+    n_id = np.asarray(res.n_id)
+    count = int(res.count)
+    assert count == 6
+    # seeds keep slots 0..2 in order; rest in first-occurrence order
+    assert n_id[:6].tolist() == [7, 3, 9, 100, 200, 300]
+    # local ids rewrite to those slots
+    np.testing.assert_array_equal(np.asarray(res.local_seeds), [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(res.local_nbrs), [[1, 3], [0, 4], [3, 5]])
+
+
+def test_invalid_masked_out():
+    seeds = jnp.array([5, 6])
+    nbrs = jnp.array([[42, 0], [0, 6]])
+    valid = jnp.array([[True, False], [False, True]])
+    res = local_reindex(seeds, jnp.ones(2, bool), nbrs, valid)
+    assert int(res.count) == 3
+    assert np.asarray(res.n_id)[:3].tolist() == [5, 6, 42]
+    # the garbage 0-entries got no local slot
+    assert np.asarray(res.local_nbrs)[0, 0] == 2
+    assert np.asarray(res.local_nbrs)[1, 1] == 1
+
+
+def test_roundtrip_identity():
+    rng = np.random.default_rng(4)
+    seeds = rng.choice(1000, 20, replace=False)
+    nbrs = rng.integers(0, 1000, (20, 6))
+    res = local_reindex(
+        jnp.asarray(seeds), jnp.ones(20, bool), jnp.asarray(nbrs), jnp.ones((20, 6), bool)
+    )
+    n_id = np.asarray(res.n_id)
+    local = np.asarray(res.local_nbrs)
+    # n_id[local] == original neighbor ids (the permutation round-trip oracle)
+    np.testing.assert_array_equal(n_id[local], nbrs)
+    np.testing.assert_array_equal(n_id[np.asarray(res.local_seeds)], seeds)
+
+
+def test_host_reindex_matches_device():
+    rng = np.random.default_rng(5)
+    seeds = rng.choice(500, 12, replace=False).astype(np.int64)
+    nbrs = rng.integers(0, 500, (12, 4)).astype(np.int64)
+    mask = rng.random((12, 4)) < 0.8
+    d = local_reindex(
+        jnp.asarray(seeds), jnp.ones(12, bool), jnp.asarray(nbrs), jnp.asarray(mask)
+    )
+    n_id_h, count_h, local_h, _ = host_reindex(seeds, 12, nbrs, mask)
+    assert count_h == int(d.count)
+    np.testing.assert_array_equal(n_id_h, np.asarray(d.n_id)[:count_h])
+    np.testing.assert_array_equal(local_h[mask], np.asarray(d.local_nbrs)[mask])

@@ -1,0 +1,171 @@
+"""Sampler correctness: validity oracle, distribution sanity, host==device
+semantics (reference test strategy: tests/cpp/test_quiver_cpu.cpp oracle,
+tests/python/cuda/test_sampler.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quiver_tpu.utils import CSRTopo
+from quiver_tpu.ops.sample import fisher_yates_positions, sample_layer
+from quiver_tpu.ops.cpu_kernels import HostSampler, native_available
+from quiver_tpu.pyg import GraphSageSampler
+from conftest import make_random_graph
+
+
+def neighbor_sets(topo):
+    return {
+        u: set(topo.indices[topo.indptr[u] : topo.indptr[u + 1]].tolist())
+        for u in range(topo.node_count)
+    }
+
+
+@pytest.fixture(scope="module")
+def graph():
+    edge_index = make_random_graph(120, 1500, seed=3)
+    return CSRTopo(edge_index=edge_index)
+
+
+def test_fisher_yates_exact_subset():
+    # every returned position distinct and in range, copy-all when deg<=k
+    key = jax.random.key(0)
+    deg = jnp.array([0, 1, 3, 5, 7, 20, 100], jnp.int32)
+    pos, valid = fisher_yates_positions(key, deg, 5)
+    pos, valid = np.asarray(pos), np.asarray(valid)
+    assert valid.sum(1).tolist() == [0, 1, 3, 5, 5, 5, 5]
+    for i, d in enumerate([0, 1, 3, 5, 7, 20, 100]):
+        p = pos[i][valid[i]]
+        assert len(set(p.tolist())) == len(p)
+        assert (p >= 0).all() and (p < max(d, 1)).all()
+    # copy-all rows are in order
+    assert pos[2][:3].tolist() == [0, 1, 2]
+
+
+def test_fisher_yates_uniformity():
+    # each position of [0, 6) should be drawn ~uniformly when k=3
+    deg = jnp.full((4000,), 6, jnp.int32)
+    pos, valid = fisher_yates_positions(jax.random.key(1), deg, 3)
+    counts = np.bincount(np.asarray(pos).reshape(-1), minlength=6)
+    expected = 4000 * 3 / 6
+    assert (np.abs(counts - expected) < 5 * np.sqrt(expected)).all()
+
+
+def test_sample_layer_validity(graph):
+    nbr = neighbor_sets(graph)
+    indptr, indices = graph.to_device()
+    seeds = jnp.arange(120, dtype=indices.dtype)
+    nbrs, valid = sample_layer(
+        indptr, indices, seeds, jnp.ones((120,), bool), 7, jax.random.key(2)
+    )
+    nbrs, valid = np.asarray(nbrs), np.asarray(valid)
+    for i in range(120):
+        deg = len(graph.indices[graph.indptr[i] : graph.indptr[i + 1]])
+        assert valid[i].sum() == min(deg, 7)
+        for v in nbrs[i][valid[i]]:
+            assert int(v) in nbr[i]
+
+
+def test_host_sampler_validity(graph):
+    nbr = neighbor_sets(graph)
+    eng = HostSampler(graph.indptr, graph.indices)
+    seeds = np.arange(120, dtype=np.int64)
+    nbrs, valid = eng.sample_layer(seeds, 7, seed=7)
+    for i in range(120):
+        deg = graph.indptr[i + 1] - graph.indptr[i]
+        assert valid[i].sum() == min(deg, 7)
+        vals = nbrs[i][valid[i]]
+        # without replacement: within-row duplicates only if the graph has
+        # duplicate edges
+        for v in vals:
+            assert int(v) in nbr[i]
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib not built")
+def test_native_distinct_positions():
+    # star graph: node 0 has 50 distinct neighbors; k=10 draws are distinct
+    n = 51
+    src = np.zeros(50, np.int64)
+    dst = np.arange(1, 51, dtype=np.int64)
+    topo = CSRTopo(edge_index=np.stack([src, dst]), num_nodes=n)
+    eng = HostSampler(topo.indptr, topo.indices)
+    for s in range(5):
+        nbrs, valid = eng.sample_layer(np.array([0]), 10, seed=s)
+        got = nbrs[0][valid[0]]
+        assert len(set(got.tolist())) == 10
+
+
+def test_multihop_dense_consistency(graph):
+    sampler = GraphSageSampler(graph, sizes=[5, 3], mode="TPU", seed=11)
+    seeds = np.arange(0, 32)
+    ds = sampler.sample_dense(seeds)
+    n_id = np.asarray(ds.n_id)
+    count = int(ds.count)
+    # seeds first
+    np.testing.assert_array_equal(n_id[:32], seeds)
+    # unique among valid
+    assert len(set(n_id[:count].tolist())) == count
+    # adjs reversed: adjs[-1] is the first hop (targets = the 32 seeds)
+    innermost = ds.adjs[-1]
+    assert innermost.cols.shape[0] == 32
+    nbr = neighbor_sets(graph)
+    # every valid edge in every hop connects real graph neighbors
+    layer_nid = [None] * (len(ds.adjs) + 1)
+    # reconstruct per-hop source n_id widths: innermost targets are seeds
+    cur_ids = n_id  # outermost source ids
+    for adj in ds.adjs:
+        cols = np.asarray(adj.cols)
+        mask = np.asarray(adj.mask)
+        n_src = int(adj.n_src)
+        tgt_width = cols.shape[0]
+        for i in range(tgt_width):
+            for j in range(cols.shape[1]):
+                if mask[i, j]:
+                    src_node = cur_ids[cols[i, j]]
+                    tgt_node = cur_ids[i]  # targets are the prefix
+                    assert int(src_node) in nbr[int(tgt_node)]
+        cur_ids = cur_ids[:tgt_width]
+
+
+def test_pyg_compat_surface(graph):
+    sampler = GraphSageSampler(graph, sizes=[4, 2], mode="TPU", seed=5)
+    n_id, batch_size, adjs = sampler.sample(np.arange(16))
+    assert batch_size == 16
+    np.testing.assert_array_equal(n_id[:16], np.arange(16))
+    assert len(adjs) == 2
+    # Adj sizes: (n_src, n_dst); outermost first
+    assert adjs[0].size[0] >= adjs[0].size[1]
+    assert adjs[-1].size[1] == 16
+    for adj in adjs:
+        assert adj.edge_index.shape[0] == 2
+        assert adj.e_id.size == 0
+
+
+def test_host_mode_matches_device_shapes(graph):
+    tpu = GraphSageSampler(graph, sizes=[4, 2], mode="TPU", seed=5)
+    host = GraphSageSampler(graph, sizes=[4, 2], mode="HOST", seed=5)
+    ds_t = tpu.sample_dense(np.arange(16))
+    ds_h = host.sample_dense(np.arange(16))
+    assert ds_t.n_id.shape == ds_h.n_id.shape
+    for a, b in zip(ds_t.adjs, ds_h.adjs):
+        assert a.cols.shape == b.cols.shape
+        assert a.mask.shape == b.mask.shape
+    # host seeds-first contract too
+    np.testing.assert_array_equal(np.asarray(ds_h.n_id)[:16], np.arange(16))
+
+
+def test_deterministic_given_seed(graph):
+    s1 = GraphSageSampler(graph, sizes=[5], mode="TPU", seed=9)
+    s2 = GraphSageSampler(graph, sizes=[5], mode="TPU", seed=9)
+    a = s1.sample_dense(np.arange(10))
+    b = s2.sample_dense(np.arange(10))
+    np.testing.assert_array_equal(np.asarray(a.n_id), np.asarray(b.n_id))
+
+
+def test_sample_prob_monotone(graph):
+    sampler = GraphSageSampler(graph, sizes=[5, 3], mode="TPU")
+    prob = np.asarray(sampler.sample_prob(np.arange(20), graph.node_count))
+    assert prob.shape == (graph.node_count,)
+    assert (prob >= 0).all()
+    # training seeds themselves must be hot
+    assert (prob[:20] > 0).all()
