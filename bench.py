@@ -5,6 +5,11 @@ edges per second, bench_sampler.py:14-16) on an ogbn-products-scale synthetic
 graph, fanout [15, 10, 5], batch 1024 — the config behind the reference's
 headline 34.29M SEPS UVA number (docs/Introduction_en.md:41, BASELINE.md).
 
+Timing is tunnel-safe: every iteration's edge count folds into a dependent
+accumulator and ONE scalar fetch ends the run, so the device must have
+finished every sample step before the clock stops (block_until_ready alone
+can return early through the remote-TPU relay).
+
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
@@ -35,61 +40,80 @@ def build_graph(n_nodes=2_449_029, n_edges=61_859_140, seed=0):
     return indptr, dst
 
 
+def measure(run_jit, seed_batches, iters, warmup=3):
+    """Dependent-accumulation timing: returns (seps, total_edges)."""
+    import jax
+    import jax.numpy as jnp
+
+    acc = jnp.int32(0)
+    for i in range(warmup):
+        acc = acc + run_jit(jax.random.key(i), seed_batches[i % len(seed_batches)])
+    int(acc)  # sync
+    t0 = time.time()
+    acc = jnp.int32(0)
+    for i in range(iters):
+        acc = acc + run_jit(jax.random.key(100 + i), seed_batches[i % len(seed_batches)])
+    total_edges = int(acc)  # single dependent fetch == full completion
+    dt = time.time() - t0
+    return total_edges / dt, total_edges
+
+
 def main():
     import jax
     import jax.numpy as jnp
 
-    from quiver_tpu.pyg.sage_sampler import sample_dense_pure
+    from quiver_tpu.pyg.sage_sampler import sample_dense_fused, sample_dense_pure
 
     batch = 1024
     sizes = (15, 10, 5)
     n_nodes = 2_449_029
+    iters = 20
 
     indptr_np, indices_np = build_graph(n_nodes=n_nodes)
     indptr = jnp.asarray(indptr_np.astype(np.int32))
     indices = jnp.asarray(indices_np.astype(np.int32))
     log(f"devices: {jax.devices()}")
 
-    def run(key, seeds):
-        ds = sample_dense_pure(indptr, indices, key, seeds, sizes)
-        edges = sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
-        return edges
+    def run_fused(key, seeds):
+        ds = sample_dense_fused(indptr, indices, key, seeds, sizes)
+        return sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
 
-    run_jit = jax.jit(run)
+    def run_dedup(key, seeds):
+        ds = sample_dense_pure(indptr, indices, key, seeds, sizes)
+        return sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
 
     rng = np.random.default_rng(1)
     seed_batches = [
         jnp.asarray(rng.integers(0, n_nodes, batch, dtype=np.int64).astype(np.int32))
         for _ in range(24)
     ]
-    log("compiling...")
-    t0 = time.time()
-    e = run_jit(jax.random.key(0), seed_batches[0])
-    jax.block_until_ready(e)
-    log(f"compile+first run: {time.time()-t0:.1f}s, edges/iter={int(e)}")
 
-    # warmup
-    for i in range(1, 4):
-        jax.block_until_ready(run_jit(jax.random.key(i), seed_batches[i]))
-
-    iters = 20
+    fused_jit = jax.jit(run_fused)
+    log("compiling fused pipeline...")
     t0 = time.time()
-    edge_counts = []
-    for i in range(iters):
-        edge_counts.append(run_jit(jax.random.key(100 + i), seed_batches[i % len(seed_batches)]))
-    jax.block_until_ready(edge_counts)
-    dt = time.time() - t0
-    total_edges = int(np.sum([int(x) for x in edge_counts]))
-    seps = total_edges / dt
-    log(f"{iters} iters in {dt:.3f}s -> {seps/1e6:.2f}M SEPS")
+    e = int(fused_jit(jax.random.key(0), seed_batches[0]))
+    log(f"fused compile+first run: {time.time()-t0:.1f}s, edges/iter={e}")
+    seps_fused, edges_f = measure(fused_jit, seed_batches, iters)
+    log(f"fused  : {seps_fused/1e6:.2f}M SEPS ({edges_f} edges)")
+
+    try:
+        dedup_jit = jax.jit(run_dedup)
+        log("compiling dedup pipeline...")
+        t0 = time.time()
+        int(dedup_jit(jax.random.key(0), seed_batches[0]))
+        log(f"dedup compile+first run: {time.time()-t0:.1f}s")
+        seps_dedup, _ = measure(dedup_jit, seed_batches, max(iters // 2, 5))
+        log(f"dedup  : {seps_dedup/1e6:.2f}M SEPS (reference-parity reindex path)")
+    except Exception as exc:  # secondary diagnostic only
+        log(f"dedup path failed: {exc}")
 
     print(
         json.dumps(
             {
                 "metric": "neighbor_sampling_throughput",
-                "value": round(seps, 1),
+                "value": round(seps_fused, 1),
                 "unit": "sampled_edges_per_sec",
-                "vs_baseline": round(seps / BASELINE_SEPS, 4),
+                "vs_baseline": round(seps_fused / BASELINE_SEPS, 4),
             }
         )
     )

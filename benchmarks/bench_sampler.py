@@ -1,0 +1,67 @@
+"""Sampling throughput (SEPS) across backends — the reference's
+benchmarks/sample/bench_sampler.py (SEPS metric at lines 14-16), TPU edition.
+
+Backends: TPU (HBM CSR, XLA pipeline), HOST (native C++ host engine), CPU
+(same engine, results stay host-side). Synthetic products-scale graph.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def build_graph(n_nodes, n_edges, seed=0):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_nodes, n_edges, dtype=np.int64)
+    dst = rng.integers(0, n_nodes, n_edges, dtype=np.int64)
+    return np.stack([src, dst])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2_449_029)
+    ap.add_argument("--edges", type=int, default=61_859_140)
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--sizes", default="15,10,5")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--modes", default="TPU,HOST")
+    args = ap.parse_args()
+
+    import jax
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.pyg import GraphSageSampler
+    from quiver_tpu.trace import seps
+
+    sizes = [int(s) for s in args.sizes.split(",")]
+    topo = CSRTopo(edge_index=build_graph(args.nodes, args.edges))
+    rng = np.random.default_rng(1)
+
+    for mode in args.modes.split(","):
+        sampler = GraphSageSampler(topo, sizes=sizes, mode=mode)
+        seeds0 = rng.integers(0, args.nodes, args.batch_size)
+        ds = sampler.sample_dense(seeds0)  # compile/warm
+        jax.block_until_ready(ds.n_id)
+        total_edges = 0
+        t0 = time.time()
+        results = []
+        for _ in range(args.iters):
+            seeds = rng.integers(0, args.nodes, args.batch_size)
+            ds = sampler.sample_dense(seeds)
+            results.append(ds)
+        for ds in results:
+            jax.block_until_ready(ds.n_id)
+            total_edges += int(sum(int(np.asarray(a.mask).sum()) for a in ds.adjs))
+        dt = time.time() - t0
+        print(f"{mode:5s}: {seps(total_edges, dt)/1e6:8.2f}M SEPS "
+              f"({total_edges} edges / {dt:.3f}s, batch={args.batch_size}, sizes={sizes})")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,8 @@ from .partition import (
     partition_feature_without_replication,
     quiver_partition_feature,
 )
-from . import pyg
+from . import comm, pyg, trace
+from .comm import HostRankTable, NcclComm, TpuComm, getNcclId
 
 __version__ = "0.1.0"
 
@@ -33,7 +34,13 @@ __all__ = [
     "DeviceConfig",
     "DistFeature",
     "Feature",
+    "HostRankTable",
     "IciTopo",
+    "NcclComm",
+    "TpuComm",
+    "comm",
+    "getNcclId",
+    "trace",
     "Offset",
     "PartitionInfo",
     "ShardTensor",

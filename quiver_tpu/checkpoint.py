@@ -1,0 +1,76 @@
+"""Checkpoint / resume for sampled-GNN training state.
+
+The reference has NO library-level checkpointing (SURVEY.md section 5:
+"absent from the library"; only benchmark scripts load Lightning checkpoints
+for eval, train_quiver_multi_node.py:436-451, and offline artifacts are
+torch.save'd files, partition.py:133-141). This module closes that gap with
+an orbax-backed store for (params, opt_state, step, sampler RNG cursor), so
+long multi-epoch runs survive preemption — table stakes on TPU pods.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+class CheckpointManager:
+    """Thin orbax CheckpointManager wrapper keyed by step.
+
+    save/restore operate on a pytree dict, e.g.::
+
+        mgr = CheckpointManager("/tmp/run1", max_to_keep=3)
+        mgr.save(step, {"params": params, "opt_state": opt_state,
+                        "sampler_call": sampler._call})
+        state = mgr.restore()           # latest, or restore(step)
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        ocp = _ocp()
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: Dict[str, Any], wait: bool = True) -> None:
+        ocp = _ocp()
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+        ocp = _ocp()
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        if template is not None:
+            return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        return self._mgr.restore(step)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_partition_artifacts(path: str, **arrays) -> None:
+    """Persist offline artifacts (partition books, orders, preprocessed CSR)
+    — the torch.save analog (reference preprocess.py:143-179)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+
+
+def load_partition_artifacts(path: str) -> Dict[str, np.ndarray]:
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    return {k: data[k] for k in data.files}

@@ -42,6 +42,17 @@ class DeviceConfig:
     device_cache_size: Union[int, str] = 0
 
 
+@jax.jit
+def _padded_gather(table: jax.Array, ids: jax.Array) -> jax.Array:
+    return jnp.take(table, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
+
+
+@jax.jit
+def _padded_gather_ordered(table: jax.Array, order: jax.Array, ids: jax.Array) -> jax.Array:
+    ids = jnp.take(order, jnp.clip(ids, 0, order.shape[0] - 1))
+    return jnp.take(table, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
+
+
 class Feature:
     """Tiered [N, D] float feature store (reference feature.py:17).
 
@@ -163,14 +174,24 @@ class Feature:
     def __getitem__(self, node_idx) -> jax.Array:
         """Gather features for (original) node ids; remaps through
         feature_order then hits the tiered ShardTensor (reference
-        feature.py:296-333)."""
+        feature.py:296-333). Out-of-range ids (e.g. the sampler's
+        sentinel padding) yield zero rows."""
         ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
+        invalid = (ids < 0) | (ids >= self._n)
+        if invalid.any():
+            ids = np.where(invalid, 0, ids)
         if self.feature_order is not None:
             ids = self.feature_order[ids]
-        return self.shard_tensor[ids]
+        rows = self.shard_tensor[ids]
+        if invalid.any():
+            rows = rows * jnp.asarray(~invalid, rows.dtype)[:, None]
+        return rows
 
     def lookup_padded(self, node_idx: jax.Array, valid: Optional[jax.Array] = None) -> jax.Array:
-        """Jit-friendly gather for padded id arrays.
+        """Jit-friendly gather for padded id arrays; already jitted
+        internally (the table is passed as an ARGUMENT to the jitted
+        program — never ``jax.jit`` a bound method of this class, or the
+        table becomes a baked-in compile-time constant).
 
         Requires the feature to be fully device-resident (single hot shard on
         this chip covering all rows); multi-tier padded lookup goes through
@@ -183,13 +204,12 @@ class Feature:
                 "use __getitem__ (tiered) or the mesh-sharded gather"
             )
         table = st.device_shards[0][1]
-        ids = node_idx
         if self.feature_order is not None:
             if self._order_dev is None:
                 self._order_dev = jnp.asarray(self.feature_order)
-            ids = jnp.take(self._order_dev, jnp.clip(ids, 0, self._n - 1))
-        ids = jnp.clip(ids, 0, table.shape[0] - 1)
-        rows = jnp.take(table, ids, axis=0)
+            rows = _padded_gather_ordered(table, self._order_dev, node_idx)
+        else:
+            rows = _padded_gather(table, node_idx)
         if valid is not None:
             rows = rows * valid[:, None].astype(rows.dtype)
         return rows
@@ -266,16 +286,24 @@ class PartitionInfo:
         self._build_global2local()
 
     def _build_global2local(self):
+        """global id -> owner-local row, for EVERY host (reference
+        feature.py:484-508 ranks each host's owned ids 0..n_h-1)."""
         n = self.global2host.shape[0]
         self.global2local = np.zeros(n, dtype=np.int64)
+        for h in range(self.hosts):
+            owned = np.nonzero(self.global2host == h)[0]
+            self.global2local[owned] = np.arange(owned.shape[0])
         local_mask = self.global2host == self.host
         if self.replicate is not None:
+            # replicated ids live after this host's owned rows, in the order
+            # given (reference feature.py:497-505)
             local_mask = local_mask.copy()
-            local_mask[self.replicate] = True
+            owned_count = int(local_mask.sum())
+            rep = self.replicate[~local_mask[self.replicate]]
+            self.global2local[rep] = owned_count + np.arange(rep.shape[0])
+            local_mask[rep] = True
         local_ids = np.nonzero(local_mask)[0]
-        self.global2local[local_ids] = np.arange(local_ids.shape[0])
         self.local_ids = local_ids
-        # remote ids keep their global id as the "local" key on the owner side
         self.local_mask = local_mask
 
     def dispatch(self, ids: np.ndarray):
@@ -308,10 +336,18 @@ class DistFeature:
     def __getitem__(self, ids) -> jax.Array:
         ids = np.asarray(ids).astype(np.int64)
         per_host, local_ids, per_pos, local_pos = self.info.dispatch(ids)
-        remote_feats = self.comm.exchange(per_host, self.feature)
+        # owners answer in their local row space (reference set_local_order
+        # remap, feature.py:283-294 + comm.py:165-168 local gather)
+        per_host_local = [self.info.global2local[h_ids] for h_ids in per_host]
+        remote_feats = self.comm.exchange(per_host_local, self.feature)
         out = np.zeros((ids.shape[0], self.feature.dim), np.float32)
         if local_ids.size:
-            out[local_pos] = np.asarray(self.feature[local_ids])
+            # a Feature with set_local_order applied remaps global ids itself
+            # (reference feature.py:283-294); otherwise localize here
+            if self.feature._local_order_applied:
+                out[local_pos] = np.asarray(self.feature[local_ids])
+            else:
+                out[local_pos] = np.asarray(self.feature[self.info.global2local[local_ids]])
         for h, feats in enumerate(remote_feats):
             if feats is not None and per_pos[h].size:
                 out[per_pos[h]] = np.asarray(feats)

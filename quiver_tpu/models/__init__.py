@@ -1,5 +1,6 @@
 """Reference-parity model zoo (GraphSAGE, GAT) in flax."""
 
 from .sage import SAGEConv, GraphSAGE, masked_mean_aggregate
+from .gat import GAT, GATConv
 
-__all__ = ["SAGEConv", "GraphSAGE", "masked_mean_aggregate"]
+__all__ = ["GAT", "GATConv", "SAGEConv", "GraphSAGE", "masked_mean_aggregate"]

@@ -1,0 +1,6 @@
+"""Auto-install pickle reducers on import (reference
+srcs/python/quiver/multiprocessing/__init__.py:1-3)."""
+
+from .reductions import init_reductions
+
+init_reductions()

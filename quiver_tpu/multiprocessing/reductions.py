@@ -1,0 +1,45 @@
+"""Pickle hooks so samplers/features cross process boundaries.
+
+Re-design of the reference ``srcs/python/quiver/multiprocessing/reductions.py``
+(register ForkingPickler reducers, reductions.py:30-33; rebuild via
+lazy_from_ipc_handle, reductions.py:5-27).
+
+On TPU there is no CUDA-IPC: one JAX process drives every local chip, so the
+only real cross-process hand-off is to CPU sampling workers. The reducers
+therefore serialize the *host-side* state (CSRTopo arrays, feature handles)
+and rebuild lazily in the child — same API shape, no device handles.
+"""
+
+from __future__ import annotations
+
+from multiprocessing.reduction import ForkingPickler
+
+from ..feature import Feature
+from ..pyg.sage_sampler import GraphSageSampler
+
+
+def rebuild_feature(ipc_handle):
+    """Reference reductions.py:5-9."""
+    rank = ipc_handle.get("rank", 0) if isinstance(ipc_handle, dict) else 0
+    return Feature.lazy_from_ipc_handle(rank, ipc_handle)
+
+
+def reduce_feature(feature: Feature):
+    """Reference reductions.py:11-15."""
+    return (rebuild_feature, (feature.share_ipc(),))
+
+
+def rebuild_pyg_sampler(cls, ipc_handle):
+    """Reference reductions.py:17-20."""
+    return cls.lazy_from_ipc_handle(ipc_handle)
+
+
+def reduce_pyg_sampler(sampler: GraphSageSampler):
+    """Reference reductions.py:22-26."""
+    return (rebuild_pyg_sampler, (type(sampler), sampler.share_ipc()))
+
+
+def init_reductions() -> None:
+    """Reference reductions.py:30-33."""
+    ForkingPickler.register(Feature, reduce_feature)
+    ForkingPickler.register(GraphSageSampler, reduce_pyg_sampler)

@@ -6,14 +6,20 @@ from .sage_sampler import (
     DenseSample,
     GraphSageSampler,
     dense_to_pyg,
+    sample_dense_fused,
     sample_dense_pure,
 )
+from .mixed_sampler import MixedGraphSageSampler, SampleJob, TrainSampleJob
 
 __all__ = [
     "Adj",
     "DenseAdj",
     "DenseSample",
     "GraphSageSampler",
+    "MixedGraphSageSampler",
+    "SampleJob",
+    "TrainSampleJob",
     "dense_to_pyg",
+    "sample_dense_fused",
     "sample_dense_pure",
 ]

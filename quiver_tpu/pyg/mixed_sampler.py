@@ -1,0 +1,312 @@
+"""Hybrid device+CPU adaptive sampling.
+
+Re-design of the reference's ``MixedGraphSageSampler``/``SampleJob``
+(srcs/python/quiver/pyg/sage_sampler.py:180-376): daemon CPU worker
+processes drain a task queue (cpu_sampler_worker_loop, sage_sampler.py:198-205)
+while the device samples inline; every epoch the task split between device
+and CPU is re-decided from measured average sample times
+(decide_task_num, sage_sampler.py:272-288).
+
+TPU mapping: "device" sampling is the XLA pipeline on the chip (which is
+also busy training, so shifting sampling work to host CPUs is exactly as
+valuable as it was on GPU); "CPU" sampling is the native host engine
+(`quiver_tpu.csrc`). Workers are forked processes — the CSR arrays are
+inherited copy-on-write, replacing the reference's torch shared memory
+(CSRTopo.share_memory_, utils.py:216-226).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as queue_mod
+import time
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..utils import CSRTopo
+from .sage_sampler import DenseSample, GraphSageSampler
+
+
+class SampleJob:
+    """Abstract indexable, shuffleable task list (reference
+    sage_sampler.py:180-195). Each task is a seed batch."""
+
+    def __getitem__(self, index: int):
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class TrainSampleJob(SampleJob):
+    """Canonical job: shuffle train ids, fixed-size seed batches."""
+
+    def __init__(self, train_idx: np.ndarray, batch_size: int, seed: int = 0):
+        self.train_idx = np.asarray(train_idx, np.int64).copy()
+        self.batch_size = batch_size
+        self._rng = np.random.default_rng(seed)
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self.train_idx)
+
+    def __len__(self) -> int:
+        return (len(self.train_idx) + self.batch_size - 1) // self.batch_size
+
+    def __getitem__(self, index: int):
+        lo = index * self.batch_size
+        return self.train_idx[lo : lo + self.batch_size]
+
+
+def _cpu_worker_loop(shm_names, shapes, sizes, caps, seed, task_q, result_q):
+    """Reference cpu_sampler_worker_loop (sage_sampler.py:198-205).
+
+    Workers are spawned (fork deadlocks under the JAX runtime's threads) and
+    attach the CSR arrays through POSIX shared memory — the analog of the
+    reference sharing CSRTopo via torch shm (utils.py:216-226)."""
+    from multiprocessing import shared_memory
+
+    from ..ops.cpu_kernels import HostSampler
+
+    shms = [shared_memory.SharedMemory(name=n) for n in shm_names]
+    indptr = np.ndarray(shapes[0], dtype=np.int64, buffer=shms[0].buf)
+    indices = np.ndarray(shapes[1], dtype=np.int64, buffer=shms[1].buf)
+    eng = HostSampler(indptr, indices)
+    try:
+        while True:
+            item = task_q.get()
+            if item is None:
+                return
+            epoch, task_idx, seeds = item
+            t0 = time.perf_counter()
+            n_id, count, adjs = eng.sample_multilayer(
+                np.asarray(seeds, np.int64), sizes, seed + epoch * 1009 + task_idx, caps
+            )
+            dt = time.perf_counter() - t0
+            result_q.put((epoch, task_idx, n_id, count, adjs, dt))
+    finally:
+        del eng, indptr, indices
+        for shm in shms:
+            shm.close()
+
+
+class MixedGraphSageSampler:
+    """Adaptive device+CPU k-hop sampler (reference sage_sampler.py:207-376).
+
+    mode: "TPU_CPU_MIXED" | "HOST_CPU_MIXED" | "TPU_ONLY" | "CPU_ONLY"
+    (reference spellings GPU_CPU_MIXED / UVA_CPU_MIXED / GPU_ONLY /
+    UVA_ONLY accepted).
+
+    Iterating yields ``(task_idx, DenseSample)`` per task, one epoch per
+    ``__iter__`` (job reshuffled each epoch like the reference).
+    """
+
+    MODE_ALIASES = {
+        "GPU_CPU_MIXED": "TPU_CPU_MIXED",
+        "UVA_CPU_MIXED": "HOST_CPU_MIXED",
+        "GPU_ONLY": "TPU_ONLY",
+        "UVA_ONLY": "TPU_ONLY",
+    }
+
+    def __init__(
+        self,
+        job: SampleJob,
+        csr_topo: CSRTopo,
+        sizes: Sequence[int],
+        num_workers: int = 2,
+        device: int = 0,
+        mode: str = "TPU_CPU_MIXED",
+        caps: Optional[Sequence[Optional[int]]] = None,
+        seed: int = 0,
+    ):
+        mode = self.MODE_ALIASES.get(mode, mode)
+        if mode not in ("TPU_CPU_MIXED", "HOST_CPU_MIXED", "TPU_ONLY", "CPU_ONLY"):
+            raise ValueError(f"unsupported mode: {mode}")
+        if mode == "CPU_ONLY" and num_workers < 1:
+            raise ValueError("CPU_ONLY mode needs num_workers >= 1")
+        self.job = job
+        self.csr_topo = csr_topo
+        self.sizes = tuple(int(s) for s in sizes)
+        self.caps = None if caps is None else tuple(caps)
+        self.num_workers = num_workers if "MIXED" in mode or mode == "CPU_ONLY" else 0
+        self.mode = mode
+        self.seed = seed
+        dev_mode = "HOST" if mode.startswith("HOST") else "TPU"
+        self.device_sampler = (
+            None
+            if mode == "CPU_ONLY"
+            else GraphSageSampler(
+                csr_topo, sizes, device=device, mode=dev_mode, caps=caps, seed=seed
+            )
+        )
+        self._workers = []
+        self._task_q = None
+        self._result_q = None
+        # measured averages drive the adaptive split (reference
+        # avg_device_time/avg_cpu_time, sage_sampler.py:262-270)
+        self.avg_device_time = 0.0
+        self.avg_cpu_time = 0.0
+
+    # -- worker lifecycle (reference lazy_init, sage_sampler.py:298-313) ----
+    def lazy_init(self) -> None:
+        if self._workers or self.num_workers == 0:
+            return
+        from multiprocessing import shared_memory
+
+        ctx = mp.get_context("spawn")
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._shms = []
+        shm_names, shapes = [], []
+        for arr in (self.csr_topo.indptr, self.csr_topo.indices):
+            arr = np.ascontiguousarray(arr, np.int64)
+            shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+            np.ndarray(arr.shape, np.int64, buffer=shm.buf)[:] = arr
+            self._shms.append(shm)
+            shm_names.append(shm.name)
+            shapes.append(arr.shape)
+        for w in range(self.num_workers):
+            p = ctx.Process(
+                target=_cpu_worker_loop,
+                args=(
+                    shm_names,
+                    shapes,
+                    self.sizes,
+                    self.caps,
+                    self.seed + 7919 * (w + 1),
+                    self._task_q,
+                    self._result_q,
+                ),
+                daemon=True,
+            )
+            p.start()
+            self._workers.append(p)
+
+    def shutdown(self) -> None:
+        if self._task_q is not None:
+            for _ in self._workers:
+                self._task_q.put(None)
+        for p in self._workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._workers = []
+        self._task_q = None
+        self._result_q = None
+        for shm in getattr(self, "_shms", []):
+            try:
+                shm.close()
+                shm.unlink()
+            except Exception:
+                pass
+        self._shms = []
+
+    # -- adaptive split (reference decide_task_num, sage_sampler.py:272-288)
+    def decide_task_num(self, total: int) -> int:
+        """Number of tasks the device takes this epoch."""
+        if self.mode == "CPU_ONLY":
+            return 0
+        if self.num_workers == 0 or self.mode == "TPU_ONLY":
+            return total
+        if self.avg_device_time <= 0 or self.avg_cpu_time <= 0:
+            # first epoch: split evenly to get measurements
+            return max(total // 2, 1)
+        device_rate = 1.0 / self.avg_device_time
+        cpu_rate = self.num_workers / self.avg_cpu_time
+        share = device_rate / (device_rate + cpu_rate)
+        return int(round(total * share))
+
+    def _update_avg(self, attr: str, dt: float) -> None:
+        prev = getattr(self, attr)
+        setattr(self, attr, dt if prev == 0 else 0.9 * prev + 0.1 * dt)
+
+    def _to_dense(self, n_id, count, adjs) -> DenseSample:
+        import jax.numpy as jnp
+
+        from .sage_sampler import DenseAdj
+
+        dense_adjs = tuple(
+            DenseAdj(
+                cols=jnp.asarray(a["cols"]),
+                mask=jnp.asarray(a["mask"]),
+                n_src=jnp.asarray(a["n_src"], jnp.int32),
+                n_dst=jnp.asarray(a["n_dst"], jnp.int32),
+            )
+            for a in adjs[::-1]
+        )
+        return DenseSample(
+            n_id=jnp.asarray(n_id),
+            count=jnp.asarray(count, jnp.int32),
+            batch_size=int(adjs[0]["n_dst"]) if adjs else 0,
+            adjs=dense_adjs,
+        )
+
+    # -- epoch iterator (reference iter_sampler, sage_sampler.py:316-368) ---
+    def __iter__(self) -> Iterator:
+        self.lazy_init()
+        self.job.shuffle()
+        # stale-epoch fencing: an abandoned iterator (break/GeneratorExit)
+        # may leave this epoch's tasks in flight; results are tagged with the
+        # epoch and anything older is discarded on receipt
+        self._epoch = getattr(self, "_epoch", 0) + 1
+        epoch = self._epoch
+        total = len(self.job)
+        device_num = self.decide_task_num(total)
+
+        def recv(block: bool):
+            """Next CPU result of THIS epoch, or None."""
+            while True:
+                try:
+                    if block:
+                        item = self._result_q.get(timeout=120)
+                    else:
+                        item = self._result_q.get_nowait()
+                except queue_mod.Empty:
+                    return None
+                r_epoch, task_idx, n_id, count, adjs, dt = item
+                if r_epoch != epoch:
+                    continue  # stale result from an interrupted epoch
+                self._update_avg("avg_cpu_time", dt)
+                return task_idx, self._to_dense(n_id, count, adjs)
+
+        # CPU tasks go to the shared queue up front (round-robin in the
+        # reference, one shared queue here — workers self-balance)
+        for t in range(device_num, total):
+            self._task_q.put((epoch, t, np.asarray(self.job[t], np.int64)))
+        outstanding = total - device_num
+        try:
+            for t in range(device_num):
+                t0 = time.perf_counter()
+                ds = self.device_sampler.sample_dense(self.job[t])
+                import jax
+
+                jax.block_until_ready(ds.n_id)
+                self._update_avg("avg_device_time", time.perf_counter() - t0)
+                yield t, ds
+                # drain any finished CPU results between device tasks
+                while outstanding:
+                    res = recv(block=False)
+                    if res is None:
+                        break
+                    outstanding -= 1
+                    yield res
+            while outstanding:
+                res = recv(block=True)
+                if res is None:
+                    raise TimeoutError("CPU sampler workers stalled")
+                outstanding -= 1
+                yield res
+        except Exception:
+            # drain workers so the queue doesn't wedge (the reference's only
+            # recovery logic, sage_sampler.py:361-368)
+            self.shutdown()
+            raise
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

@@ -75,6 +75,50 @@ class DenseSample(NamedTuple):
     adjs: Tuple[DenseAdj, ...]  # outermost hop first (reference reverses too)
 
 
+def sample_dense_fused(
+    indptr: jax.Array,
+    indices: jax.Array,
+    key: jax.Array,
+    seeds: jax.Array,
+    sizes: Tuple[int, ...],
+) -> DenseSample:
+    """Fused multi-hop sample with NO per-layer dedup/reindex — the
+    TPU-idiomatic hot path.
+
+    The reference dedups every hop with a GPU hash table because UVA/PCIe
+    bandwidth made repeated feature/topology reads expensive. On TPU the
+    dedup itself is the expensive part (sort-based `unique` costs two
+    O(W log W) sorts per hop on the MXU-starved sort unit), while the padded
+    frontier is exactly the same width with or without dedup
+    (W_{l+1} = W_l * (1+k)). Skipping dedup makes the local adjacency a
+    STATIC index pattern — ``cols[i, j] = W_l + i*k + j`` — so the whole
+    multihop pipeline is just degree lookups, Fisher-Yates draws and index
+    gathers: zero sorts, zero scatters.
+
+    Semantics: identical sampled-edge distribution; ``n_id`` may contain
+    duplicate nodes (each occurrence carries the same feature row, so model
+    outputs are bit-identical to the deduped pipeline up to float order).
+    Use :func:`sample_dense_pure` when the unique-n_id contract matters
+    (PyG-compat surface, cross-host dispatch).
+    """
+    B = seeds.shape[0]
+    cur = seeds
+    cur_valid = jnp.ones((B,), bool)
+    adjs: List[DenseAdj] = []
+    prev_count = jnp.asarray(B, jnp.int32)
+    for k in sizes:
+        key, sub = jax.random.split(key)
+        w = cur.shape[0]
+        nbrs, valid = _sample_layer_op(indptr, indices, cur, cur_valid, k, sub)
+        n_id = jnp.concatenate([cur, nbrs.reshape(-1)])
+        n_valid = jnp.concatenate([cur_valid, valid.reshape(-1)])
+        cols = (w + jnp.arange(w * k, dtype=jnp.int32)).reshape(w, k)
+        count = n_valid.sum().astype(jnp.int32)
+        adjs.append(DenseAdj(cols=cols, mask=valid, n_src=count, n_dst=prev_count))
+        cur, cur_valid, prev_count = n_id, n_valid, count
+    return DenseSample(n_id=cur, count=prev_count, batch_size=B, adjs=tuple(adjs[::-1]))
+
+
 def sample_dense_pure(
     indptr: jax.Array,
     indices: jax.Array,
@@ -127,6 +171,9 @@ class GraphSageSampler:
     caps : optional per-layer static n_id budget (TPU-only knob; bounds padded
         growth for deep fanouts)
     seed : RNG seed; sampling is deterministic given (seed, call index)
+    dedup : True (default) dedups every hop like the reference's hash-table
+        reindex; False uses the fused no-reindex hot path
+        (`sample_dense_fused`) — fastest on TPU, n_id may repeat nodes
     """
 
     MODE_ALIASES = {"GPU": "TPU", "UVA": "HOST", "ZERO_COPY": "HOST", "DMA": "TPU"}
@@ -139,6 +186,7 @@ class GraphSageSampler:
         mode: str = "TPU",
         caps: Optional[Sequence[Optional[int]]] = None,
         seed: int = 0,
+        dedup: bool = True,
     ):
         mode = self.MODE_ALIASES.get(mode, mode)
         if mode not in ("TPU", "HOST", "CPU"):
@@ -148,6 +196,7 @@ class GraphSageSampler:
         self.caps = None if caps is None else tuple(caps)
         self.mode = mode
         self.device = device
+        self.dedup = dedup
         self._seed = seed
         self._call = 0
         self._dev_arrays = None
@@ -186,6 +235,10 @@ class GraphSageSampler:
         if self.mode == "TPU":
             indptr, indices = self.lazy_init_quiver()
             seeds = jnp.asarray(np.asarray(seeds), indices.dtype)
+            if not self.dedup:
+                return sample_dense_fused(
+                    indptr, indices, self._next_key(), seeds, self.sizes
+                )
             return sample_dense_pure(
                 indptr, indices, self._next_key(), seeds, self.sizes, self.caps
             )
@@ -218,8 +271,19 @@ class GraphSageSampler:
     def sample(self, input_nodes):
         """Reference-compatible ``(n_id, batch_size, [Adj])``
         (sage_sampler.py:118-147). Ragged — forces a host sync; prefer
-        :meth:`sample_dense` inside TPU training loops."""
-        ds = self.sample_dense(input_nodes)
+        :meth:`sample_dense` inside TPU training loops.
+
+        Always uses the deduped pipeline: the ragged contract requires
+        unique, prefix-valid n_id, which the fused path does not provide.
+        """
+        if self.mode == "TPU" and not self.dedup:
+            indptr, indices = self.lazy_init_quiver()
+            seeds = jnp.asarray(np.asarray(input_nodes), indices.dtype)
+            ds = sample_dense_pure(
+                indptr, indices, self._next_key(), seeds, self.sizes, self.caps
+            )
+        else:
+            ds = self.sample_dense(input_nodes)
         return dense_to_pyg(ds)
 
     def sample_layer(self, seeds, size: int):
@@ -275,12 +339,18 @@ class GraphSageSampler:
 
     # -- multiprocess hand-off shims (reference sage_sampler.py:159-178) --
     def share_ipc(self):
-        return self.csr_topo, self.sizes, self.device, self.mode, self.caps, self._seed
+        return (
+            self.csr_topo, self.sizes, self.device, self.mode, self.caps,
+            self._seed, self.dedup,
+        )
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
-        csr_topo, sizes, device, mode, caps, seed = ipc_handle
-        return cls(csr_topo, sizes, device=device, mode=mode, caps=caps, seed=seed)
+        csr_topo, sizes, device, mode, caps, seed, dedup = ipc_handle
+        return cls(
+            csr_topo, sizes, device=device, mode=mode, caps=caps, seed=seed,
+            dedup=dedup,
+        )
 
 
 def dense_to_pyg(ds: DenseSample):

@@ -76,6 +76,22 @@ def _gather_local(table: jax.Array, ids: jax.Array) -> jax.Array:
     return jnp.take(table, ids, axis=0)
 
 
+@jax.jit
+def _scatter_rows(out: jax.Array, pos: jax.Array, rows: jax.Array) -> jax.Array:
+    # positions == out.shape[0] are padding; 'drop' discards them
+    return out.at[pos].set(rows, mode="drop")
+
+
+def _bucket(n: int, floor: int = 256) -> int:
+    """Pad id-batch lengths to power-of-two buckets so the jitted gather and
+    scatter programs are reused across calls (XLA recompiles per shape; an
+    eager per-batch shape would recompile every step)."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
 class ShardTensor:
     """Logical row-sharded tensor with gather across tiers.
 
@@ -168,24 +184,43 @@ class ShardTensor:
         kernel hid inside loads become explicit transfers here.
         """
         ids_np = np.asarray(ids).astype(np.int64).reshape(-1)
+        n = ids_np.shape[0]
         target = _device_of(self.current_device)
-        out = jnp.zeros((ids_np.shape[0], self._dim), jnp.float32, device=target)
+        out = jnp.zeros((n, self._dim), jnp.float32, device=target)
+
+        def pad_sel(sel: np.ndarray, local: np.ndarray, pad_id: int):
+            # pow2-bucketed padding; padded scatter positions point past the
+            # output (mode='drop'), padded gather ids clamp to a valid row
+            b = _bucket(sel.shape[0])
+            pos = np.full(b, n, np.int32)
+            pos[: sel.shape[0]] = sel
+            loc = np.full(b, pad_id, np.int64)
+            loc[: local.shape[0]] = local
+            return pos, loc
+
         for dev_rank, table, off in self.device_shards:
             sel = np.nonzero((ids_np >= off.start) & (ids_np < off.end))[0]
             if sel.size == 0:
                 continue
-            local_ids = jnp.asarray(ids_np[sel] - off.start)
-            local_ids = jax.device_put(local_ids, _device_of(dev_rank))
+            pos, loc = pad_sel(sel, ids_np[sel] - off.start, 0)
+            local_ids = jax.device_put(jnp.asarray(loc), _device_of(dev_rank))
             rows = _gather_local(table, local_ids)
             rows = jax.device_put(rows, target)  # rides ICI for peer chips
-            out = out.at[jnp.asarray(sel)].set(rows)
+            out = _scatter_rows(out, jnp.asarray(pos), rows)
         if self.cpu_tensor is not None:
             off = self.cpu_offset
             sel = np.nonzero((ids_np >= off.start) & (ids_np < off.end))[0]
             if sel.size:
-                rows_np = cpu_kernels.gather_rows(self.cpu_tensor, ids_np[sel] - off.start)
+                # host tier: native parallel gather, then ONE padded H2D copy
+                b = _bucket(sel.shape[0])
+                pos = np.full(b, n, np.int32)
+                pos[: sel.shape[0]] = sel
+                rows_np = np.zeros((b, self._dim), np.float32)
+                rows_np[: sel.size] = cpu_kernels.gather_rows(
+                    self.cpu_tensor, ids_np[sel] - off.start
+                )
                 rows = jax.device_put(jnp.asarray(rows_np), target)
-                out = out.at[jnp.asarray(sel)].set(rows)
+                out = _scatter_rows(out, jnp.asarray(pos), rows)
         return out
 
     # ------------------------------------------------------- ipc-compat shims

@@ -1,0 +1,121 @@
+"""Tracing, timing and metrics.
+
+Re-design of the reference's observability surface (SURVEY.md section 5):
+
+- RAII scope timer (include/quiver/timer.hpp:7-28) -> :class:`timer` /
+  :func:`trace_scope` context managers;
+- compile-time TRACE_SCOPE macros gated by QUIVER_ENABLE_TRACE
+  (include/quiver/trace.hpp:6-14, setup.py:45-46) -> runtime gating by the
+  same env var, durations aggregated in a process-local registry;
+- ad-hoc benchmark metrics (SEPS, benchmarks/sample/bench_sampler.py:14-16;
+  GB/s, benchmarks/feature/bench_feature.py:44-46) -> :func:`seps` /
+  :func:`gbps` helpers so every bench reports identically;
+- GPU profiler gap -> `jax.profiler` pass-throughs (:func:`start_profile`)
+  producing TensorBoard/XProf traces with real TPU timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional, Tuple
+
+TRACE_ENV = "QUIVER_ENABLE_TRACE"
+
+_registry: Dict[str, Tuple[int, float]] = defaultdict(lambda: (0, 0.0))
+
+
+def trace_enabled() -> bool:
+    return os.environ.get(TRACE_ENV, "0") not in ("0", "", "false", "False")
+
+
+class timer:
+    """Scope timer (reference quiver::timer, timer.hpp:7-28).
+
+    >>> with timer("sample") as t: ...
+    >>> t.elapsed  # seconds
+    """
+
+    def __init__(self, name: str = "", verbose: bool = False):
+        self.name = name
+        self.verbose = verbose
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+        if self.verbose:
+            print(f"[quiver-tpu] {self.name}: {self.elapsed*1e3:.3f} ms")
+
+
+@contextlib.contextmanager
+def trace_scope(name: str) -> Iterator[None]:
+    """TRACE_SCOPE analog (trace.hpp:6-14): no-op unless QUIVER_ENABLE_TRACE
+    is set; aggregates (count, total seconds) per scope name."""
+    if not trace_enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        cnt, tot = _registry[name]
+        _registry[name] = (cnt + 1, tot + dt)
+
+
+def trace_report(reset: bool = False) -> Dict[str, Tuple[int, float]]:
+    """Snapshot of aggregated scopes: {name: (count, total_seconds)}."""
+    out = dict(_registry)
+    if reset:
+        _registry.clear()
+    return out
+
+
+def print_trace_report() -> None:
+    for name, (cnt, tot) in sorted(trace_report().items()):
+        avg = tot / max(cnt, 1)
+        print(f"[trace] {name}: n={cnt} total={tot:.4f}s avg={avg*1e3:.3f}ms")
+
+
+# -- benchmark metric helpers -------------------------------------------------
+
+def seps(sampled_edges: int, seconds: float) -> float:
+    """Sampled edges per second (reference bench_sampler.py:14-16)."""
+    return sampled_edges / max(seconds, 1e-12)
+
+
+def gbps(num_rows: int, feature_dim: int, seconds: float, bytes_per_elem: int = 4) -> float:
+    """Feature-collection throughput in GB/s (reference bench_feature.py:44-46)."""
+    return num_rows * feature_dim * bytes_per_elem / max(seconds, 1e-12) / 1e9
+
+
+# -- jax profiler pass-throughs ----------------------------------------------
+
+def start_profile(logdir: str) -> None:
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profile() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def profile(logdir: Optional[str] = None) -> Iterator[None]:
+    if logdir is None:
+        yield
+        return
+    start_profile(logdir)
+    try:
+        yield
+    finally:
+        stop_profile()

@@ -132,6 +132,14 @@ class CSRTopo:
     def edge_count(self) -> int:
         return self.indices.shape[0]
 
+    def __getstate__(self):
+        # device arrays don't cross process boundaries; children re-bind
+        # lazily (the reference reshares topology via torch shm and re-runs
+        # lazy_init_quiver in the child, sage_sampler.py:98-113)
+        state = self.__dict__.copy()
+        state["_device_cache"] = None
+        return state
+
     def share_memory_(self):
         """No-op compat shim (reference utils.py:216-226).
 

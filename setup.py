@@ -1,0 +1,32 @@
+"""Packaging for quiver_tpu (reference setup.py builds the CUDA extension;
+here the native piece is the plain-C-ABI host engine, compiled by a custom
+build step with no pybind11/torch involvement)."""
+
+import os
+import subprocess
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        csrc = os.path.join(os.path.dirname(os.path.abspath(__file__)), "quiver_tpu", "csrc")
+        if os.path.exists(os.path.join(csrc, "Makefile")):
+            try:
+                subprocess.run(["make", "-C", csrc], check=True)
+            except Exception as e:  # native lib is optional (numpy fallback)
+                print(f"warning: native build failed ({e}); numpy fallbacks will be used")
+        super().run()
+
+
+setup(
+    name="quiver-tpu",
+    version="0.1.0",
+    description="TPU-native graph-learning data engine (torch-quiver capabilities on JAX/XLA/Pallas)",
+    packages=find_packages(include=["quiver_tpu", "quiver_tpu.*"]),
+    package_data={"quiver_tpu": ["csrc/*.so", "csrc/*.cpp", "csrc/Makefile"]},
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "optax", "numpy"],
+    cmdclass={"build_py": BuildWithNative},
+)

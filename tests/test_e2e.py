@@ -84,3 +84,45 @@ def test_train_community_classification(mode):
     pred = np.asarray(jnp.argmax(logits, -1))
     acc = (pred == labels[seeds]).mean()
     assert acc > 0.9, acc
+
+
+def test_gat_learns():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu.models import GAT
+
+    edge_index, feat_np, labels, n = make_community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    sampler = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=1)
+    feature = Feature(rank=0, device_list=[0], device_cache_size=n * 16 * 4)
+    feature.from_cpu_tensor(feat_np)
+    model = GAT(hidden_dim=16, out_dim=4, heads=2, num_layers=2, dropout=0.0)
+    tx = optax.adam(5e-3)
+    params = opt_state = None
+    labels_d = jnp.asarray(labels)
+
+    @jax.jit
+    def step(params, opt_state, x, adjs, y):
+        def loss_fn(p):
+            logits = model.apply(p, x, adjs)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        up, opt_state2 = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, up), opt_state2, loss
+
+    rng = np.random.default_rng(1)
+    losses = []
+    for _ in range(50):
+        seeds = rng.choice(n, 32, replace=False)
+        ds = sampler.sample_dense(seeds)
+        x = feature.lookup_padded(ds.n_id)
+        y = labels_d[jnp.asarray(np.asarray(ds.n_id)[:32])]
+        if params is None:
+            params = model.init(jax.random.key(0), x, ds.adjs)
+            opt_state = tx.init(params)
+        params, opt_state, loss = step(params, opt_state, x, ds.adjs, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

@@ -1,0 +1,52 @@
+"""Cross-process hand-off tests (reference tests/python/cuda/
+test_reductions.py:41-93: pass object through ForkingPickler to a child,
+child re-gathers and checks)."""
+
+import multiprocessing as mp
+
+import numpy as np
+
+import quiver_tpu.multiprocessing  # noqa: F401 — installs reducers
+from quiver_tpu import CSRTopo, Feature
+from quiver_tpu.pyg import GraphSageSampler
+from conftest import make_random_graph
+
+
+def _child_feature(handle_holder, q):
+    feat = handle_holder["feature"]
+    ids = np.array([0, 7, 63])
+    q.put(np.asarray(feat[ids]))
+
+
+def _child_sampler(holder, q):
+    sampler = holder["sampler"]
+    n_id, bs, adjs = sampler.sample(np.arange(8))
+    q.put((np.asarray(n_id), bs, len(adjs)))
+
+
+def test_feature_crosses_process():
+    rng = np.random.default_rng(0)
+    table = rng.standard_normal((64, 8)).astype(np.float32)
+    feat = Feature(rank=0, device_list=[0], device_cache_size=32 * 8 * 4)
+    feat.from_cpu_tensor(table)
+    ctx = mp.get_context("spawn")  # spawn forces a real pickle round-trip
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_feature, args=({"feature": feat}, q))
+    p.start()
+    out = q.get(timeout=120)
+    p.join(timeout=30)
+    np.testing.assert_allclose(out, table[[0, 7, 63]], rtol=1e-6)
+
+
+def test_sampler_crosses_process():
+    topo = CSRTopo(edge_index=make_random_graph(60, 600, seed=1))
+    sampler = GraphSageSampler(topo, sizes=[4], mode="CPU", seed=0)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_sampler, args=({"sampler": sampler}, q))
+    p.start()
+    n_id, bs, n_adjs = q.get(timeout=120)
+    p.join(timeout=30)
+    assert bs == 8
+    assert n_adjs == 1
+    np.testing.assert_array_equal(n_id[:8], np.arange(8))

@@ -1,0 +1,60 @@
+"""Aux subsystem tests: tracing/metrics + checkpoint/resume."""
+
+import os
+
+import numpy as np
+import pytest
+
+from quiver_tpu.trace import gbps, seps, timer, trace_report, trace_scope
+from quiver_tpu.checkpoint import (
+    CheckpointManager,
+    load_partition_artifacts,
+    save_partition_artifacts,
+)
+
+
+def test_timer_measures():
+    with timer("x") as t:
+        sum(range(10000))
+    assert t.elapsed > 0
+
+
+def test_trace_scope_gated(monkeypatch):
+    monkeypatch.delenv("QUIVER_ENABLE_TRACE", raising=False)
+    with trace_scope("off"):
+        pass
+    assert "off" not in trace_report()
+    monkeypatch.setenv("QUIVER_ENABLE_TRACE", "1")
+    with trace_scope("on"):
+        pass
+    with trace_scope("on"):
+        pass
+    cnt, tot = trace_report(reset=True)["on"]
+    assert cnt == 2 and tot >= 0
+
+
+def test_metric_helpers():
+    assert seps(1000, 0.5) == 2000
+    assert abs(gbps(1000, 250, 1.0) - 1e-3) < 1e-9
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    state = {"params": {"w": jnp.ones((3, 3))}, "step": np.int64(7)}
+    mgr.save(7, state)
+    mgr.save(9, {"params": {"w": jnp.full((3, 3), 2.0)}, "step": np.int64(9)})
+    assert mgr.latest_step() == 9
+    got = mgr.restore()
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]), 2.0)
+    got7 = mgr.restore(7)
+    np.testing.assert_allclose(np.asarray(got7["params"]["w"]), 1.0)
+    mgr.close()
+
+
+def test_partition_artifacts_roundtrip(tmp_path):
+    p = str(tmp_path / "arts.npz")
+    save_partition_artifacts(p, global2host=np.arange(10), order=np.arange(10)[::-1])
+    arts = load_partition_artifacts(p)
+    np.testing.assert_array_equal(arts["global2host"], np.arange(10))
