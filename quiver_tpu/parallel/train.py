@@ -43,6 +43,13 @@ def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh
 
     devs = jax.devices()
     n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"make_mesh: requested {n} devices but only {len(devs)} are "
+            f"visible ({devs}); for a virtual mesh set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n} and "
+            f'jax.config.update("jax_platforms", "cpu") before first jax use'
+        )
     devs = np.array(devs[:n])
     if dp is None:
         dp = 1
@@ -50,6 +57,10 @@ def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh
             dp *= 2
             n //= 2
         n = len(devs) // dp
+    if dp <= 0 or len(devs) % dp != 0:
+        raise ValueError(
+            f"make_mesh: dp={dp} does not divide device count {len(devs)}"
+        )
     ici = len(devs) // dp
     return Mesh(devs.reshape(dp, ici), ("dp", "ici"))
 

