@@ -107,8 +107,13 @@ def _np_sample_layer(
     B = seeds.shape[0]
     nbrs = np.zeros((B, k), np.int64)
     valid = np.zeros((B, k), bool)
-    starts = indptr[seeds]
-    degs = indptr[seeds + 1] - starts
+    # mirror the native guard (csrc/quiver_cpu.cpp): out-of-range seeds
+    # produce an invalid (deg=0) row instead of wrapping/raising
+    node_count = indptr.shape[0] - 1
+    in_range = (seeds >= 0) & (seeds < node_count)
+    safe = np.where(in_range, seeds, 0)
+    starts = indptr[safe]
+    degs = np.where(in_range, indptr[safe + 1] - starts, 0)
     for i in range(B):
         deg = int(degs[i])
         if deg <= 0:
@@ -131,27 +136,32 @@ def host_reindex(
     mask: np.ndarray,
 ) -> Tuple[np.ndarray, int, np.ndarray, np.ndarray]:
     """Host mirror of :func:`quiver_tpu.ops.reindex.local_reindex`: returns
-    (n_id_unpadded, count, local_nbrs [S,k], nbr_valid) with seeds-first,
-    first-occurrence order (reference reindex.cu.hpp min-index contract)."""
+    (n_id_unpadded, count, local_nbrs [S,k], nbr_valid). Valid seeds keep
+    slots 0..seed_count-1 VERBATIM (duplicates included, reference
+    reindex.cu.hpp min-index contract: lookups resolve to the first slot
+    holding a value); unique new neighbors follow in ascending-id order —
+    the same contract as the device op, so outputs are bit-identical."""
     S, k = nbrs.shape
-    seed_valid = np.arange(S) < seed_count
-    all_nodes = np.concatenate([
-        np.where(seed_valid, seeds, SENTINEL),
-        np.where(mask, nbrs, SENTINEL).reshape(-1),
-    ])
-    all_valid = np.concatenate([seed_valid, mask.reshape(-1)])
-    total = all_nodes.shape[0]
-    uniq, inv = np.unique(all_nodes, return_inverse=True)
-    first = np.full(uniq.shape[0], total, np.int64)
-    np.minimum.at(first, inv, np.where(all_valid, np.arange(total), total))
-    order = np.argsort(first, kind="stable")
-    rank = np.empty_like(order)
-    rank[order] = np.arange(order.shape[0])
-    local_all = rank[inv]
-    n_id = uniq[order]
-    count = int((first < total).sum())
-    local_nbrs = local_all[S:].reshape(S, k).astype(np.int32)
-    return n_id[:count], count, local_nbrs, mask
+    seeds = np.asarray(seeds, np.int64)
+    head = seeds[:seed_count]
+    nbr_vals = nbrs[mask]
+    new = np.setdiff1d(nbr_vals, head)  # sorted unique, seed values excluded
+    count = seed_count + new.shape[0]
+    n_id = np.concatenate([head, new])
+
+    # canonical id: first seed slot holding the value, else the rank slot
+    local_new = seed_count + np.clip(
+        np.searchsorted(new, nbrs), 0, max(new.shape[0] - 1, 0)
+    )
+    if seed_count > 0:
+        uq_s, first_slot = np.unique(head, return_index=True)
+        pc = np.clip(np.searchsorted(uq_s, nbrs), 0, uq_s.shape[0] - 1)
+        in_seeds = uq_s[pc] == nbrs
+        local = np.where(in_seeds, first_slot[pc], local_new)
+    else:
+        local = local_new
+    local_nbrs = np.where(mask, local, 0).astype(np.int32)
+    return n_id, count, local_nbrs, mask
 
 
 class HostSampler:

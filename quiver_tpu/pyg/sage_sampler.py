@@ -110,9 +110,15 @@ def sample_dense_fused(
         key, sub = jax.random.split(key)
         w = cur.shape[0]
         nbrs, valid = _sample_layer_op(indptr, indices, cur, cur_valid, k, sub)
-        n_id = jnp.concatenate([cur, nbrs.reshape(-1)])
-        n_valid = jnp.concatenate([cur_valid, valid.reshape(-1)])
-        cols = (w + jnp.arange(w * k, dtype=jnp.int32)).reshape(w, k)
+        # transposed flatten: a [big, tiny] row-major flatten costs ~40 s of
+        # TPU compile (lane-tile relayout); [k, w] -> flat is free. Neighbor
+        # (i, j) lands at n_id position w + j*w + i, hence the cols iota.
+        n_id = jnp.concatenate([cur, nbrs.T.reshape(-1)])
+        n_valid = jnp.concatenate([cur_valid, valid.T.reshape(-1)])
+        cols = (
+            w * (1 + jnp.arange(k, dtype=jnp.int32))[None, :]
+            + jnp.arange(w, dtype=jnp.int32)[:, None]
+        )
         count = n_valid.sum().astype(jnp.int32)
         adjs.append(DenseAdj(cols=cols, mask=valid, n_src=count, n_dst=prev_count))
         cur, cur_valid, prev_count = n_id, n_valid, count

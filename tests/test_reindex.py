@@ -8,19 +8,22 @@ from quiver_tpu.ops.reindex import local_reindex
 from quiver_tpu.ops.cpu_kernels import host_reindex
 
 
-def test_seeds_first_and_first_occurrence_order():
+def test_seeds_first_then_ascending_unique_tail():
     seeds = jnp.array([7, 3, 9])
-    nbrs = jnp.array([[3, 100], [7, 200], [100, 300]])
+    # 300 appears before 100 in input order; the tail is ascending-id, not
+    # first-occurrence (documented contract change vs the reference's hash
+    # insert order — no consumer depends on tail order, see reindex.py)
+    nbrs = jnp.array([[3, 300], [7, 200], [300, 100]])
     valid = jnp.ones((3, 2), bool)
     res = local_reindex(seeds, jnp.ones(3, bool), nbrs, valid)
     n_id = np.asarray(res.n_id)
     count = int(res.count)
     assert count == 6
-    # seeds keep slots 0..2 in order; rest in first-occurrence order
+    # seeds keep slots 0..2 in order; rest unique, ascending
     assert n_id[:6].tolist() == [7, 3, 9, 100, 200, 300]
     # local ids rewrite to those slots
     np.testing.assert_array_equal(np.asarray(res.local_seeds), [0, 1, 2])
-    np.testing.assert_array_equal(np.asarray(res.local_nbrs), [[1, 3], [0, 4], [3, 5]])
+    np.testing.assert_array_equal(np.asarray(res.local_nbrs), [[1, 5], [0, 4], [5, 3]])
 
 
 def test_invalid_masked_out():
@@ -47,6 +50,40 @@ def test_roundtrip_identity():
     # n_id[local] == original neighbor ids (the permutation round-trip oracle)
     np.testing.assert_array_equal(n_id[local], nbrs)
     np.testing.assert_array_equal(n_id[np.asarray(res.local_seeds)], seeds)
+
+
+def test_duplicate_seeds_keep_slots_verbatim():
+    # ADVICE round 1 (medium): duplicate seeds were collapsed, corrupting the
+    # row<->n_id[i] pairing. Reference contract: seeds verbatim in slots
+    # 0..S-1; lookups resolve to the FIRST slot holding the value.
+    seeds = jnp.array([5, 5, 7, 9])
+    nbrs = jnp.array([[5, 43], [7, 5], [9, 43], [5, 99]])
+    res = local_reindex(seeds, jnp.ones(4, bool), nbrs, jnp.ones((4, 2), bool))
+    n_id = np.asarray(res.n_id)
+    assert n_id[:4].tolist() == [5, 5, 7, 9]
+    assert int(res.count) == 6
+    assert n_id[4:6].tolist() == [43, 99]
+    # canonical ids: 5 -> slot 0 (first), 7 -> 2, 9 -> 3, 43 -> 4, 99 -> 5
+    np.testing.assert_array_equal(
+        np.asarray(res.local_nbrs), [[0, 4], [2, 0], [3, 4], [0, 5]]
+    )
+    np.testing.assert_array_equal(np.asarray(res.local_seeds), [0, 1, 2, 3])
+    # round trip still holds: every local id points at a slot with the value
+    np.testing.assert_array_equal(n_id[np.asarray(res.local_nbrs)], np.asarray(nbrs))
+
+
+def test_duplicate_seeds_host_matches_device():
+    rng = np.random.default_rng(7)
+    seeds = rng.integers(0, 50, 16).astype(np.int64)  # duplicates likely
+    nbrs = rng.integers(0, 200, (16, 5)).astype(np.int64)
+    mask = rng.random((16, 5)) < 0.7
+    d = local_reindex(
+        jnp.asarray(seeds), jnp.ones(16, bool), jnp.asarray(nbrs), jnp.asarray(mask)
+    )
+    n_id_h, count_h, local_h, _ = host_reindex(seeds, 16, nbrs, mask)
+    assert count_h == int(d.count)
+    np.testing.assert_array_equal(n_id_h, np.asarray(d.n_id)[:count_h])
+    np.testing.assert_array_equal(local_h[mask], np.asarray(d.local_nbrs)[mask])
 
 
 def test_host_reindex_matches_device():
