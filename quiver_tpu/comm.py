@@ -219,11 +219,29 @@ class TpuComm:
             req[self.host, j, : ids.shape[0]] = ids
         tables = self._tables_for_exchange(feature, h)
         out = exchange_all(self.mesh, self.axis, req, tables)
+        mine = self._my_rows(out)  # [H, L, D]: answers addressed to this host
         res: List[Optional[jax.Array]] = []
         for j, ids in enumerate(host2ids):
             n = len(ids)
-            res.append(out[self.host, j, :n] if n else None)
+            res.append(mine[j, :n] if n else None)
         return res
+
+    def _my_rows(self, out: jax.Array):
+        """This host's slice of the [H, H, L, D] exchange result. On a real
+        multi-process pod only this process's shard is addressable, so the
+        slice must come from addressable_shards, not global indexing."""
+        if jax.process_count() == 1:
+            return out[self.host]
+        for s in out.addressable_shards:
+            idx = s.index[0]
+            start = 0 if idx.start is None else idx.start
+            stop = out.shape[0] if idx.stop is None else idx.stop
+            if start <= self.host < stop:
+                return np.asarray(s.data)[self.host - start]
+        raise RuntimeError(
+            f"host {self.host}'s exchange shard is not addressable from "
+            f"process {jax.process_index()}; check mesh/process mapping"
+        )
 
     def _tables_for_exchange(self, feature, h: int):
         """Assemble (and cache) the device-resident [H, R, D] table stack —

@@ -177,11 +177,20 @@ class Feature:
         feature.py:296-333). Out-of-range ids (e.g. the sampler's
         sentinel padding) yield zero rows."""
         ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
-        invalid = (ids < 0) | (ids >= self._n)
-        if invalid.any():
-            ids = np.where(invalid, 0, ids)
-        if self.feature_order is not None:
-            ids = self.feature_order[ids]
+        if self._local_order_applied:
+            # distributed path: ids are GLOBAL but self._n is the LOCAL row
+            # count, so validity must come from the remap itself —
+            # feature_order[gid] < 0 means this host does not own gid
+            oob = (ids < 0) | (ids >= self.feature_order.shape[0])
+            mapped = self.feature_order[np.where(oob, 0, ids)]
+            invalid = oob | (mapped < 0)
+            ids = np.where(invalid, 0, mapped)
+        else:
+            invalid = (ids < 0) | (ids >= self._n)
+            if invalid.any():
+                ids = np.where(invalid, 0, ids)
+            if self.feature_order is not None:
+                ids = self.feature_order[ids]
         rows = self.shard_tensor[ids]
         if invalid.any():
             rows = rows * jnp.asarray(~invalid, rows.dtype)[:, None]

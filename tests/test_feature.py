@@ -107,6 +107,25 @@ def test_feature_ipc_shim_roundtrip(table):
     np.testing.assert_allclose(np.asarray(feat2[ids]), table[ids])
 
 
+def test_feature_set_local_order_global_ids(table):
+    # distributed path: this host owns global ids 10..19 only; lookups use
+    # GLOBAL ids, so validity must come from the remap, not the local row
+    # count (advisor finding: owned ids >= n_local were silently zeroed)
+    local_rows = table[:10]
+    owned_global = np.arange(10, 20, dtype=np.int64)
+    feat = Feature(rank=0, device_list=[0], device_cache_size=10 * 16 * 4)
+    feat.from_cpu_tensor(local_rows)
+    feat.set_local_order(owned_global)
+    np.testing.assert_allclose(
+        np.asarray(feat[np.array([10, 15, 19])]), local_rows[[0, 5, 9]]
+    )
+    # unowned / out-of-range global ids yield zero rows, owned rows intact
+    got = np.asarray(feat[np.array([3, 12, 10_000])])
+    np.testing.assert_allclose(got[0], np.zeros(16))
+    np.testing.assert_allclose(got[1], local_rows[2])
+    np.testing.assert_allclose(got[2], np.zeros(16))
+
+
 def test_feature_from_mmap(tmp_path, table):
     path = tmp_path / "feat.npy"
     np.save(path, table)
