@@ -26,6 +26,13 @@ from .partition import (
 )
 from . import comm, pyg, trace
 from .comm import HostRankTable, NcclComm, TpuComm, getNcclId
+from .pipeline import (
+    TieredBatch,
+    TieredFeaturePipeline,
+    TrainPipeline,
+    make_tiered_train_step,
+    tiered_lookup,
+)
 
 __version__ = "0.1.0"
 
@@ -56,4 +63,9 @@ __all__ = [
     "quiver_partition_feature",
     "reindex_by_config",
     "reindex_feature",
+    "TieredBatch",
+    "TieredFeaturePipeline",
+    "TrainPipeline",
+    "make_tiered_train_step",
+    "tiered_lookup",
 ]

@@ -1,0 +1,237 @@
+"""Double-buffered sample -> tiered gather -> train pipeline.
+
+The reference hides its host<->device latency in two ways the TPU cannot
+copy: UVA kernels read pinned host memory directly (quiver.cu.hpp:16-26) and
+CUDA streams overlap transfers with compute (stream_pool.hpp). The TPU-native
+replacement (SURVEY.md section 7.3 item 5) is an explicit software pipeline:
+
+- the jitted train step fuses the HOT gather (HBM-resident feature prefix)
+  with the model fwd/bwd — one XLA program, nothing leaves the chip;
+- COLD rows (the host-DRAM tail) are gathered by the native C++ engine
+  (`qt_gather_rows`, csrc/quiver_cpu.cpp) and shipped with ONE async H2D
+  copy per batch;
+- a one-worker prefetch thread prepares batch i+1 (device sampling dispatch,
+  n_id fetch, host cold gather, H2D enqueue) while the device executes batch
+  i's train step — the double buffering that replaces CUDA streams.
+
+The merge is in-jit: ``x = hot_gather(mapped) * is_hot`` then scatter the
+prefetched cold rows into their slots (`mode="drop"` makes the padding
+self-discarding). Cold batch length is bucketed to powers of two so the step
+program is reused across batches (bounded recompiles).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import dataclass
+from typing import Iterator, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .comm import round_up_pow2
+from .feature import Feature
+from .pyg.sage_sampler import DenseSample, GraphSageSampler
+from .trace import trace_scope
+
+
+class TieredBatch(NamedTuple):
+    """Device-ready inputs for one pipelined step."""
+
+    ds: DenseSample        # padded sample (adjs consumed by the model)
+    mapped: jax.Array      # [W] int32 row ids in reordered (cache) space; -1 invalid
+    cold_rows: jax.Array   # [C_b, D] prefetched host-tier rows (padded bucket)
+    cold_pos: jax.Array    # [C_b] int32 slot in [0, W) for each cold row; W pads
+    seeds: jax.Array       # [B] the batch's seed node ids (for labels)
+
+
+def tiered_lookup(
+    hot_table: jax.Array,
+    mapped: jax.Array,
+    cold_rows: jax.Array,
+    cold_pos: jax.Array,
+) -> jax.Array:
+    """Jit-safe tiered feature assembly: HBM gather for hot rows + scatter of
+    prefetched cold rows. The in-jit half of the reference's multi-pointer
+    gather kernel (shard_tensor.cu.hpp:16-58) — the host-pointer branch
+    arrives as ``cold_rows`` instead of being read through UVA."""
+    hot_n = hot_table.shape[0]
+    is_hot = (mapped >= 0) & (mapped < hot_n)
+    x = jnp.take(hot_table, jnp.clip(mapped, 0, hot_n - 1), axis=0)
+    x = x * is_hot[:, None].astype(x.dtype)
+    if cold_rows.shape[0]:
+        x = x.at[cold_pos].set(cold_rows, mode="drop")
+    return x
+
+
+class TieredFeaturePipeline:
+    """Prepares :class:`TieredBatch` inputs for a tiered :class:`Feature`.
+
+    Host-side per batch: remap ids through ``feature_order``, split hot/cold
+    by the cache boundary, native-gather the cold rows, enqueue ONE async H2D
+    copy. All device work this object dispatches is async; the caller's train
+    step consumes the arrays without further host syncs.
+    """
+
+    def __init__(self, feature: Feature, device=None):
+        st = feature.shard_tensor
+        if st is None:
+            raise ValueError("feature not built; call from_cpu_tensor first")
+        if len(st.device_shards) > 1:
+            raise ValueError(
+                "tiered pipeline expects one hot shard + optional host tail; "
+                "use the mesh-sharded gather for clique-striped features"
+            )
+        self.feature = feature
+        self.device = device or jax.local_devices()[0]
+        if st.device_shards:
+            _, self.hot_table, off = st.device_shards[0]
+            self.hot_rows = off.end - off.start
+        else:
+            self.hot_table = jnp.zeros((0, feature.dim), jnp.float32, device=self.device)
+            self.hot_rows = 0
+        self.cold_np = st.cpu_tensor  # may be None (fully resident)
+        self._order = feature.feature_order  # old id -> stored row (or None)
+        from .ops import cpu_kernels
+
+        self._gather = cpu_kernels.gather_rows
+        # true tier traffic (padding excluded), accumulated across prepare()
+        self.cold_rows_seen = 0
+        self.rows_seen = 0
+
+    def prepare(self, n_id: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(mapped, cold_rows, cold_pos) for a padded n_id array. Fetches
+        n_id to host (small: W ids), gathers cold rows natively, enqueues the
+        H2D copy; returns immediately usable device arrays."""
+        with trace_scope("pipeline.prepare"):
+            ids = np.asarray(n_id).astype(np.int64).reshape(-1)
+            W = ids.shape[0]
+            n_total = self.feature.shape[0]
+            invalid = (ids < 0) | (ids >= n_total)
+            safe = np.where(invalid, 0, ids)
+            mapped = self._order[safe] if self._order is not None else safe
+            mapped = np.where(invalid, -1, mapped).astype(np.int32)
+            mapped_dev = jax.device_put(mapped, self.device)
+            self.rows_seen += W
+            if self.cold_np is None:
+                cold_rows = jnp.zeros((0, self.feature.dim), jnp.float32, device=self.device)
+                cold_pos = jnp.zeros((0,), jnp.int32, device=self.device)
+                return mapped_dev, cold_rows, cold_pos
+            (cold_sel,) = np.nonzero(mapped >= self.hot_rows)
+            self.cold_rows_seen += int(cold_sel.shape[0])
+            b = round_up_pow2(max(cold_sel.shape[0], 1), floor=256)
+            pos = np.full(b, W, np.int32)  # W == out-of-range -> dropped
+            pos[: cold_sel.shape[0]] = cold_sel
+            rows = np.zeros((b, self.feature.dim), np.float32)
+            if cold_sel.size:
+                with trace_scope("pipeline.cold_gather"):
+                    rows[: cold_sel.size] = self._gather(
+                        self.cold_np, mapped[cold_sel] - self.hot_rows
+                    )
+            with trace_scope("pipeline.h2d"):
+                cold_rows = jax.device_put(rows, self.device)
+                cold_pos = jax.device_put(pos, self.device)
+            return mapped_dev, cold_rows, cold_pos
+
+
+@dataclass
+class PipelineStats:
+    batches: int = 0
+    cold_rows: int = 0
+    hot_rows: int = 0
+
+
+class TrainPipeline:
+    """sample -> tiered gather -> step, double-buffered.
+
+    ``step_fn(params, opt_state, key, batch: TieredBatch) -> (params,
+    opt_state, loss)`` must be jitted by the caller (see
+    :func:`make_tiered_train_step`). One worker thread runs batch i+1's
+    sampling + cold prefetch while the main thread dispatches batch i's step;
+    JAX's async dispatch overlaps the H2D copy with device compute.
+    """
+
+    def __init__(
+        self,
+        sampler: GraphSageSampler,
+        feature: Feature,
+        step_fn,
+        depth: int = 2,
+    ):
+        self.sampler = sampler
+        self.tiered = TieredFeaturePipeline(feature)
+        self.step_fn = step_fn
+        self.depth = max(depth, 1)
+        self.stats = PipelineStats()
+
+    def _stage(self, seeds: np.ndarray) -> TieredBatch:
+        ds = self.sampler.sample_dense(seeds)
+        before = self.tiered.cold_rows_seen
+        mapped, cold_rows, cold_pos = self.tiered.prepare(ds.n_id)
+        cold = self.tiered.cold_rows_seen - before
+        self.stats.batches += 1
+        self.stats.cold_rows += cold
+        self.stats.hot_rows += int(mapped.shape[0]) - cold
+        return TieredBatch(
+            ds=ds,
+            mapped=mapped,
+            cold_rows=cold_rows,
+            cold_pos=cold_pos,
+            seeds=jnp.asarray(np.asarray(seeds), jnp.int32),
+        )
+
+    def run_epoch(
+        self,
+        seed_batches: Sequence[np.ndarray],
+        params,
+        opt_state,
+        key: jax.Array,
+    ):
+        """Run one epoch; returns (params, opt_state, losses list)."""
+        losses = []
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(self._stage, seed_batches[0])
+            for i in range(len(seed_batches)):
+                batch = fut.result()
+                if i + 1 < len(seed_batches):
+                    fut = pool.submit(self._stage, seed_batches[i + 1])
+                key, sub = jax.random.split(key)
+                params, opt_state, loss = self.step_fn(params, opt_state, sub, batch)
+                losses.append(loss)
+        return params, opt_state, [float(l) for l in losses]
+
+
+def make_tiered_train_step(model, tx, labels: jax.Array, hot_table: jax.Array):
+    """Jitted ``step(params, opt_state, key, batch)`` fusing the hot gather
+    into fwd/bwd. ``labels``/``hot_table`` enter the jitted program as
+    ARGUMENTS (closure capture would embed a million-row table as an XLA
+    constant — minutes of compile, see bench.py)."""
+    import optax
+
+    hot_table = jnp.asarray(hot_table)
+    labels = jnp.asarray(labels)
+
+    @jax.jit
+    def step(params, opt_state, key, hot, lab, batch: TieredBatch):
+        x = tiered_lookup(hot, batch.mapped, batch.cold_rows, batch.cold_pos)
+        y = jnp.take(lab, jnp.clip(batch.seeds, 0, lab.shape[0] - 1))
+
+        def objective(p):
+            logits = model.apply(
+                p, x, batch.ds.adjs, train=True, rngs={"dropout": key}
+            )
+            ll = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(ll, y[:, None].astype(jnp.int32), axis=1)[:, 0]
+            return nll.mean()
+
+        loss, grads = jax.value_and_grad(objective)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def bound(params, opt_state, key, batch: TieredBatch):
+        return step(params, opt_state, key, hot_table, labels, batch)
+
+    return bound

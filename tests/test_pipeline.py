@@ -1,0 +1,100 @@
+"""Tiered prefetch pipeline: correctness of the jitted hot+cold merge and
+the double-buffered train loop (VERDICT r1 item 4 / SURVEY 7.3 item 5)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from quiver_tpu import CSRTopo, Feature
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.pipeline import (
+    TieredFeaturePipeline,
+    TrainPipeline,
+    make_tiered_train_step,
+    tiered_lookup,
+)
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+
+
+def community_graph(n_comm=4, per_comm=40, intra=6, seed=0):
+    rng = np.random.default_rng(seed)
+    n = n_comm * per_comm
+    src, dst = [], []
+    for u in range(n):
+        cu = u // per_comm
+        for v in rng.choice(per_comm, intra, replace=False) + cu * per_comm:
+            src.append(u)
+            dst.append(int(v))
+    feat = rng.standard_normal((n, 16)).astype(np.float32)
+    labels = (np.arange(n) // per_comm).astype(np.int32)
+    return np.stack([np.array(src), np.array(dst)]), feat, labels, n
+
+
+def test_tiered_lookup_matches_dense():
+    rng = np.random.default_rng(0)
+    table = rng.standard_normal((100, 8)).astype(np.float32)
+    hot = jnp.asarray(table[:60])
+    ids = np.array([3, 77, 59, 60, 99, -5, 200, 0], np.int64)
+    W = ids.shape[0]
+    mapped = np.where((ids < 0) | (ids >= 100), -1, ids).astype(np.int32)
+    cold_sel = np.nonzero(mapped >= 60)[0]
+    pos = np.full(4, W, np.int32)
+    pos[: cold_sel.size] = cold_sel
+    rows = np.zeros((4, 8), np.float32)
+    rows[: cold_sel.size] = table[mapped[cold_sel]]
+    out = np.asarray(
+        tiered_lookup(hot, jnp.asarray(mapped), jnp.asarray(rows), jnp.asarray(pos))
+    )
+    expect = np.zeros((W, 8), np.float32)
+    ok = (ids >= 0) & (ids < 100)
+    expect[ok] = table[ids[ok]]
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_prepare_matches_eager_feature():
+    edge_index, feat, _, n = community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    f = Feature(rank=0, device_list=[0], device_cache_size=feat.shape[0] // 2 * 16 * 4,
+                cache_policy="device_replicate", csr_topo=topo)
+    f.from_cpu_tensor(feat)
+    pipe = TieredFeaturePipeline(f)
+    assert pipe.cold_np is not None  # half the table is host-tier
+    ids = np.array([0, 5, n - 1, n // 2, 3, 3, n + 7, -1], np.int64)
+    mapped, cold_rows, cold_pos = pipe.prepare(jnp.asarray(ids))
+    out = np.asarray(tiered_lookup(pipe.hot_table, mapped, cold_rows, cold_pos))
+    expect = np.asarray(f[ids])
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_train_pipeline_learns_and_prefetches():
+    edge_index, feat, labels, n = community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    cache_bytes = (n // 2) * feat.shape[1] * 4  # 50% hot -> real cold traffic
+    f = Feature(rank=0, device_list=[0], device_cache_size=cache_bytes,
+                cache_policy="device_replicate", csr_topo=topo)
+    f.from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=1)
+
+    model = GraphSAGE(hidden_dim=32, out_dim=4, num_layers=2, dropout=0.0)
+    tx = optax.adam(5e-3)
+    pipe = TieredFeaturePipeline(f)
+    step_fn = make_tiered_train_step(model, tx, jnp.asarray(labels), pipe.hot_table)
+
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, n, 32).astype(np.int64) for _ in range(12)]
+    ds0 = sampler.sample_dense(batches[0])
+    x0 = jnp.zeros((ds0.n_id.shape[0], feat.shape[1]), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    opt_state = tx.init(params)
+
+    tp = TrainPipeline(sampler, f, step_fn)
+    params, opt_state, losses = tp.run_epoch(batches, params, opt_state, jax.random.key(1))
+    assert len(losses) == len(batches)
+    assert all(np.isfinite(losses))
+    # cold tier actually exercised through the pipeline
+    assert tp.stats.cold_rows > 0
+    # the community task is easy: loss should drop across the epoch
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
