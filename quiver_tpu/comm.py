@@ -197,34 +197,91 @@ class TpuComm:
             mesh = Mesh(devs, (axis,))
         self.mesh = mesh
         self.axis = axis
+        # multi-process exchanges need a request budget every process agrees
+        # on WITHOUT communicating (the pow2 bucket of the local max can
+        # disagree across hosts); set this to a static per-peer request cap
+        self.static_budget: Optional[int] = None
 
     @property
     def host(self) -> int:
         return self.table.rank2host(self.rank)
 
-    def exchange(self, host2ids: Sequence[np.ndarray], feature) -> List[Optional[jax.Array]]:
-        """Fetch rows for per-host id lists (GLOBAL ids; localized through
-        ``feature``'s partition metadata by the caller — DistFeature passes
-        owner-local ids directly).
+    def exchange(
+        self,
+        host2ids: Sequence[np.ndarray],
+        budget: Optional[int] = None,
+    ) -> List[Optional[jax.Array]]:
+        """Fetch rows for per-host id lists (owner-LOCAL row ids; DistFeature
+        localizes global ids through its partition metadata before calling).
+        Tables come from :meth:`register_local_table`, not from a Feature.
 
-        Single-process path: gathers through the per-host tables registered
-        with :meth:`register_local_table`; multi-host path: the collective
-        :func:`exchange_all` over this comm's mesh.
+        Collective: every host process must call together (reference
+        NcclComm.exchange contract, comm.py:127-182). Single-controller mode
+        (one process driving all mesh devices, e.g. the hermetic tests)
+        builds the global request/table arrays directly; multi-process mode
+        (`jax.distributed`) assembles them from per-process shards via
+        ``jax.make_array_from_process_local_data`` — no process ever holds
+        the global table.
         """
-        budget = round_up_pow2(max((len(i) for i in host2ids), default=1))
+        if budget is None:
+            budget = self.static_budget
+            if budget is None:
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "multi-process exchange needs a budget every process "
+                        "agrees on: set comm.static_budget (or pass budget=) "
+                        "— a locally-computed bucket can differ across hosts "
+                        "and desync the collective"
+                    )
+                budget = round_up_pow2(max((len(i) for i in host2ids), default=1))
         h = self.table.hosts
-        req = np.full((h, h, budget), ID_PAD, np.int64)
+        req_mine = np.full((1, h, budget), ID_PAD, np.int64)
         for j, ids in enumerate(host2ids):
             ids = np.asarray(ids, np.int64)
-            req[self.host, j, : ids.shape[0]] = ids
-        tables = self._tables_for_exchange(feature, h)
-        out = exchange_all(self.mesh, self.axis, req, tables)
+            if ids.shape[0] > budget:
+                raise ValueError(
+                    f"request to host {j} ({ids.shape[0]} ids) exceeds the "
+                    f"exchange budget {budget}; raise static_budget"
+                )
+            req_mine[0, j, : ids.shape[0]] = ids
+        if jax.process_count() > 1:
+            out = self._exchange_multiprocess(req_mine, h)
+        else:
+            req = np.full((h, h, budget), ID_PAD, np.int64)
+            req[self.host] = req_mine[0]
+            tables = self._tables_for_exchange(h)
+            out = exchange_all(self.mesh, self.axis, req, tables)
         mine = self._my_rows(out)  # [H, L, D]: answers addressed to this host
         res: List[Optional[jax.Array]] = []
         for j, ids in enumerate(host2ids):
             n = len(ids)
             res.append(mine[j, :n] if n else None)
         return res
+
+    def _exchange_multiprocess(self, req_mine: np.ndarray, h: int) -> jax.Array:
+        """Assemble the [H, H, L] request and [H, R, D] table arrays from
+        per-process shards (this process contributes row ``self.host`` of
+        each) and run the collective. Table row counts must be uniform
+        across hosts (pad the smaller blocks before registering)."""
+        blocks = getattr(self, "_local_tables", None)
+        if blocks is None or self.host not in blocks:
+            raise RuntimeError(
+                "register_local_table(self.host, rows) must be called before "
+                "a multi-process exchange"
+            )
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        req = jax.make_array_from_process_local_data(
+            sharding, np.asarray(req_mine, np.int32)
+        )
+        # the table is invariant across exchanges: shard it onto the mesh
+        # ONCE (mirrors the single-controller _tables_for_exchange cache;
+        # invalidated by register_local_table)
+        if getattr(self, "_table_stack_dev", None) is None:
+            mine = blocks[self.host]
+            self._table_stack_dev = jax.make_array_from_process_local_data(
+                sharding, np.asarray(mine, np.float32)[None]
+            )
+        return _exchange_jit(req, self._table_stack_dev, mesh=self.mesh, axis=self.axis)
 
     def _my_rows(self, out: jax.Array):
         """This host's slice of the [H, H, L, D] exchange result. On a real
@@ -243,13 +300,11 @@ class TpuComm:
             f"process {jax.process_index()}; check mesh/process mapping"
         )
 
-    def _tables_for_exchange(self, feature, h: int):
+    def _tables_for_exchange(self, h: int):
         """Assemble (and cache) the device-resident [H, R, D] table stack —
         it is invariant across exchanges, so it is built and placed on the
-        mesh ONCE (invalidated by register_local_table). In single-controller
-        mode the caller registered every host's block; in true multi-host
-        mode each process supplies only its own (others are zero placeholders
-        the runtime never reads locally)."""
+        mesh ONCE (invalidated by register_local_table). Single-controller
+        mode only: the caller registered every host's block."""
         if getattr(self, "_table_stack_dev", None) is not None:
             return self._table_stack_dev
         blocks = getattr(self, "_local_tables", None)

@@ -89,6 +89,8 @@ class Feature:
         self._dim: Optional[int] = None
         self._n: int = 0
         self._local_order_applied = False
+        self.mmap_handle_ = None  # disk tier (reference feature.py:84-93)
+        self.disk_map: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------ build
     def from_cpu_tensor(self, cpu_tensor) -> None:
@@ -170,12 +172,38 @@ class Feature:
         self.shard_tensor = st
         return self
 
+    def set_mmap_file(self, path: str, disk_map) -> None:
+        """Attach a disk tier (reference feature.py:84-88): ``path`` is an
+        ``np.save``'d [N_total, D] array opened with ``mmap_mode='r'``;
+        ``disk_map[global_id]`` is the in-memory row for cached ids and
+        ``< 0`` for ids resident only on disk."""
+        self.mmap_handle_ = np.load(path, mmap_mode="r")
+        self.disk_map = np.asarray(disk_map).astype(np.int64).reshape(-1)
+        if self._dim is None:
+            self._dim = int(self.mmap_handle_.shape[1])
+
+    def read_mmap(self, ids) -> jax.Array:
+        """Read rows from the disk tier by GLOBAL node id (reference
+        feature.py:89-93); one page-cache-friendly host read + one H2D.
+        Out-of-range ids (sampler sentinel padding) yield zero rows, same
+        as every other lookup path (numpy would silently wrap negatives)."""
+        ids = np.asarray(ids).astype(np.int64).reshape(-1)
+        oob = (ids < 0) | (ids >= self.mmap_handle_.shape[0])
+        rows = np.asarray(self.mmap_handle_[np.where(oob, 0, ids)], dtype=np.float32)
+        if oob.any():
+            rows[oob] = 0.0
+        return jnp.asarray(rows)
+
     # ----------------------------------------------------------------- lookup
     def __getitem__(self, node_idx) -> jax.Array:
         """Gather features for (original) node ids; remaps through
         feature_order then hits the tiered ShardTensor (reference
         feature.py:296-333). Out-of-range ids (e.g. the sampler's
-        sentinel padding) yield zero rows."""
+        sentinel padding) yield zero rows. With a disk tier attached
+        (:meth:`set_mmap_file`), ids whose ``disk_map`` entry is negative
+        are read from the mmap and merged (reference feature.py:309-333)."""
+        if self.mmap_handle_ is not None:
+            return self._getitem_with_disk(node_idx)
         ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
         if self._local_order_applied:
             # distributed path: ids are GLOBAL but self._n is the LOCAL row
@@ -195,6 +223,23 @@ class Feature:
         if invalid.any():
             rows = rows * jnp.asarray(~invalid, rows.dtype)[:, None]
         return rows
+
+    def _getitem_with_disk(self, node_idx) -> jax.Array:
+        """Disk-mask merge (reference feature.py:309-333): ``disk_map`` splits
+        the batch into mmap reads (entry < 0, read by global id) and
+        in-memory rows (entry = local row into the shard book)."""
+        ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
+        oob = (ids < 0) | (ids >= self.disk_map.shape[0])
+        safe = np.where(oob, 0, ids)
+        disk_index = self.disk_map[safe]
+        disk_mask = (disk_index < 0) & ~oob
+        mem_mask = (disk_index >= 0) & ~oob
+        out = np.zeros((ids.shape[0], self.dim), np.float32)
+        if disk_mask.any():
+            out[disk_mask] = np.asarray(self.mmap_handle_[ids[disk_mask]], np.float32)
+        if mem_mask.any():
+            out[mem_mask] = np.asarray(self.shard_tensor[disk_index[mem_mask]])
+        return jnp.asarray(out)
 
     def lookup_padded(self, node_idx: jax.Array, valid: Optional[jax.Array] = None) -> jax.Array:
         """Jit-friendly gather for padded id arrays; already jitted
@@ -348,7 +393,7 @@ class DistFeature:
         # owners answer in their local row space (reference set_local_order
         # remap, feature.py:283-294 + comm.py:165-168 local gather)
         per_host_local = [self.info.global2local[h_ids] for h_ids in per_host]
-        remote_feats = self.comm.exchange(per_host_local, self.feature)
+        remote_feats = self.comm.exchange(per_host_local)
         out = np.zeros((ids.shape[0], self.feature.dim), np.float32)
         if local_ids.size:
             # a Feature with set_local_order applied remaps global ids itself

@@ -167,21 +167,35 @@ def neighbor_prob(
     ``next[v] += prob[u] * min(k/deg(u), 1)``.
 
     In XLA this is a flat edge-parallel segment-sum over the CSR (the TPU-native
-    replacement for the atomicAdd kernel). Chunked over edges to bound memory.
+    replacement for the atomicAdd kernel). Chunked over edges with a
+    ``lax.fori_loop`` so the traced program holds ONE chunk body regardless of
+    graph size (an unrolled Python loop would bake 15+ scatter-adds into the
+    graph at products scale, worse at papers100M scale).
     """
     n = indptr.shape[0] - 1
     e = indices.shape[0]
+    if e == 0:
+        return jnp.zeros((n,), jnp.float32)
     deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
     w = prob * jnp.minimum(k / jnp.maximum(deg, 1.0), 1.0)  # weight per src node
-    # expand per-edge src id: edge i belongs to row searchsorted(indptr, i, 'right')-1
-    out = jnp.zeros((n,), jnp.float32)
-    for start in range(0, max(e, 1), edge_chunk):
-        sl = slice(start, min(start + edge_chunk, e))
-        eidx = jnp.arange(sl.start, sl.stop, dtype=indptr.dtype)
+    chunk = min(edge_chunk, e)
+    nchunks = -(-e // chunk)
+
+    def body(c, out):
+        # chunks cover [c*chunk, (c+1)*chunk); the final chunk's start is
+        # clamped so the static-size slice stays in bounds, and lanes the
+        # previous chunk already covered are masked out
+        start_u = c * chunk
+        start = jnp.minimum(start_u, e - chunk)
+        eidx = start + jnp.arange(chunk, dtype=indptr.dtype)
+        fresh = eidx >= start_u
+        # edge i belongs to row searchsorted(indptr, i, 'right')-1
         src = jnp.searchsorted(indptr, eidx, side="right") - 1
-        dst = indices[sl]
-        out = out.at[dst].add(jnp.take(w, src))
-    return out
+        dst = lax.dynamic_slice(indices, (start,), (chunk,))
+        dst = jnp.where(fresh, dst, n)  # n is out of range -> dropped
+        return out.at[dst].add(jnp.where(fresh, jnp.take(w, src), 0.0), mode="drop")
+
+    return lax.fori_loop(0, nchunks, body, jnp.zeros((n,), jnp.float32))
 
 
 def sample_prob(

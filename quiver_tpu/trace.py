@@ -53,17 +53,39 @@ class timer:
             print(f"[quiver-tpu] {self.name}: {self.elapsed*1e3:.3f} ms")
 
 
+class _SyncBox:
+    """Mutable handle a scope can park device arrays in (``box.sync = out``)
+    so the scope waits for their EXECUTION, not just dispatch."""
+
+    __slots__ = ("sync",)
+
+    def __init__(self):
+        self.sync = None
+
+
 @contextlib.contextmanager
-def trace_scope(name: str) -> Iterator[None]:
+def trace_scope(name: str, sync=None) -> Iterator["_SyncBox"]:
     """TRACE_SCOPE analog (trace.hpp:6-14): no-op unless QUIVER_ENABLE_TRACE
-    is set; aggregates (count, total seconds) per scope name."""
+    is set; aggregates (count, total seconds) per scope name.
+
+    JAX dispatch is asynchronous, so a bare wall clock measures *enqueue*
+    time, not device time. Pass the scope's output arrays via ``sync=`` (or
+    assign them to the yielded box: ``with trace_scope("s") as b: b.sync =
+    out``) and the scope calls ``jax.block_until_ready`` before stopping the
+    clock."""
+    box = _SyncBox()
+    box.sync = sync
     if not trace_enabled():
-        yield
+        yield box
         return
     t0 = time.perf_counter()
     try:
-        yield
+        yield box
     finally:
+        if box.sync is not None:
+            import jax
+
+            jax.block_until_ready(box.sync)
         dt = time.perf_counter() - t0
         cnt, tot = _registry[name]
         _registry[name] = (cnt + 1, tot + dt)

@@ -1,0 +1,73 @@
+"""Worker for the 2-process hermetic exchange test (run via subprocess).
+
+Each process is one "host" of a 2-host pod: it initializes jax.distributed
+over a local coordinator, holds ONLY its own feature block, and runs the
+collective exchange. Proves the multi-process path (per-process shards via
+jax.make_array_from_process_local_data) without a real pod — the reference
+could only test its NcclComm against live LAN IPs (test_comm.py:9-11).
+
+usage: python dist_worker.py <process_id> <coordinator_port>
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "")
+
+    import jax
+
+    # the env var alone loses to accelerator plugins (e.g. the axon TPU
+    # tunnel); the config update is authoritative (same as tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from quiver_tpu.comm import TpuComm
+
+    R, D = 8, 4
+    # host h's local block: row r = [1000*h + r, ...] so provenance is checkable
+    local_table = (
+        np.arange(R, dtype=np.float32)[:, None] + 1000.0 * pid + np.zeros((R, D), np.float32)
+    )
+
+    mesh = Mesh(np.array(jax.devices()), ("host",))
+    comm = TpuComm(rank=pid, world_size=2, mesh=mesh)
+    comm.static_budget = 4
+    comm.register_local_table(pid, local_table)  # own block ONLY
+
+    # host 0 asks host 1 for its local rows [1, 3]; host 1 asks host 0 for [2, 5, 7]
+    if pid == 0:
+        host2ids = [np.array([], np.int64), np.array([1, 3], np.int64)]
+    else:
+        host2ids = [np.array([2, 5, 7], np.int64), np.array([], np.int64)]
+
+    res = comm.exchange(host2ids)
+
+    peer = 1 - pid
+    got = np.asarray(res[peer])
+    want_rows = host2ids[peer]
+    expect = want_rows[:, None] + 1000.0 * peer + np.zeros((want_rows.size, D), np.float32)
+    np.testing.assert_allclose(got, expect)
+    assert res[pid] is None  # no self-request was made
+
+    # a second exchange reuses the same program/budget (steady-state path)
+    res2 = comm.exchange(host2ids)
+    np.testing.assert_allclose(np.asarray(res2[peer]), expect)
+
+    print(f"worker {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,7 +94,7 @@ def test_tpu_comm_exchange_single_controller(host_mesh):
     for i, t in enumerate(tables):
         comm.register_local_table(i, t)
     host2ids = [np.array([0, 5]), np.array([], np.int64), np.array([11]), np.array([3, 3, 7])]
-    res = comm.exchange(host2ids, feature=None)
+    res = comm.exchange(host2ids)
     np.testing.assert_allclose(np.asarray(res[0]), tables[0][[0, 5]], rtol=1e-6)
     assert res[1] is None
     np.testing.assert_allclose(np.asarray(res[2]), tables[2][[11]], rtol=1e-6)

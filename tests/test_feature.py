@@ -133,3 +133,25 @@ def test_feature_from_mmap(tmp_path, table):
     feat = Feature.from_mmap(mm, DeviceConfig([0], 100 * 16 * 4))
     ids = np.array([0, 99, 100, 499])
     np.testing.assert_allclose(np.asarray(feat[ids]), table[ids])
+
+
+def test_feature_set_mmap_file(tmp_path, table):
+    # reference feature.py:84-93 + disk-mask merge (feature.py:309-333):
+    # the first 100 rows are cached in memory, the rest live on disk only
+    path = tmp_path / "full.npy"
+    np.save(path, table)
+    feat = Feature(rank=0, device_list=[0], device_cache_size=100 * 16 * 4)
+    feat.from_cpu_tensor(table[:100])  # in-memory tier holds rows 0..99
+    disk_map = np.full(table.shape[0], -1, np.int64)
+    disk_map[:100] = np.arange(100)  # cached ids -> their in-memory rows
+    feat.set_mmap_file(str(path), disk_map)
+
+    # read_mmap reads by global id
+    np.testing.assert_allclose(
+        np.asarray(feat.read_mmap(np.array([150, 499]))), table[[150, 499]]
+    )
+    # __getitem__ merges mem + disk tiers; out-of-range ids -> zero rows
+    ids = np.array([5, 150, 99, 499, 1000])
+    got = np.asarray(feat[ids])
+    np.testing.assert_allclose(got[:4], table[ids[:4]], rtol=1e-6)
+    np.testing.assert_allclose(got[4], np.zeros(16))

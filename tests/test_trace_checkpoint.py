@@ -33,6 +33,24 @@ def test_trace_scope_gated(monkeypatch):
     assert cnt == 2 and tot >= 0
 
 
+def test_trace_scope_syncs_device_work(monkeypatch):
+    # async dispatch: without block_until_ready the scope would time enqueue
+    # only; with sync= it must cover device execution of a non-trivial matmul
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("QUIVER_ENABLE_TRACE", "1")
+    a = jnp.ones((500, 500))
+    with trace_scope("mm") as box:
+        box.sync = a @ a
+    cnt, tot = trace_report(reset=True)["mm"]
+    assert cnt == 1 and tot > 0
+    # the sync= kwarg form works too
+    with trace_scope("mm2", sync=a @ a):
+        pass
+    assert trace_report(reset=True)["mm2"][0] == 1
+
+
 def test_metric_helpers():
     assert seps(1000, 0.5) == 2000
     assert abs(gbps(1000, 250, 1.0) - 1e-3) < 1e-9
