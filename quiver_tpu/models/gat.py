@@ -32,7 +32,7 @@ class GATConv(nn.Module):
     @nn.compact
     def __call__(self, x_src: jax.Array, adj: DenseAdj) -> jax.Array:
         h, d = self.heads, self.out_dim
-        w_dst = adj.cols.shape[0]
+        w_dst = adj.w_dst
         x_dst = x_src[:w_dst]
 
         proj = nn.Dense(h * d, use_bias=False, name="lin")
@@ -42,8 +42,7 @@ class GATConv(nn.Module):
         a_src = self.param("att_src", nn.initializers.glorot_uniform(), (1, h, d))
         a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (1, h, d))
 
-        cols = jnp.clip(adj.cols, 0, x_src.shape[0] - 1)
-        hn = hs[cols]                                # [W_dst, k, H, D]
+        hn = adj.gather_src(hs)                      # [W_dst, k, H, D]
         e_src = (hn * a_src[None]).sum(-1)           # [W_dst, k, H]
         e_dst = (hd * a_dst).sum(-1)                 # [W_dst, H]
         # self-attention edge (PyG adds self loops; the sampler's target node

@@ -181,10 +181,14 @@ def test_fused_path_validity(graph):
     ds = sample_dense_fused(indptr, indices, jax.random.key(3), seeds, (4, 3))
     n_id = np.asarray(ds.n_id)
     np.testing.assert_array_equal(n_id[:24], np.arange(24))
-    # static col pattern: every valid edge connects true neighbors
+    # structural layout (cols=None): neighbor (i, j) at W + j*W + i; every
+    # valid edge connects true neighbors
     cur_ids = n_id
     for adj in ds.adjs:
-        cols, mask = np.asarray(adj.cols), np.asarray(adj.mask)
+        assert adj.cols is None
+        mask = np.asarray(adj.mask)
+        w, k = mask.shape
+        cols = w * (1 + np.arange(k))[None, :] + np.arange(w)[:, None]
         for i in range(cols.shape[0]):
             for j in range(cols.shape[1]):
                 if mask[i, j]:
