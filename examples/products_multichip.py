@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--avg-deg", type=int, default=15)
     ap.add_argument("--dim", type=int, default=100)
     ap.add_argument("--classes", type=int, default=47)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--sizes", default="15,10,5")
+    ap.add_argument("--steps-per-epoch", type=int, default=0, help="0 = full epoch")
     args = ap.parse_args()
 
     import jax
@@ -58,8 +61,10 @@ def main():
     dp = mesh.shape["dp"]
     print(f"mesh: dp={dp} ici={mesh.shape['ici']} ({mesh.devices.size} devices)")
 
-    sizes = (15, 10, 5)
-    model = GraphSAGE(hidden_dim=256, out_dim=args.classes, num_layers=3, dropout=0.5)
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    model = GraphSAGE(
+        hidden_dim=args.hidden, out_dim=args.classes, num_layers=len(sizes), dropout=0.5
+    )
     tx = optax.adam(1e-3)
     step = make_sharded_train_step(mesh, model, tx, sizes=sizes)
 
@@ -83,7 +88,7 @@ def main():
     )
     opt_state = jax.device_put(tx.init(params), NamedSharding(mesh, P()))
 
-    steps_per_epoch = max(n // batch_global, 1)
+    steps_per_epoch = args.steps_per_epoch or max(n // batch_global, 1)
     for epoch in range(args.epochs):
         t0 = time.time()
         for i in range(steps_per_epoch):

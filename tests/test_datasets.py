@@ -1,0 +1,83 @@
+"""Dataset ingestion + power-law realism + skew-aware cache measurement
+(VERDICT r1 item 7)."""
+
+import numpy as np
+
+from quiver_tpu import CSRTopo, Feature
+from quiver_tpu.datasets import (
+    cache_hit_rate,
+    edge_skew,
+    load_npz,
+    products_like,
+    save_npz,
+    synthetic_powerlaw,
+)
+from quiver_tpu.pyg import GraphSageSampler
+
+
+def test_npz_roundtrip(tmp_path):
+    path = str(tmp_path / "ds.npz")
+    ei = np.array([[0, 1, 2], [1, 2, 0]])
+    feat = np.eye(3, dtype=np.float32)
+    save_npz(path, ei, feat, np.array([0, 1, 0]), np.array([0, 2]), test_idx=np.array([1]))
+    data = load_npz(path)
+    np.testing.assert_array_equal(data["edge_index"], ei)
+    np.testing.assert_array_equal(data["test_idx"], np.array([1]))
+
+
+def test_powerlaw_matches_products_skew():
+    n, e = 20_000, 500_000
+    ei, feat, labels, train_idx = synthetic_powerlaw(n, e, dim=8, classes=4, seed=0)
+    assert ei.shape == (2, e)
+    assert feat.shape == (n, 8) and labels.shape == (n,)
+    # products: top 20% of nodes own well over half the edges
+    # (docs/Introduction_en.md:77-80: >avg-degree nodes = 31% own 77%)
+    skew = edge_skew(ei, n, 0.2)
+    assert skew > 0.55, skew
+    # in-degree must be skewed too (degree-proportional destinations)
+    in_deg = np.bincount(ei[1], minlength=n)
+    top = np.sort(in_deg)[::-1][: n // 5].sum()
+    assert top / max(in_deg.sum(), 1) > 0.5
+
+
+def test_products_like_scaled():
+    ei, feat, labels, train_idx = products_like(scale=0.002)
+    n = int(2_449_029 * 0.002)
+    assert feat.shape[1] == 100 and labels.max() < 47
+    assert ei.max() < n
+    assert 0 < len(train_idx) < n
+
+
+def test_cache_hit_rate_under_skew():
+    n, e = 20_000, 500_000
+    ei, feat, labels, _ = synthetic_powerlaw(n, e, dim=8, classes=4, seed=1)
+    topo = CSRTopo(edge_index=ei)
+    feat20 = Feature(
+        rank=0, device_list=[0], device_cache_size=(n // 5) * 8 * 4, csr_topo=topo
+    )
+    feat20.from_cpu_tensor(feat)  # installs degree-ordered feature_order
+    sampler = GraphSageSampler(topo, sizes=[10, 5], mode="CPU", seed=0)
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(4):
+        ds = sampler.sample_dense(rng.integers(0, n, 256))
+        ids = np.asarray(ds.n_id)[: int(ds.count)]
+        batches.append(ids)
+    hit = cache_hit_rate(topo, batches, 0.2)
+    # gathered (deduped) ids concentrate on hubs: a degree-ordered 20% cache
+    # must clearly beat the ~20% a uniform graph gives. (The deduped n_id
+    # understates raw gather traffic skew — each hub counts once per batch.)
+    assert hit > 0.33, hit
+
+    # control: the same measurement on a uniform random graph sits near the
+    # cache ratio, so the margin above is the power-law structure, not noise
+    rng2 = np.random.default_rng(2)
+    ei_u = np.stack([rng2.integers(0, n, e // 10), rng2.integers(0, n, e // 10)])
+    topo_u = CSRTopo(edge_index=ei_u)
+    sampler_u = GraphSageSampler(topo_u, sizes=[10, 5], mode="CPU", seed=0)
+    batches_u = []
+    for _ in range(2):
+        ds = sampler_u.sample_dense(rng2.integers(0, n, 256))
+        batches_u.append(np.asarray(ds.n_id)[: int(ds.count)])
+    hit_u = cache_hit_rate(topo_u, batches_u, 0.2)
+    assert hit > hit_u + 0.08, (hit, hit_u)
