@@ -1,16 +1,29 @@
-"""Headline benchmark: k-hop neighbor sampling throughput (SEPS).
+"""Headline benchmark: k-hop neighbor sampling throughput (SEPS), plus
+feature-collection GB/s and an end-to-end epoch-equivalent train loop.
 
 Mirrors the reference's benchmarks/sample/bench_sampler.py (SEPS = sampled
 edges per second, bench_sampler.py:14-16) on an ogbn-products-scale synthetic
 graph, fanout [15, 10, 5], batch 1024 — the config behind the reference's
 headline 34.29M SEPS UVA number (docs/Introduction_en.md:41, BASELINE.md).
+Context also records:
 
-Timing is tunnel-safe: every iteration's edge count folds into a dependent
-accumulator and ONE scalar fetch ends the run, so the device must have
-finished every sample step before the clock stops (block_until_ready alone
-can return early through the remote-TPU relay).
+- feature gather GB/s (reference benchmarks/feature/bench_feature.py:44-46;
+  baseline 14.82 GB/s 20%-cache 1-GPU, docs/Introduction_en.md:95) on the
+  jitted HBM path and the tiered (hot HBM + host cold) prefetch path;
+- e2e epoch-equivalent seconds for the FULL train step (sample -> feature
+  gather -> fwd/bwd -> adam, all one XLA program), fused and dedup sampling,
+  vs the reference's 11.1 s 1-GPU products epoch
+  (docs/Introduction_en.md:144-149) — this charges the fused path's
+  duplicated-n_id gather volume end to end.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Measurement discipline: the TPU here sits behind the axon tunnel, where every
+dispatch costs ~0.3-1 s of RPC latency — a host-side timing loop measures the
+network, not the chip. Every device benchmark therefore runs its iteration
+loop INSIDE jit (`lax.scan`), so one dispatch covers all iterations and one
+dependent scalar fetch ends the clock. A wall-clock budget (default 480 s,
+env QUIVER_BENCH_BUDGET_S) skips later sections rather than losing the JSON.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", "context"}.
 """
 
 import json
@@ -21,6 +34,20 @@ import time
 import numpy as np
 
 BASELINE_SEPS = 34.29e6  # reference: 1 GPU, UVA, ogbn-products [15,10,5]
+BASELINE_FEAT_GBPS = 14.82  # reference: 1 GPU, 20% cache, products (Introduction_en.md:95)
+BASELINE_EPOCH_S = 11.1  # reference: 1 GPU products GraphSAGE epoch (Introduction_en.md:144)
+PRODUCTS_TRAIN_NODES = 196_615  # ogbn-products train split size
+
+_T0 = time.time()
+_BUDGET_S = float(os.environ.get("QUIVER_BENCH_BUDGET_S", "480"))
+
+
+def remaining() -> float:
+    return _BUDGET_S - (time.time() - _T0)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
 
 
 def enable_compile_cache():
@@ -36,40 +63,282 @@ def enable_compile_cache():
         log(f"compile cache unavailable: {exc}")
 
 
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+def build_graph(n_nodes=2_449_029, n_edges=2 * 61_859_140, seed=0):
+    """products-scale power-law graph. Node count = ogbn-products; edge
+    count = 2x the published 61.86M because products is UNDIRECTED and the
+    reference samples the symmetrized CSR (avg degree ~50). The power-law
+    degree profile matches the published skew (docs/Introduction_en.md:77-80)
+    — a uniform random graph would misrepresent both the dedup pipeline's
+    subgraph sizes and cache-hit behaviour."""
+    from quiver_tpu.datasets import powerlaw_csr
+
+    log(f"generating power-law graph: {n_nodes} nodes, {n_edges} edges")
+    return powerlaw_csr(n_nodes, n_edges, seed=seed)
 
 
-def build_graph(n_nodes=2_449_029, n_edges=61_859_140, seed=0):
-    """products-scale random graph (node/edge counts = ogbn-products)."""
-    rng = np.random.default_rng(seed)
-    log(f"generating graph: {n_nodes} nodes, {n_edges} edges")
-    src = rng.integers(0, n_nodes, n_edges, dtype=np.int64)
-    dst = rng.integers(0, n_nodes, n_edges, dtype=np.int64)
-    order = np.argsort(src, kind="stable")
-    src = src[order]
-    dst = dst[order]
-    indptr = np.zeros(n_nodes + 1, dtype=np.int64)
-    np.cumsum(np.bincount(src, minlength=n_nodes), out=indptr[1:])
-    return indptr, dst
-
-
-def measure(run_jit, graph_args, seed_batches, iters, warmup=3):
-    """Dependent-accumulation timing: returns (seps, total_edges)."""
+def make_scanned_sampler(sample_fn, sizes, iters):
+    """One jitted program running `iters` sample iterations in a lax.scan —
+    a single dispatch + a single dependent fetch, so tunnel RPC latency is
+    amortized across the whole run instead of multiplying it."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
-    acc = jnp.int32(0)
-    for i in range(warmup):
-        acc = acc + run_jit(*graph_args, jax.random.key(i), seed_batches[i % len(seed_batches)])
-    int(acc)  # sync
+    @jax.jit
+    def run_many(ip, ix, key0, seeds_all):
+        m = seeds_all.shape[0]
+
+        def body(acc, i):
+            key = jax.random.fold_in(key0, i)
+            ds = sample_fn(ip, ix, key, seeds_all[i % m], sizes)
+            edges = sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
+            return acc + edges, None  # ~21M/iter x 20 iters < 2^31: int32 is exact
+
+        acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(iters, dtype=jnp.int32))
+        return acc
+
+    return run_many
+
+
+def bench_sampling(context, indptr, indices, seeds_all, iters=20):
+    import jax
+
+    from quiver_tpu.pyg.sage_sampler import sample_dense_fused, sample_dense_pure
+
+    sizes = (15, 10, 5)
+    results = {}
+    for name, fn in (("fused", sample_dense_fused), ("dedup", sample_dense_pure)):
+        if remaining() < 60:
+            log(f"budget exhausted before {name} sampling bench")
+            break
+        try:
+            run = make_scanned_sampler(fn, sizes, iters)
+            log(f"compiling {name} pipeline...")
+            t0 = time.time()
+            total = int(run(indptr, indices, jax.random.key(0), seeds_all))
+            compile_s = time.time() - t0
+            t0 = time.time()
+            total = int(run(indptr, indices, jax.random.key(1), seeds_all))
+            dt = time.time() - t0
+            seps = total / dt
+            log(
+                f"{name:5s}: {seps/1e6:.2f}M SEPS ({total} edges, {iters} iters in "
+                f"{dt:.2f}s; compile+first {compile_s:.1f}s)"
+            )
+            results[name] = seps
+            context[f"{name}_compile_s"] = round(compile_s, 1)
+            context[f"{name}_seps"] = round(seps, 1)
+            context[f"{name}_vs_uva_baseline"] = round(seps / BASELINE_SEPS, 4)
+        except Exception as exc:  # one leg failing must not lose the JSON
+            log(f"{name} sampling bench failed: {exc}")
+    return results
+
+
+def bench_feature(context, table_dev, iters=10, batch=262_144):
+    """Feature-collection GB/s, products-like table (N x 100 f32 = 0.98 GB).
+
+    hot: fully HBM-resident jitted gather (the honest TPU-native design —
+    the whole products table fits one chip's HBM, so the reference's 20%
+    cache split is unnecessary at this scale); iterations scanned in-jit.
+    tiered: 20% HBM hot prefix + host cold tier through the REAL prefetch
+    pipeline (`TieredFeaturePipeline.prepare` + `tiered_lookup`) with the
+    reference's power-law skew (80% of reads in the hot 20%,
+    docs/Introduction_en.md:77-80). Host work + per-batch dispatch are the
+    honest cost of that path; under the axon tunnel the H2D copy and RPC
+    dominate (on a TPU VM they ride PCIe).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from quiver_tpu import Feature
+    from quiver_tpu.pipeline import TieredFeaturePipeline, tiered_lookup
+
+    n_nodes, dim = table_dev.shape
+    rng = np.random.default_rng(0)
+    log(f"feature table: {n_nodes} x {dim} f32")
+
+    hot_n = n_nodes // 5
+    hot_ids = rng.integers(0, hot_n, int(batch * 0.8))
+    cold_ids = rng.integers(hot_n, n_nodes, batch - hot_ids.shape[0])
+    ids = np.concatenate([hot_ids, cold_ids])
+    rng.shuffle(ids)
+
+    # --- hot: all rows in HBM, iters gathers scanned inside one program
+    ids_dev = jax.device_put(jnp.asarray(ids.astype(np.int32)))
+
+    @jax.jit
+    def gather_many(tab, idx):
+        def body(acc, i):
+            shifted = (idx + i * 977) % tab.shape[0]  # decorrelate iterations
+            return acc + jnp.take(tab, shifted, axis=0).sum(dtype=jnp.float32), None
+
+        acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(iters, dtype=jnp.int32))
+        return acc
+
+    float(gather_many(table_dev, ids_dev))  # compile + warm
     t0 = time.time()
-    acc = jnp.int32(0)
-    for i in range(iters):
-        acc = acc + run_jit(*graph_args, jax.random.key(100 + i), seed_batches[i % len(seed_batches)])
-    total_edges = int(acc)  # single dependent fetch == full completion
+    float(gather_many(table_dev, ids_dev))
     dt = time.time() - t0
-    return total_edges / dt, total_edges
+    hot_gbps = iters * batch * dim * 4 / dt / 1e9
+    log(f"feature hot HBM: {hot_gbps:.2f} GB/s ({iters} gathers in {dt:.3f}s)")
+    context["feature_hot_gbps"] = round(hot_gbps, 2)
+    context["feature_hot_vs_ref_20pct"] = round(hot_gbps / BASELINE_FEAT_GBPS, 2)
+
+    # --- tiered 20% through the real prefetch pipeline. Host-side table is
+    # generated fresh (pulling the device table back over the tunnel costs
+    # minutes); only the hot 20% is uploaded. Content differs from the hot
+    # bench's device table — irrelevant, throughput only.
+    iters = max(iters // 2, 4)
+    table_host = rng.standard_normal((n_nodes, dim)).astype(np.float32)
+    feat = Feature(rank=0, device_list=[0], device_cache_size=hot_n * dim * 4)
+    feat.from_cpu_tensor(table_host)
+    pipe = TieredFeaturePipeline(feat)
+
+    def merge_sum(hot, mapped, cold_rows, cold_pos):
+        return tiered_lookup(hot, mapped, cold_rows, cold_pos).sum(dtype=jnp.float32)
+
+    m = jax.jit(merge_sum)
+    ids_j = jnp.asarray(ids)
+    float(m(pipe.hot_table, *pipe.prepare(ids_j)))  # compile + warm
+    t0 = time.time()
+    acc = jnp.float32(0)
+    for _ in range(iters):
+        acc = acc + m(pipe.hot_table, *pipe.prepare(ids_j))
+    float(acc)
+    dt = time.time() - t0
+    tiered_gbps = iters * batch * dim * 4 / dt / 1e9
+    log(f"feature tiered 20% (prefetch pipeline): {tiered_gbps:.2f} GB/s")
+    context["feature_tiered20_gbps"] = round(tiered_gbps, 2)
+
+
+def bench_e2e(context, indptr, indices, seeds_all, table, iters=10, classes=47):
+    """Epoch-equivalent e2e: ONE jitted program scans `iters` full train
+    steps (sample -> feature gather -> 3-layer GraphSAGE fwd/bwd -> adam).
+    Charges the fused path's duplicated-n_id gather volume against its
+    sampling win; epoch time = per-step time x ceil(196615/1024) products
+    train steps."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.pyg.sage_sampler import (
+        sample_and_gather_fused,
+        sample_dense_pure,
+    )
+
+    sizes = (15, 10, 5)
+    batch = seeds_all.shape[1]
+    n_nodes, dim = table.shape
+    steps_per_epoch = -(-PRODUCTS_TRAIN_NODES // batch)
+    labels = jax.jit(
+        lambda k: jax.random.randint(k, (n_nodes,), 0, classes, jnp.int32)
+    )(jax.random.key(8))
+    model = GraphSAGE(hidden_dim=256, out_dim=classes, num_layers=3, dropout=0.0)
+    tx = optax.adam(1e-3)
+
+    # dedup path: static n_id caps derived from an observed subgraph (1.3x
+    # the measured unique count, rounded up to 16k granules — stable across
+    # runs). On a power-law graph the real subgraph is far below the padded
+    # B*prod(1+k) worst case; capping shrinks the gather + model width.
+    ds_probe = sample_dense_pure(
+        indptr, indices, jax.random.key(0), jnp.asarray(seeds_all[0]), sizes
+    )
+    hop_counts = [int(a.n_src) for a in ds_probe.adjs[::-1]]  # innermost first
+    caps = tuple(
+        min(-(-int(c * 1.3) // 16384) * 16384, w)  # 1.3x margin, 16k granules
+        for c, w in zip(
+            hop_counts,
+            [batch * 16, batch * 16 * 11, batch * 16 * 11 * 6],
+        )
+    )
+    log(f"dedup hop unique counts {hop_counts} -> caps {caps}")
+
+    def make_epoch(sample_fn, sample_caps):
+        def one_step(params, opt_state, ip, ix, tab, lab, key, seeds):
+            key, sub = jax.random.split(key)
+            if sample_fn is sample_and_gather_fused:
+                # per-hop interleaved gather: XLA overlaps each hop's
+                # (row-rate-bound) feature fetch with the next hop's sampling
+                ds, x = sample_and_gather_fused(ip, ix, tab, sub, seeds, sizes)
+            else:
+                ds = sample_fn(ip, ix, sub, seeds, sizes, sample_caps)
+                x = jnp.take(tab, jnp.clip(ds.n_id, 0, tab.shape[0] - 1), axis=0)
+            y = jnp.take(lab, jnp.clip(ds.n_id[:batch], 0, lab.shape[0] - 1))
+
+            def objective(p):
+                logits = model.apply(p, x, ds.adjs, train=True, rngs={"dropout": key})
+                ll = jax.nn.log_softmax(logits)
+                return -jnp.take_along_axis(ll, y[:, None], axis=1).mean()
+
+            loss, grads = jax.value_and_grad(objective)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        @jax.jit
+        def epoch(params, opt_state, ip, ix, tab, lab, key0, seeds_all):
+            m = seeds_all.shape[0]
+
+            def body(carry, i):
+                params, opt_state = carry
+                key = jax.random.fold_in(key0, i)
+                params, opt_state, loss = one_step(
+                    params, opt_state, ip, ix, tab, lab, key, seeds_all[i % m]
+                )
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = lax.scan(
+                body, (params, opt_state), jnp.arange(iters, dtype=jnp.int32)
+            )
+            return params, opt_state, losses
+
+        return epoch
+
+    for name, sample_fn, sample_caps in (
+        ("fused", sample_and_gather_fused, None),
+        ("dedup", sample_dense_pure, caps),
+    ):
+        # a cold-cache compile of one e2e program runs ~70-100 s; skip the
+        # leg outright rather than blow the budget mid-compile with no JSON
+        if remaining() < 150:
+            log(f"budget exhausted before e2e {name}")
+            break
+        if sample_fn is sample_and_gather_fused:
+            ds_real, x0 = sample_and_gather_fused(
+                indptr, indices, table, jax.random.key(0), jnp.asarray(seeds_all[0]), sizes
+            )
+        else:
+            ds_real = sample_fn(
+                indptr, indices, jax.random.key(0), jnp.asarray(seeds_all[0]), sizes, sample_caps
+            )
+            x0 = jnp.zeros((ds_real.n_id.shape[0], dim), jnp.float32)
+        params = model.init(jax.random.key(1), x0, ds_real.adjs)
+        opt_state = tx.init(params)
+        epoch_fn = make_epoch(sample_fn, sample_caps)
+        log(f"compiling e2e {name} step...")
+        t0 = time.time()
+        params, opt_state, losses = epoch_fn(
+            params, opt_state, indptr, indices, table, labels, jax.random.key(2), seeds_all
+        )
+        float(losses[-1])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        params, opt_state, losses = epoch_fn(
+            params, opt_state, indptr, indices, table, labels, jax.random.key(3), seeds_all
+        )
+        float(losses[-1])  # dependent fetch == all steps executed
+        step_s = (time.time() - t0) / iters
+        epoch_s = step_s * steps_per_epoch
+        log(
+            f"e2e {name}: {step_s*1e3:.1f} ms/step -> epoch {epoch_s:.2f}s "
+            f"(compile {compile_s:.1f}s, ref 1-GPU epoch {BASELINE_EPOCH_S}s)"
+        )
+        context[f"e2e_{name}_epoch_s"] = round(epoch_s, 2)
+        context[f"e2e_{name}_compile_s"] = round(compile_s, 1)
+        context[f"e2e_{name}_vs_ref_epoch"] = round(BASELINE_EPOCH_S / epoch_s, 2)
 
 
 def main():
@@ -77,12 +346,8 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from quiver_tpu.pyg.sage_sampler import sample_dense_fused, sample_dense_pure
-
     batch = 1024
-    sizes = (15, 10, 5)
     n_nodes = 2_449_029
-    iters = 20
 
     indptr_np, indices_np = build_graph(n_nodes=n_nodes)
     # graph arrays are jit ARGUMENTS, not closure constants: embedding a
@@ -91,47 +356,35 @@ def main():
     indices = jax.device_put(jnp.asarray(indices_np.astype(np.int32)))
     log(f"devices: {jax.devices()}")
 
-    def run_fused(ip, ix, key, seeds):
-        ds = sample_dense_fused(ip, ix, key, seeds, sizes)
-        return sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
-
-    def run_dedup(ip, ix, key, seeds):
-        ds = sample_dense_pure(ip, ix, key, seeds, sizes)
-        return sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
-
     rng = np.random.default_rng(1)
-    seed_batches = [
-        jnp.asarray(rng.integers(0, n_nodes, batch, dtype=np.int64).astype(np.int32))
-        for _ in range(24)
-    ]
+    seeds_all = jax.device_put(
+        jnp.asarray(rng.integers(0, n_nodes, (24, batch), dtype=np.int64).astype(np.int32))
+    )
 
     context = {}
-    fused_jit = jax.jit(run_fused)
-    log("compiling fused pipeline...")
-    t0 = time.time()
-    e = int(fused_jit(indptr, indices, jax.random.key(0), seed_batches[0]))
-    compile_fused = time.time() - t0
-    log(f"fused compile+first run: {compile_fused:.1f}s, edges/iter={e}")
-    seps_fused, edges_f = measure(fused_jit, (indptr, indices), seed_batches, iters)
-    log(f"fused  : {seps_fused/1e6:.2f}M SEPS ({edges_f} edges)")
-    context["fused_compile_s"] = round(compile_fused, 1)
-
-    seps_dedup = None
+    results = bench_sampling(context, indptr, indices, seeds_all)
+    # products-like feature table, generated ON DEVICE (a host-side table
+    # would cost minutes of tunnel transfer); shared by both sections
+    dim = 100
+    table = jax.jit(
+        lambda k: jax.random.normal(k, (n_nodes, dim), jnp.float32)
+    )(jax.random.key(7))
     try:
-        dedup_jit = jax.jit(run_dedup)
-        log("compiling dedup pipeline...")
-        t0 = time.time()
-        int(dedup_jit(indptr, indices, jax.random.key(0), seed_batches[0]))
-        compile_dedup = time.time() - t0
-        log(f"dedup compile+first run: {compile_dedup:.1f}s")
-        seps_dedup, _ = measure(dedup_jit, (indptr, indices), seed_batches, max(iters // 2, 5))
-        log(f"dedup  : {seps_dedup/1e6:.2f}M SEPS (reference-parity reindex path)")
-        context["dedup_compile_s"] = round(compile_dedup, 1)
-        context["dedup_seps"] = round(seps_dedup, 1)
-        context["dedup_vs_uva_baseline"] = round(seps_dedup / BASELINE_SEPS, 4)
-    except Exception as exc:  # secondary diagnostic only
-        log(f"dedup path failed: {exc}")
+        if remaining() > 60:
+            bench_feature(context, table)
+        else:
+            log("budget exhausted before feature bench")
+    except Exception as exc:
+        log(f"feature bench failed: {exc}")
+    try:
+        if remaining() > 120:
+            bench_e2e(context, indptr, indices, seeds_all, table)
+        else:
+            log("budget exhausted before e2e bench")
+    except Exception as exc:
+        log(f"e2e bench failed: {exc}")
 
+    seps_fused = results.get("fused", 0.0)
     print(
         json.dumps(
             {
