@@ -4,9 +4,11 @@ examples/multi_gpu/pyg/ogb-products/dist_sampling_ogb_products_quiver.py
 ONE jitted step over the device mesh: per-dp-group seed shards, hot feature
 rows striped over ici, gradient psum.
 
-Runs on any device count (8 fake CPU devices via
-XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu, or a
-real TPU slice).
+Runs on any device count: a real TPU slice, or a virtual CPU mesh via
+``QUIVER_VIRTUAL_DEVICES=8 python examples/products_multichip.py`` (the env
+knob forces the mesh even when an accelerator plugin pre-registered).
+``--pipeline fused`` selects the no-dedup structural pipeline with per-hop
+ICI gathers (fastest); ``--pipeline dedup`` keeps reference-parity reindex.
 """
 
 import os
@@ -20,7 +22,19 @@ import time
 import numpy as np
 
 
+def _maybe_force_virtual_devices():
+    """QUIVER_VIRTUAL_DEVICES=N forces an N-device CPU mesh even when an
+    accelerator plugin pre-registered (env vars alone lose to it)."""
+    n = os.environ.get("QUIVER_VIRTUAL_DEVICES")
+    if not n:
+        return
+    from quiver_tpu.utils import force_virtual_cpu_devices
+
+    force_virtual_cpu_devices(int(n))
+
+
 def main():
+    _maybe_force_virtual_devices()
     ap = argparse.ArgumentParser()
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-per-dp", type=int, default=256)
@@ -31,6 +45,7 @@ def main():
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--sizes", default="15,10,5")
     ap.add_argument("--steps-per-epoch", type=int, default=0, help="0 = full epoch")
+    ap.add_argument("--pipeline", default="dedup", choices=["dedup", "fused"])
     args = ap.parse_args()
 
     import jax
@@ -66,7 +81,7 @@ def main():
         hidden_dim=args.hidden, out_dim=args.classes, num_layers=len(sizes), dropout=0.5
     )
     tx = optax.adam(1e-3)
-    step = make_sharded_train_step(mesh, model, tx, sizes=sizes)
+    step = make_sharded_train_step(mesh, model, tx, sizes=sizes, pipeline=args.pipeline)
 
     indptr = replicate(mesh, topo.indptr.astype(np.int32))
     indices = replicate(mesh, topo.indices.astype(np.int32))

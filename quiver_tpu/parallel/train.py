@@ -32,7 +32,7 @@ try:  # jax >= 0.6 moved shard_map out of experimental
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_fn
 
-from ..pyg.sage_sampler import sample_dense_pure
+from ..pyg.sage_sampler import sample_and_gather_fused, sample_dense_pure
 from .collectives import sharded_gather
 
 
@@ -72,6 +72,7 @@ def make_sharded_train_step(
     sizes: Sequence[int],
     caps: Optional[Sequence[Optional[int]]] = None,
     train: bool = True,
+    pipeline: str = "dedup",
 ):
     """Build ``step(params, opt_state, key, indptr, indices, feat_block,
     labels, seeds) -> (params, opt_state, loss)``.
@@ -83,16 +84,35 @@ def make_sharded_train_step(
         (the p2p_clique_replicate layout, reference feature.py:225-265);
       - seeds: sharded over dp, replicated over ici;
       - params/opt_state: replicated; grads psum over dp.
+
+    ``pipeline``: "dedup" (reference-parity per-hop reindex) or "fused"
+    (no-dedup structural layout; per-hop ICI gathers interleave with
+    sampling — the fastest path, same tradeoff as the single-chip
+    pipelines, PERF_NOTES.md).
     """
+    if pipeline not in ("dedup", "fused"):
+        raise ValueError(f"unknown pipeline: {pipeline!r}")
+    if pipeline == "fused" and caps is not None:
+        raise ValueError(
+            "caps only apply to the dedup pipeline: the fused layout is "
+            "structural (width is exactly B*prod(1+k), not cappable)"
+        )
+
     def step_local(params, opt_state, key, indptr, indices, feat_block, labels, seeds):
         dp_idx = lax.axis_index("dp")
         # distinct sample stream per dp group, identical within an ici group
         key = jax.random.fold_in(key, dp_idx)
         key, dropout_key = jax.random.split(key)
-        ds = sample_dense_pure(indptr, indices, key, seeds, tuple(sizes), caps)
-        # hot rows are striped across the ici axis (replicated over dp);
-        # one psum over ICI assembles full rows for this dp group's n_id
-        x = sharded_gather(feat_block, ds.n_id, "ici")
+        if pipeline == "fused":
+            ds, x = sample_and_gather_fused(
+                indptr, indices, feat_block, key, seeds, tuple(sizes),
+                gather_fn=lambda tab, ids: sharded_gather(tab, ids, "ici"),
+            )
+        else:
+            ds = sample_dense_pure(indptr, indices, key, seeds, tuple(sizes), caps)
+            # hot rows are striped across the ici axis (replicated over dp);
+            # one psum over ICI assembles full rows for this dp group's n_id
+            x = sharded_gather(feat_block, ds.n_id, "ici")
         y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
 
         def objective(p):

@@ -151,6 +151,7 @@ def sample_and_gather_fused(
     key: jax.Array,
     seeds: jax.Array,
     sizes: Tuple[int, ...],
+    gather_fn=None,
 ) -> Tuple[DenseSample, jax.Array]:
     """Fused multi-hop sample with the FEATURE GATHER interleaved per hop.
 
@@ -161,20 +162,27 @@ def sample_and_gather_fused(
     ``x == table[clip(ds.n_id)]`` row for row (invalid lanes carry garbage
     rows that ``adj.mask`` gates out of every aggregation, exactly like the
     single-take formulation).
+
+    ``gather_fn(table, ids) -> rows`` overrides the local HBM take — e.g.
+    `quiver_tpu.parallel.collectives.sharded_gather` inside shard_map, so
+    the ICI collective per hop overlaps with sampling the same way.
     """
     B = seeds.shape[0]
     n_rows = table.shape[0]
+    if gather_fn is None:
+        def gather_fn(tab, ids):
+            return jnp.take(tab, jnp.clip(ids, 0, n_rows - 1), axis=0)
     cur = seeds
     cur_valid = jnp.ones((B,), bool)
     adjs: List[DenseAdj] = []
-    xs = [jnp.take(table, jnp.clip(seeds, 0, n_rows - 1), axis=0)]
+    xs = [gather_fn(table, seeds)]
     prev_count = jnp.asarray(B, jnp.int32)
     for k in sizes:
         key, sub = jax.random.split(key)
         w = cur.shape[0]
         nbrs, valid = _sample_layer_op(indptr, indices, cur, cur_valid, k, sub)
         flat = nbrs.T.reshape(-1)
-        xs.append(jnp.take(table, jnp.clip(flat, 0, n_rows - 1), axis=0))
+        xs.append(gather_fn(table, flat))
         n_id = jnp.concatenate([cur, flat])
         n_valid = jnp.concatenate([cur_valid, valid.T.reshape(-1)])
         count = n_valid.sum().astype(jnp.int32)

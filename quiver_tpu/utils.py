@@ -251,6 +251,65 @@ p2pCliqueTopo = IciTopo
 Topo = IciTopo
 
 
+def force_virtual_cpu_devices(n_devices: int) -> None:
+    """Force an ``n_devices`` virtual CPU mesh regardless of which
+    accelerator plugin registered first.
+
+    Env vars alone (``JAX_PLATFORMS``/``XLA_FLAGS``) lose once a site hook
+    has imported jax and an accelerator plugin won platform selection; only
+    ``jax.config.update`` is authoritative, and an already-initialized
+    backend must be cleared so the new device count is re-read. Used by the
+    test conftest, the driver's multichip dryrun, and the examples'
+    ``QUIVER_VIRTUAL_DEVICES`` knob.
+    """
+    import os
+    import re as _re
+
+    xla_flags = _re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    def _apply():
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except AttributeError:  # older jax: the XLA_FLAGS env (above) rules
+            pass
+
+    def _clear():
+        # reset initialized backends (e.g. a TPU plugin) so the
+        # platform/device-count config is re-read on next use
+        try:
+            import jax.extend.backend
+
+            jax.extend.backend.clear_backends()
+        except Exception:  # pragma: no cover
+            from jax._src import xla_bridge
+
+            xla_bridge._clear_backends()
+        jax.clear_caches()
+
+    try:
+        _apply()
+    except RuntimeError:
+        _clear()
+        _apply()
+    if len(jax.devices()) != n_devices or jax.devices()[0].platform != "cpu":
+        _clear()
+        _apply()
+    assert len(jax.devices()) == n_devices and jax.devices()[0].platform == "cpu", (
+        f"could not force {n_devices} virtual CPU devices; got {jax.devices()}"
+    )
+
+
 def init_p2p(device_list: Optional[List[int]] = None) -> None:
     """Compat no-op (reference utils.py:251-257 / quiver_feature.cu:363-406).
 

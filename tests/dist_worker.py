@@ -66,6 +66,30 @@ def main() -> None:
     res2 = comm.exchange(host2ids)
     np.testing.assert_allclose(np.asarray(res2[peer]), expect)
 
+    # --- full DistFeature stack across the two processes: each host holds
+    # ONLY its own rows; lookups use GLOBAL ids and the remote rows arrive
+    # through the collective exchange (reference train_quiver_multi_node.py
+    # needed a live cluster for this; here it is hermetic)
+    from quiver_tpu import DistFeature, Feature, PartitionInfo
+
+    n_global = 2 * R
+    global2host = (np.arange(n_global) // R).astype(np.int32)  # host h owns [h*R,(h+1)*R)
+    owned_global = np.arange(pid * R, (pid + 1) * R, dtype=np.int64)
+
+    feat = Feature(rank=0, device_list=[0], device_cache_size=R * D * 4)
+    feat.from_cpu_tensor(local_table)
+    feat.set_local_order(owned_global)
+
+    info = PartitionInfo(device=0, host=pid, hosts=2, global2host=global2host)
+    dist = DistFeature(feat, info, comm)
+    # every host requests the same mix of local + remote global ids
+    want = np.array([1, R + 2, 3, 2 * R - 1], np.int64)
+    got = np.asarray(dist[want])
+    expect_rows = (want % R)[:, None] + 1000.0 * (want // R)[:, None] + np.zeros(
+        (want.size, D), np.float32
+    )
+    np.testing.assert_allclose(got, expect_rows)
+
     print(f"worker {pid} OK", flush=True)
 
 

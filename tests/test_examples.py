@@ -48,10 +48,7 @@ def test_products_multichip_runs():
             "--dim", "16", "--classes", "8", "--hidden", "32",
             "--sizes", "6,5", "--steps-per-epoch", "4",
         ],
-        {
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-        },
+        {"QUIVER_VIRTUAL_DEVICES": "8"},
     )
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "mesh: dp=" in r.stdout and "epoch 0:" in r.stdout, r.stdout
+    assert "(8 devices)" in r.stdout and "epoch 0:" in r.stdout, r.stdout

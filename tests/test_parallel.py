@@ -89,13 +89,19 @@ def test_sharded_gather_oob_ids_zero():
     np.testing.assert_allclose(np.asarray(out)[0], 1.0)
 
 
-def test_sharded_train_step_learns():
+@pytest.mark.parametrize("pipeline", ["dedup", "fused"])
+def test_sharded_train_step_learns(pipeline):
+    # dedup = reference-parity per-hop reindex; fused = no-dedup structural
+    # layout with per-hop ICI gathers interleaved into sampling. Same
+    # sharding contract either way (duplicated n_id is fine for fused).
+    from quiver_tpu.pyg.sage_sampler import sample_and_gather_fused, sample_dense_pure
+
     edge_index, feat_np, labels, n = make_community_graph(per_comm=40)
     topo = CSRTopo(edge_index=edge_index)
     mesh = make_mesh(8)
     model = GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
     tx = optax.adam(1e-2)
-    step = make_sharded_train_step(mesh, model, tx, sizes=[4, 4])
+    step = make_sharded_train_step(mesh, model, tx, sizes=[4, 4], pipeline=pipeline)
 
     indptr = replicate(mesh, topo.indptr.astype(np.int32))
     indices = replicate(mesh, topo.indices.astype(np.int32))
@@ -103,18 +109,18 @@ def test_sharded_train_step_learns():
     labels_d = replicate(mesh, labels.astype(np.int32))
 
     # bootstrap params with a host-side sample of matching static shapes
-    from quiver_tpu.pyg.sage_sampler import sample_dense_pure
-
     dp = mesh.shape["dp"]
     batch_global = 8 * dp
-    ds0 = sample_dense_pure(
-        jnp.asarray(topo.indptr.astype(np.int32)),
-        jnp.asarray(topo.indices.astype(np.int32)),
-        jax.random.key(0),
-        jnp.arange(batch_global // dp, dtype=jnp.int32),
-        (4, 4),
-    )
-    x0 = jnp.zeros((ds0.n_id.shape[0], feat_np.shape[1]), jnp.float32)
+    ip = jnp.asarray(topo.indptr.astype(np.int32))
+    ix = jnp.asarray(topo.indices.astype(np.int32))
+    seeds0 = jnp.arange(batch_global // dp, dtype=jnp.int32)
+    if pipeline == "fused":
+        ds0, x0 = sample_and_gather_fused(
+            ip, ix, jnp.asarray(feat_np), jax.random.key(0), seeds0, (4, 4)
+        )
+    else:
+        ds0 = sample_dense_pure(ip, ix, jax.random.key(0), seeds0, (4, 4))
+        x0 = jnp.zeros((ds0.n_id.shape[0], feat_np.shape[1]), jnp.float32)
     params = model.init(jax.random.key(1), x0, ds0.adjs)
     opt_state = tx.init(params)
     params = replicate(mesh, params)
@@ -132,3 +138,12 @@ def test_sharded_train_step_learns():
         )
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_sharded_train_step_fused_rejects_caps():
+    mesh = make_mesh(8)
+    model = GraphSAGE(hidden_dim=4, out_dim=2, num_layers=1, dropout=0.0)
+    with pytest.raises(ValueError, match="caps"):
+        make_sharded_train_step(
+            mesh, model, optax.adam(1e-3), sizes=[3], caps=[64], pipeline="fused"
+        )
