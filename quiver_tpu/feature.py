@@ -30,7 +30,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .shard_tensor import CPU_DEVICE, ShardTensor, ShardTensorConfig, _device_of
+from .shard_tensor import (
+    CPU_DEVICE,
+    ShardTensor,
+    ShardTensorConfig,
+    _device_of,
+    normalize_dtype,
+)
 from .utils import CSRTopo, IciTopo, parse_size, reindex_feature
 
 
@@ -72,11 +78,16 @@ class Feature:
         device_cache_size: Union[int, str] = 0,
         cache_policy: str = "device_replicate",
         csr_topo: Optional[CSRTopo] = None,
+        dtype=np.float32,
     ):
         if cache_policy == "ici_replicate":
             cache_policy = "p2p_clique_replicate"
         if cache_policy not in ("device_replicate", "p2p_clique_replicate"):
             raise ValueError(f"unknown cache_policy: {cache_policy}")
+        # dtype of the in-memory tiers: bfloat16 doubles the rows every HBM
+        # byte buys (the reference is float32-only, quiver_feature.cu:65-69).
+        # The mmap disk tier keeps its on-disk dtype.
+        self.dtype = normalize_dtype(dtype)
         self.rank = rank
         self.device_list = list(device_list) if device_list else [rank]
         self.device_cache_size = parse_size(device_cache_size)
@@ -96,11 +107,12 @@ class Feature:
     def from_cpu_tensor(self, cpu_tensor) -> None:
         """Ingest the full feature table and tier it (reference
         feature.py:195-281)."""
-        arr = np.asarray(cpu_tensor, dtype=np.float32)
+        arr = np.asarray(cpu_tensor)
         if arr.ndim != 2:
             raise ValueError("features must be [N, D]")
+        arr = arr.astype(self.dtype, copy=False)
         self._n, self._dim = arr.shape
-        row_bytes = self._dim * 4
+        row_bytes = self._dim * self.dtype.itemsize
         cache_rows = min(self.device_cache_size // row_bytes, self._n)
 
         if self.csr_topo is not None and not self._local_order_applied:
@@ -115,7 +127,7 @@ class Feature:
             self.feature_order = order
             self.csr_topo.feature_order = order
 
-        st = ShardTensor(self.rank, ShardTensorConfig({}))
+        st = ShardTensor(self.rank, ShardTensorConfig({}), dtype=self.dtype)
         if self.cache_policy == "device_replicate":
             # hot prefix replicated per chip: each rank's Feature handle is
             # built with its own `rank` and stores its own replica, so this
@@ -154,10 +166,14 @@ class Feature:
         )
         n, d = mmap_array.shape
         self._n, self._dim = n, d
-        cache_rows = min(parse_size(device_config.device_cache_size) // (d * 4), n)
-        st = ShardTensor(self.rank, ShardTensorConfig({}))
+        cache_rows = min(
+            parse_size(device_config.device_cache_size) // (d * self.dtype.itemsize), n
+        )
+        st = ShardTensor(self.rank, ShardTensorConfig({}), dtype=self.dtype)
         if cache_rows > 0:
-            st.append(np.asarray(mmap_array[:cache_rows], dtype=np.float32), self.rank)
+            # cast on host BEFORE the device_put: uploading f32 then casting
+            # on device would double the bytes over the tunnel
+            st.append(np.asarray(mmap_array[:cache_rows]).astype(self.dtype), self.rank)
         if cache_rows < n:
             cold = mmap_array[cache_rows:]
             if isinstance(cold, np.memmap) or cold.dtype != np.float32:
@@ -280,9 +296,6 @@ class Feature:
     def size(self, axis: int) -> int:
         return self.shape[axis]
 
-    def dtype(self):
-        return jnp.float32
-
     def set_local_order(self, local_order) -> None:
         """Distributed local remap (reference feature.py:283-294): after
         cross-host partitioning, this host stores only its rows; map
@@ -305,6 +318,7 @@ class Feature:
             shard_ipc=None if self.shard_tensor is None else self.shard_tensor.share_ipc(),
             feature_order=self.feature_order,
             shape=(self._n, self._dim),
+            dtype=str(self.dtype),
         )
 
     @classmethod
@@ -314,6 +328,7 @@ class Feature:
             device_list=ipc_handle["device_list"],
             device_cache_size=ipc_handle["device_cache_size"],
             cache_policy=ipc_handle["cache_policy"],
+            dtype=ipc_handle.get("dtype", np.float32),
         )
         self._n, self._dim = ipc_handle["shape"]
         self.feature_order = ipc_handle["feature_order"]

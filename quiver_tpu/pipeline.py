@@ -86,11 +86,12 @@ class TieredFeaturePipeline:
             )
         self.feature = feature
         self.device = device or jax.local_devices()[0]
+        self.dtype = getattr(feature, "dtype", np.dtype(np.float32))
         if st.device_shards:
             _, self.hot_table, off = st.device_shards[0]
             self.hot_rows = off.end - off.start
         else:
-            self.hot_table = jnp.zeros((0, feature.dim), jnp.float32, device=self.device)
+            self.hot_table = jnp.zeros((0, feature.dim), self.dtype, device=self.device)
             self.hot_rows = 0
         self.cold_np = st.cpu_tensor  # may be None (fully resident)
         self._order = feature.feature_order  # old id -> stored row (or None)
@@ -116,7 +117,7 @@ class TieredFeaturePipeline:
             mapped_dev = jax.device_put(mapped, self.device)
             self.rows_seen += W
             if self.cold_np is None:
-                cold_rows = jnp.zeros((0, self.feature.dim), jnp.float32, device=self.device)
+                cold_rows = jnp.zeros((0, self.feature.dim), self.dtype, device=self.device)
                 cold_pos = jnp.zeros((0,), jnp.int32, device=self.device)
                 return mapped_dev, cold_rows, cold_pos
             (cold_sel,) = np.nonzero(mapped >= self.hot_rows)
@@ -124,7 +125,7 @@ class TieredFeaturePipeline:
             b = round_up_pow2(max(cold_sel.shape[0], 1), floor=256)
             pos = np.full(b, W, np.int32)  # W == out-of-range -> dropped
             pos[: cold_sel.shape[0]] = cold_sel
-            rows = np.zeros((b, self.feature.dim), np.float32)
+            rows = np.zeros((b, self.feature.dim), self.dtype)
             if cold_sel.size:
                 with trace_scope("pipeline.cold_gather"):
                     rows[: cold_sel.size] = self._gather(

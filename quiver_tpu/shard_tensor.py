@@ -38,6 +38,12 @@ from .ops import cpu_kernels
 CPU_DEVICE = -1  # reference uses device == -1 for the pinned-CPU shard
 
 
+def normalize_dtype(dtype) -> np.dtype:
+    """One dtype-spelling normalizer for every tiered store ("bfloat16"
+    strings resolve through jnp since numpy may not register the name)."""
+    return np.dtype(jnp.bfloat16) if str(dtype) == "bfloat16" else np.dtype(dtype)
+
+
 @dataclass
 class Offset:
     """Row range [start, end) owned by one shard (reference shard_tensor.py:7)."""
@@ -100,9 +106,17 @@ class ShardTensor:
     same layout).
     """
 
-    def __init__(self, current_device: int = 0, shard_tensor_config: Optional[ShardTensorConfig] = None):
+    def __init__(
+        self,
+        current_device: int = 0,
+        shard_tensor_config: Optional[ShardTensorConfig] = None,
+        dtype=np.float32,
+    ):
         self.current_device = current_device
         self.config = shard_tensor_config or ShardTensorConfig({})
+        # bfloat16 halves every tier (2x the hot rows per HBM byte); the
+        # reference is float32-only (quiver_feature.cu:65-69)
+        self.dtype = normalize_dtype(dtype)
         self.device_shards: List[tuple] = []  # (device_rank, jax.Array, Offset)
         self.cpu_tensor: Optional[np.ndarray] = None
         self.cpu_offset: Optional[Offset] = None
@@ -124,12 +138,14 @@ class ShardTensor:
         if device == CPU_DEVICE:
             if self.cpu_tensor is not None:
                 raise ValueError("host shard already set")
-            self.cpu_tensor = np.ascontiguousarray(arr, dtype=np.float32)
+            self.cpu_tensor = np.ascontiguousarray(arr.astype(self.dtype, copy=False))
             self.cpu_offset = off
         else:
             if self.cpu_tensor is not None:
                 raise ValueError("device shards must precede the host shard")
-            dev_arr = jax.device_put(jnp.asarray(arr, jnp.float32), _device_of(device))
+            dev_arr = jax.device_put(
+                jnp.asarray(arr).astype(self.dtype), _device_of(device)
+            )
             self.device_shards.append((device, dev_arr, off))
         self._n_rows = off.end
 
@@ -139,12 +155,13 @@ class ShardTensor:
         tensor,
         shard_tensor_config: ShardTensorConfig,
         current_device: int = 0,
+        dtype=np.float32,
     ) -> "ShardTensor":
         """Budget-based split across device HBM shards + host tail
         (reference from_cpu_tensor, shard_tensor.py:108-136)."""
-        self = cls(current_device, shard_tensor_config)
-        arr = np.asarray(tensor, dtype=np.float32)
-        row_bytes = arr.shape[1] * 4
+        self = cls(current_device, shard_tensor_config, dtype=dtype)
+        arr = np.asarray(tensor)
+        row_bytes = arr.shape[1] * self.dtype.itemsize
         cursor = 0
         for dev in self.config.device_list:
             budget = self.config.device_memory_budget[dev]
@@ -186,7 +203,7 @@ class ShardTensor:
         ids_np = np.asarray(ids).astype(np.int64).reshape(-1)
         n = ids_np.shape[0]
         target = _device_of(self.current_device)
-        out = jnp.zeros((n, self._dim), jnp.float32, device=target)
+        out = jnp.zeros((n, self._dim), self.dtype, device=target)
 
         def pad_sel(sel: np.ndarray, local: np.ndarray, pad_id: int):
             # pow2-bucketed padding; padded scatter positions point past the
@@ -215,7 +232,7 @@ class ShardTensor:
                 b = _bucket(sel.shape[0])
                 pos = np.full(b, n, np.int32)
                 pos[: sel.shape[0]] = sel
-                rows_np = np.zeros((b, self._dim), np.float32)
+                rows_np = np.zeros((b, self._dim), self.dtype)
                 rows_np[: sel.size] = cpu_kernels.gather_rows(
                     self.cpu_tensor, ids_np[sel] - off.start
                 )
@@ -231,12 +248,12 @@ class ShardTensor:
             dict(device=d, array=np.asarray(t), offset=(o.start, o.end))
             for d, t, o in self.device_shards
         ]
-        return items, self.cpu_tensor, self.config
+        return items, self.cpu_tensor, self.config, str(self.dtype)
 
     @classmethod
     def new_from_share_ipc(cls, ipc_handle, current_device: int = 0) -> "ShardTensor":
-        items, cpu_tensor, config = ipc_handle
-        self = cls(current_device, config)
+        items, cpu_tensor, config, *rest = ipc_handle
+        self = cls(current_device, config, dtype=rest[0] if rest else np.float32)
         for item in items:
             self.append(item["array"], item["device"])
         if cpu_tensor is not None:

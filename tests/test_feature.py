@@ -135,6 +135,36 @@ def test_feature_from_mmap(tmp_path, table):
     np.testing.assert_allclose(np.asarray(feat[ids]), table[ids])
 
 
+def test_feature_bfloat16_tiers(table):
+    # bfloat16 halves every in-memory tier: same cache BYTES hold 2x rows,
+    # lookups return bf16 within rounding of the f32 source
+    import jax.numpy as jnp
+
+    cache_bytes = 100 * 16 * 4  # 100 f32 rows worth of bytes
+    f32 = Feature(rank=0, device_list=[0], device_cache_size=cache_bytes)
+    f32.from_cpu_tensor(table)
+    bf16 = Feature(rank=0, device_list=[0], device_cache_size=cache_bytes,
+                   dtype="bfloat16")
+    bf16.from_cpu_tensor(table)
+    assert f32.shard_tensor.device_shards[0][2].end == 100
+    assert bf16.shard_tensor.device_shards[0][2].end == 200  # 2x rows hot
+    assert bf16.shard_tensor.device_shards[0][1].dtype == jnp.bfloat16
+
+    ids = np.array([0, 150, 250, 499])  # hot + cold mix
+    got = np.asarray(bf16[ids]).astype(np.float32)
+    np.testing.assert_allclose(got, table[ids], rtol=1e-2, atol=1e-2)
+
+    # prefetch pipeline works in bf16 end to end
+    from quiver_tpu.pipeline import TieredFeaturePipeline, tiered_lookup
+
+    pipe = TieredFeaturePipeline(bf16)
+    mapped, cold_rows, cold_pos = pipe.prepare(np.array([5, 450, 499]))
+    out = np.asarray(
+        tiered_lookup(pipe.hot_table, mapped, cold_rows, cold_pos)
+    ).astype(np.float32)
+    np.testing.assert_allclose(out, table[[5, 450, 499]], rtol=1e-2, atol=1e-2)
+
+
 def test_feature_set_mmap_file(tmp_path, table):
     # reference feature.py:84-93 + disk-mask merge (feature.py:309-333):
     # the first 100 rows are cached in memory, the rest live on disk only
