@@ -208,7 +208,11 @@ def bench_feature(context, table_dev, iters=10, batch=262_144):
     hot_gbps = iters * batch * dim * 4 / dt / 1e9
     log(f"feature hot HBM: {hot_gbps:.2f} GB/s ({iters} gathers in {dt:.3f}s)")
     context["feature_hot_gbps"] = round(hot_gbps, 2)
+    context["feature_hot_mrows_per_s"] = round(iters * batch / dt / 1e6, 1)
     context["feature_hot_vs_ref_20pct"] = round(hot_gbps / BASELINE_FEAT_GBPS, 2)
+    # TPU row gathers are DMA-descriptor-rate bound (~20M rows/s; see
+    # PERF_NOTES.md) — the e2e epoch number below is the meaningful
+    # comparison, since the fused pipeline needs fewer row-gathers total
 
     # --- tiered 20% through the real prefetch pipeline. Host-side table is
     # generated fresh (pulling the device table back over the tunnel costs
@@ -377,9 +381,15 @@ def main():
     indptr_np, indices_np = build_graph(n_nodes=n_nodes)
     # graph arrays are jit ARGUMENTS, not closure constants: embedding a
     # 61M-element array as an XLA constant costs ~2 minutes of compile
+    t0 = time.time()
     indptr = jax.device_put(jnp.asarray(indptr_np.astype(np.int32)))
     indices = jax.device_put(jnp.asarray(indices_np.astype(np.int32)))
-    log(f"devices: {jax.devices()}")
+    # sync the ~0.5 GB H2D here: device_put is async, and letting the first
+    # timed call absorb it misattributes transfer time as compile time.
+    # Dependent value fetches on BOTH arrays — block_until_ready can return
+    # early through the tunnel (PERF_NOTES.md)
+    int(indptr[-1]), int(indices[-1])
+    log(f"devices: {jax.devices()} (graph H2D {time.time()-t0:.1f}s)")
 
     rng = np.random.default_rng(1)
     seeds_all = jax.device_put(
