@@ -40,6 +40,10 @@ def partition_feature_without_replication(
     ``GraphSageSampler.sample_prob``), each [N].
 
     Returns (per-partition id arrays, partition_book [N] -> partition).
+    The per-partition arrays are HEAT-ordered (hot nodes first — useful for
+    cache-prefix placement); sort them ascending before use as a
+    ``set_local_order``/``PartitionInfo`` local_order, whose rank space is
+    ascending-id (reference feature.py:484-508).
     """
     probs = [np.asarray(p, dtype=np.float64) for p in probs]
     n_parts = len(probs)
