@@ -1,0 +1,65 @@
+"""Export an OGB node-property dataset to the quiver_tpu .npz interchange.
+
+Run this anywhere the `ogb` package is installed (it is NOT required by
+quiver_tpu itself); copy the resulting .npz next to the TPU job and point
+the examples at it:
+
+    python scripts/export_ogb.py --name ogbn-products --out products.npz
+    python examples/reddit_sage.py --dataset products.npz --sizes 15,10,5
+
+The export symmetrizes the edge list (products/reddit are undirected; the
+reference samples the symmetrized CSR) and stores train/valid/test splits.
+Format consumed by `quiver_tpu.datasets.load_npz`:
+{edge_index [2,E] int64, features [N,D] float32, labels [N] int,
+ train_idx, valid_idx, test_idx}.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", default="ogbn-products")
+    ap.add_argument("--root", default="dataset", help="ogb download dir")
+    ap.add_argument("--out", required=True, help="output .npz path")
+    ap.add_argument("--no-symmetrize", action="store_true")
+    args = ap.parse_args()
+
+    from ogb.nodeproppred import NodePropPredDataset  # external, not baked in
+
+    ds = NodePropPredDataset(name=args.name, root=args.root)
+    graph, labels = ds[0]
+    split = ds.get_idx_split()
+
+    edge_index = np.asarray(graph["edge_index"], dtype=np.int64)
+    if not args.no_symmetrize:
+        edge_index = np.concatenate([edge_index, edge_index[::-1]], axis=1)
+    features = np.asarray(graph["node_feat"], dtype=np.float32)
+    labels = np.asarray(labels).reshape(-1).astype(np.int32)
+
+    import sys
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from quiver_tpu.datasets import save_npz
+
+    save_npz(
+        args.out,
+        edge_index=edge_index,
+        features=features,
+        labels=labels,
+        train_idx=np.asarray(split["train"], dtype=np.int64),
+        valid_idx=np.asarray(split["valid"], dtype=np.int64),
+        test_idx=np.asarray(split["test"], dtype=np.int64),
+    )
+    print(
+        f"wrote {args.out}: {features.shape[0]} nodes, "
+        f"{edge_index.shape[1]} edges, {features.shape[1]} dims, "
+        f"{int(labels.max()) + 1} classes"
+    )
+
+
+if __name__ == "__main__":
+    main()
