@@ -46,6 +46,8 @@ def main():
     ap.add_argument("--sizes", default="15,10,5")
     ap.add_argument("--steps-per-epoch", type=int, default=0, help="0 = full epoch")
     ap.add_argument("--pipeline", default="dedup", choices=["dedup", "fused"])
+    ap.add_argument("--hosts", type=int, default=0,
+                    help="add a DCN host axis: (host, dp, ici) mesh")
     args = ap.parse_args()
 
     import jax
@@ -72,9 +74,15 @@ def main():
     feat = rng.standard_normal((n, args.dim)).astype(np.float32)
     labels = rng.integers(0, args.classes, n).astype(np.int32)
 
-    mesh = make_mesh()
-    dp = mesh.shape["dp"]
-    print(f"mesh: dp={dp} ici={mesh.shape['ici']} ({mesh.devices.size} devices)")
+    mesh = make_mesh(hosts=args.hosts or None)
+    from quiver_tpu.parallel import mesh_axes
+
+    data_axes, _, dp = mesh_axes(mesh)
+    data_spec = P(data_axes)
+    print(
+        f"mesh: {dict(mesh.shape)} ({mesh.devices.size} devices), "
+        f"{dp} data-parallel groups"
+    )
 
     sizes = tuple(int(s) for s in args.sizes.split(","))
     model = GraphSAGE(
@@ -109,7 +117,7 @@ def main():
         for i in range(steps_per_epoch):
             seeds = jax.device_put(
                 jnp.asarray(rng.integers(0, n, batch_global).astype(np.int32)),
-                NamedSharding(mesh, P("dp")),
+                NamedSharding(mesh, data_spec),
             )
             params, opt_state, loss = step(
                 params, opt_state, jax.random.key(epoch * 100000 + i),

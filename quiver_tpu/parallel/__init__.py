@@ -1,9 +1,15 @@
 """Multi-chip / multi-host parallelism over jax.sharding meshes."""
 
-from .collectives import pad_to_multiple, sharded_gather, sharded_gather_a2a
+from .collectives import (
+    pad_to_multiple,
+    sharded_gather,
+    sharded_gather_a2a,
+    sharded_gather_grouped,
+)
 from .train import (
     make_mesh,
     make_sharded_train_step,
+    mesh_axes,
     replicate,
     shard_feature_rows,
 )
@@ -11,9 +17,11 @@ from .train import (
 __all__ = [
     "make_mesh",
     "make_sharded_train_step",
+    "mesh_axes",
     "pad_to_multiple",
     "replicate",
     "shard_feature_rows",
     "sharded_gather",
     "sharded_gather_a2a",
+    "sharded_gather_grouped",
 ]

@@ -21,23 +21,53 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def sharded_gather(table_block: jax.Array, ids: jax.Array, axis_name: str) -> jax.Array:
+def sharded_gather(table_block: jax.Array, ids: jax.Array, axis_name) -> jax.Array:
     """Gather rows by *global* id from a row-sharded table.
 
-    table_block: this chip's ``[rows_per_shard, D]`` contiguous block (global
-    rows ``[idx*rows_per_shard, (idx+1)*rows_per_shard)``).
+    table_block: this chip's ``[rows_per_shard, D]`` contiguous block.
     ids: global row ids, any shape; identical across the axis (replicated).
+    axis_name: one mesh axis name, or a TUPLE of names when the table is
+    striped over several axes (e.g. ``("host", "ici")`` for a multi-host
+    shard — matching a ``P(("host", "ici"), None)`` sharding, whose dim-0
+    blocks are ordered major-to-minor across the named axes). The psum then
+    rides ICI within a host and DCN across hosts.
 
-    Returns full rows, replicated across the axis. Out-of-range ids (e.g.
-    padding sentinels) return zero rows.
+    Returns full rows, replicated across the axis/axes. Out-of-range ids
+    (e.g. padding sentinels) return zero rows.
     """
     rows_per_shard = table_block.shape[0]
-    idx = lax.axis_index(axis_name)
+    if isinstance(axis_name, str):
+        axes = (axis_name,)
+    else:
+        axes = tuple(axis_name)
+    # flat shard index, major-to-minor — the block order of P((a, b), ...)
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
     local = ids.astype(jnp.int32) - idx * rows_per_shard
     in_range = (local >= 0) & (local < rows_per_shard)
     rows = jnp.take(table_block, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
     rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))
-    return lax.psum(rows, axis_name)
+    return lax.psum(rows, axes)
+
+
+def sharded_gather_grouped(
+    table_block: jax.Array, ids: jax.Array, feat_axes, group_axis: str
+) -> jax.Array:
+    """`sharded_gather` for id lists that DIFFER across ``group_axis`` (one
+    of the table's striping axes, typically "host").
+
+    `sharded_gather` requires ids identical across every psum axis; when
+    data-parallel groups span the host axis, each host samples different
+    seeds, so the lists are first all_gathered over ``group_axis`` (making
+    them identical everywhere), gathered once for all groups, and each
+    group slices its own answer. Costs ``axis_size(group_axis)`` x the
+    gather rows — the naive-stripe price; a targeted id exchange (the
+    comm.exchange pattern) is the optimization path.
+    """
+    all_ids = lax.all_gather(ids, group_axis)  # identical across group_axis
+    rows = sharded_gather(table_block, all_ids, feat_axes)
+    return rows[lax.axis_index(group_axis)]
 
 
 def sharded_gather_a2a(

@@ -24,6 +24,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -33,12 +34,23 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_fn
 
 from ..pyg.sage_sampler import sample_and_gather_fused, sample_dense_pure
-from .collectives import sharded_gather
+from .collectives import sharded_gather, sharded_gather_grouped
 
 
-def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh:
+def make_mesh(
+    n_devices: Optional[int] = None,
+    dp: Optional[int] = None,
+    hosts: Optional[int] = None,
+) -> Mesh:
     """Build a (dp, ici) mesh over the first n local devices; ici gets the
-    largest power-of-two factor so the feature shard spans chips."""
+    largest power-of-two factor so the feature shard spans chips.
+
+    ``hosts`` adds a leading DCN axis: a (host, dp, ici) mesh where the
+    feature table stripes over (host, ici) and gradients psum over
+    (host, dp) — the papers100M-scale multi-host layout in one program
+    (on a real pod ``host`` maps to the inter-host dimension of
+    ``jax.devices()``; hermetically it is just more virtual devices).
+    """
     import numpy as np
 
     devs = jax.devices()
@@ -51,18 +63,39 @@ def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None) -> Mesh
             f'jax.config.update("jax_platforms", "cpu") before first jax use'
         )
     devs = np.array(devs[:n])
+    if hosts is not None:
+        if hosts <= 0 or n % hosts != 0:
+            raise ValueError(f"make_mesh: hosts={hosts} does not divide {n}")
+        per_host = n // hosts
+        inner = make_mesh_shape(per_host, dp)
+        return Mesh(devs.reshape(hosts, *inner), ("host", "dp", "ici"))
+    return Mesh(devs.reshape(make_mesh_shape(n, dp)), ("dp", "ici"))
+
+
+def make_mesh_shape(n: int, dp: Optional[int] = None) -> Tuple[int, int]:
+    """(dp, ici) factorization: ici takes the largest power-of-two factor."""
     if dp is None:
         dp = 1
-        while n % 2 == 0 and dp < n // 2:
+        m = n
+        while m % 2 == 0 and dp < m // 2:
             dp *= 2
-            n //= 2
-        n = len(devs) // dp
-    if dp <= 0 or len(devs) % dp != 0:
-        raise ValueError(
-            f"make_mesh: dp={dp} does not divide device count {len(devs)}"
-        )
-    ici = len(devs) // dp
-    return Mesh(devs.reshape(dp, ici), ("dp", "ici"))
+            m //= 2
+    if dp <= 0 or n % dp != 0:
+        raise ValueError(f"make_mesh: dp={dp} does not divide device count {n}")
+    return dp, n // dp
+
+
+def mesh_axes(mesh: Mesh) -> Tuple[Tuple[str, ...], Tuple[str, ...], int]:
+    """(data_axes, feature_axes, n_data_groups) for a quiver mesh — the ONE
+    place the (host?, dp, ici) layout conventions live: seeds/gradients span
+    ``data_axes``, the feature table stripes over ``feature_axes``."""
+    has_host = "host" in mesh.axis_names
+    data_axes = ("host", "dp") if has_host else ("dp",)
+    feat_axes = ("host", "ici") if has_host else ("ici",)
+    n_groups = 1
+    for a in data_axes:
+        n_groups *= mesh.shape[a]
+    return data_axes, feat_axes, n_groups
 
 
 def make_sharded_train_step(
@@ -97,22 +130,37 @@ def make_sharded_train_step(
             "caps only apply to the dedup pipeline: the fused layout is "
             "structural (width is exactly B*prod(1+k), not cappable)"
         )
+    # with a "host" DCN axis (make_mesh(hosts=...)), the feature table
+    # stripes over (host, ici) and gradients sync over (host, dp)
+    has_host = "host" in mesh.axis_names
+    data_axes, feat_axes, _ = mesh_axes(mesh)
+
+    def gather_rows(tab, ids):
+        # hosts sample DIFFERENT seeds, so the host axis needs the grouped
+        # gather (see sharded_gather_grouped: all_gather ids over host,
+        # gather once, slice own answer)
+        if not has_host:
+            return sharded_gather(tab, ids, feat_axes)
+        return sharded_gather_grouped(tab, ids, feat_axes, "host")
 
     def step_local(params, opt_state, key, indptr, indices, feat_block, labels, seeds):
         dp_idx = lax.axis_index("dp")
-        # distinct sample stream per dp group, identical within an ici group
+        if has_host:
+            dp_idx = lax.axis_index("host") * lax.axis_size("dp") + dp_idx
+        # distinct sample stream per data-parallel group, identical within
+        # an ici group
         key = jax.random.fold_in(key, dp_idx)
         key, dropout_key = jax.random.split(key)
         if pipeline == "fused":
             ds, x = sample_and_gather_fused(
                 indptr, indices, feat_block, key, seeds, tuple(sizes),
-                gather_fn=lambda tab, ids: sharded_gather(tab, ids, "ici"),
+                gather_fn=gather_rows,
             )
         else:
             ds = sample_dense_pure(indptr, indices, key, seeds, tuple(sizes), caps)
-            # hot rows are striped across the ici axis (replicated over dp);
-            # one psum over ICI assembles full rows for this dp group's n_id
-            x = sharded_gather(feat_block, ds.n_id, "ici")
+            # hot rows are striped across the feature axes (replicated over
+            # dp); one psum assembles full rows for this group's n_id
+            x = gather_rows(feat_block, ds.n_id)
         y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
 
         def objective(p):
@@ -125,10 +173,10 @@ def make_sharded_train_step(
             return nll.mean()
 
         loss, grads = jax.value_and_grad(objective)(params)
-        grads = lax.pmean(grads, "dp")
-        loss = lax.pmean(loss, "dp")
+        grads = lax.pmean(grads, data_axes)
+        loss = lax.pmean(loss, data_axes)
         updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     sharded = _shard_map_fn(
@@ -140,9 +188,9 @@ def make_sharded_train_step(
             P(),            # rng key
             P(),            # indptr
             P(),            # indices
-            P("ici", None),  # hot feature rows striped over the ici axis
+            P(feat_axes, None),  # hot feature rows striped over (host?,) ici
             P(),            # labels
-            P("dp"),        # seeds
+            P(data_axes),   # seeds sharded over (host?,) dp
         ),
         out_specs=(P(), P(), P()),
         check_vma=False,
@@ -151,13 +199,17 @@ def make_sharded_train_step(
 
 
 def shard_feature_rows(mesh: Mesh, table) -> jax.Array:
-    """Place a [N, D] host table row-striped over the ici axis (replicated
-    over dp); pads N to a multiple of the ici size."""
+    """Place a [N, D] host table row-striped over the feature axes — ici,
+    plus host when the mesh has the DCN axis (replicated over dp); pads N
+    to a multiple of the shard count."""
     from .collectives import pad_to_multiple
 
-    ici = mesh.shape["ici"]
-    padded = pad_to_multiple(table, ici)
-    sharding = NamedSharding(mesh, P("ici", None))
+    _, feat_axes, _ = mesh_axes(mesh)
+    shards = 1
+    for a in feat_axes:
+        shards *= mesh.shape[a]
+    padded = pad_to_multiple(table, shards)
+    sharding = NamedSharding(mesh, P(feat_axes, None))
     return jax.device_put(jnp.asarray(padded), sharding)
 
 
