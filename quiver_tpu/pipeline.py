@@ -167,14 +167,16 @@ class TrainPipeline:
         self.depth = max(depth, 1)
         self.stats = PipelineStats()
 
-    def _stage(self, seeds: np.ndarray) -> TieredBatch:
-        ds = self.sampler.sample_dense(seeds)
+    def _stage_ds(self, ds: DenseSample, seeds=None) -> TieredBatch:
         before = self.tiered.cold_rows_seen
         mapped, cold_rows, cold_pos = self.tiered.prepare(ds.n_id)
         cold = self.tiered.cold_rows_seen - before
         self.stats.batches += 1
         self.stats.cold_rows += cold
         self.stats.hot_rows += int(mapped.shape[0]) - cold
+        if seeds is None:
+            # the seed batch is always the n_id prefix (both pipelines)
+            seeds = np.asarray(ds.n_id)[: ds.batch_size]
         return TieredBatch(
             ds=ds,
             mapped=mapped,
@@ -183,6 +185,9 @@ class TrainPipeline:
             seeds=jnp.asarray(np.asarray(seeds), jnp.int32),
         )
 
+    def _stage(self, seeds: np.ndarray) -> TieredBatch:
+        return self._stage_ds(self.sampler.sample_dense(seeds), seeds)
+
     def run_epoch(
         self,
         seed_batches: Sequence[np.ndarray],
@@ -190,14 +195,41 @@ class TrainPipeline:
         opt_state,
         key: jax.Array,
     ):
-        """Run one epoch; returns (params, opt_state, losses list)."""
+        """Run one epoch over seed batches; returns (params, opt_state,
+        losses list). Sampling + tiered prep for batch i+1 run in the
+        prefetch thread while the device steps batch i."""
+        return self._run(
+            (self._stage(s) for s in seed_batches), params, opt_state, key
+        )
+
+    def run_epoch_iter(self, samples, params, opt_state, key: jax.Array):
+        """Train over an iterator of :class:`DenseSample`s — e.g. a
+        `MixedGraphSageSampler` epoch, whose CPU worker processes then
+        overlap with BOTH the cold-tier prefetch and the device steps.
+        Accepts bare DenseSamples or the mixed sampler's
+        ``(task_idx, DenseSample)`` pairs. All samples must share one padded
+        shape (same sizes/batch/caps) so the step program is reused."""
+
+        def staged():
+            for item in samples:
+                # NB DenseSample is itself a (named) tuple — check it first
+                ds = item if isinstance(item, DenseSample) else item[1]
+                yield self._stage_ds(ds)
+
+        return self._run(staged(), params, opt_state, key)
+
+    def _run(self, batches, params, opt_state, key: jax.Array):
+        """The double-buffered loop: the generator's work (sampling, cold
+        gather, H2D enqueue) happens inside the prefetch thread's next()."""
+        it = iter(batches)
         losses = []
         with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-            fut = pool.submit(self._stage, seed_batches[0])
-            for i in range(len(seed_batches)):
+            fut = pool.submit(next, it, None)
+            while True:
                 batch = fut.result()
-                if i + 1 < len(seed_batches):
-                    fut = pool.submit(self._stage, seed_batches[i + 1])
+                if batch is None:
+                    break
+                fut = pool.submit(next, it, None)
                 key, sub = jax.random.split(key)
                 params, opt_state, loss = self.step_fn(params, opt_state, sub, batch)
                 losses.append(loss)

@@ -69,6 +69,44 @@ def test_prepare_matches_eager_feature():
     np.testing.assert_allclose(out, expect, rtol=1e-6)
 
 
+def test_pipeline_consumes_mixed_sampler():
+    """The hybrid device+CPU sampler feeds the tiered train pipeline: its
+    worker processes overlap with the prefetch thread and device steps."""
+    from quiver_tpu.pyg.mixed_sampler import MixedGraphSageSampler, TrainSampleJob
+
+    edge_index, feat, labels, n = community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    f = Feature(rank=0, device_list=[0], device_cache_size=(n // 2) * 16 * 4,
+                cache_policy="device_replicate", csr_topo=topo)
+    f.from_cpu_tensor(feat)
+    job = TrainSampleJob(np.arange(n), batch_size=32, seed=0)
+    mixed = MixedGraphSageSampler(
+        job, csr_topo=topo, sizes=[5, 5], num_workers=1, mode="TPU_CPU_MIXED"
+    )
+
+    model = GraphSAGE(hidden_dim=32, out_dim=4, num_layers=2, dropout=0.0)
+    tx = optax.adam(5e-3)
+    pipe = TieredFeaturePipeline(f)
+    step_fn = make_tiered_train_step(model, tx, jnp.asarray(labels), pipe.hot_table)
+
+    # bootstrap shapes from a plain sampler with the same config
+    boot = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=1)
+    ds0 = boot.sample_dense(np.arange(32))
+    x0 = jnp.zeros((ds0.n_id.shape[0], feat.shape[1]), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    opt_state = tx.init(params)
+
+    tp = TrainPipeline(boot, f, step_fn)
+    try:
+        params, opt_state, losses = tp.run_epoch_iter(
+            mixed, params, opt_state, jax.random.key(1)
+        )
+    finally:
+        mixed.shutdown()
+    assert len(losses) == len(job)
+    assert all(np.isfinite(losses))
+
+
 def test_train_pipeline_learns_and_prefetches():
     edge_index, feat, labels, n = community_graph()
     topo = CSRTopo(edge_index=edge_index)
