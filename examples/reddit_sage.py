@@ -60,6 +60,9 @@ def main():
     ap.add_argument("--dim", type=int, default=64, help="synthetic feature dim")
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--model", default="sage", choices=["sage", "gat"],
+                    help="gat mirrors the reference's reddit GAT example "
+                         "(dist_sampling_reddit_gat.py)")
     args = ap.parse_args()
 
     import jax
@@ -93,7 +96,17 @@ def main():
     )
     feature.from_cpu_tensor(feat)
 
-    model = GraphSAGE(hidden_dim=args.hidden, out_dim=ncls, num_layers=len(sizes), dropout=0.5)
+    if args.model == "gat":
+        from quiver_tpu.models import GAT
+
+        model = GAT(
+            hidden_dim=args.hidden, out_dim=ncls, heads=4,
+            num_layers=len(sizes), dropout=0.5,
+        )
+    else:
+        model = GraphSAGE(
+            hidden_dim=args.hidden, out_dim=ncls, num_layers=len(sizes), dropout=0.5
+        )
     tx = optax.adam(args.lr)
     params = opt_state = None
 
