@@ -1,0 +1,53 @@
+"""Drop-in ``import quiver`` alias for quiver_tpu.
+
+Reference training scripts are written against ``import quiver`` /
+``from quiver.pyg import GraphSageSampler`` / ``import
+quiver.multiprocessing`` (srcs/python/quiver/__init__.py:2-17). This alias
+package lets those scripts run against the TPU engine unchanged: the full
+quiver_tpu surface is re-exported, and a meta-path finder resolves ANY
+``quiver.<path>`` import — at any depth — to the very same module object as
+``quiver_tpu.<path>`` (no duplicate module execution, class identity
+preserved).
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+from quiver_tpu import *  # noqa: F401,F403 — the drop-in surface
+from quiver_tpu import __all__, __version__  # noqa: F401
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Hands the import machinery the REAL quiver_tpu module object, so
+    ``quiver.x.y`` IS ``quiver_tpu.x.y`` (one module, one execution)."""
+
+    def __init__(self, real_name: str):
+        self._real_name = real_name
+
+    def create_module(self, spec):
+        return importlib.import_module(self._real_name)
+
+    def exec_module(self, module):  # already executed as quiver_tpu.*
+        pass
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname == "quiver" or not fullname.startswith("quiver."):
+            return None
+        real = "quiver_tpu." + fullname.split(".", 1)[1]
+        try:
+            if importlib.util.find_spec(real) is None:
+                return None
+        except (ImportError, ModuleNotFoundError):
+            return None
+        return importlib.util.spec_from_loader(fullname, _AliasLoader(real))
+
+
+# FIRST in meta_path: the shared parent modules keep their real __path__,
+# so the default PathFinder would otherwise re-load quiver.<pkg>.<mod> from
+# the file as a duplicate module (splitting class identity) before this
+# finder is ever consulted
+sys.meta_path.insert(0, _AliasFinder())

@@ -24,7 +24,7 @@ setup(
     name="quiver-tpu",
     version="0.1.0",
     description="TPU-native graph-learning data engine (torch-quiver capabilities on JAX/XLA/Pallas)",
-    packages=find_packages(include=["quiver_tpu", "quiver_tpu.*"]),
+    packages=find_packages(include=["quiver_tpu", "quiver_tpu.*", "quiver"]),
     package_data={"quiver_tpu": ["csrc/*.so", "csrc/*.cpp", "csrc/Makefile"]},
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "numpy"],
