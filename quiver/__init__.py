@@ -25,12 +25,23 @@ class _AliasLoader(importlib.abc.Loader):
 
     def __init__(self, real_name: str):
         self._real_name = real_name
+        self._orig_spec = None
+        self._orig_loader = None
 
     def create_module(self, spec):
-        return importlib.import_module(self._real_name)
+        mod = importlib.import_module(self._real_name)
+        # the import machinery is about to stamp the alias spec/loader onto
+        # this (shared!) module object; remember the real identity so
+        # exec_module can restore it — otherwise importlib.reload and
+        # __spec__ introspection on quiver_tpu.* break after any quiver.*
+        # import, and relative imports warn (__package__ != __spec__.parent)
+        self._orig_spec = mod.__spec__
+        self._orig_loader = getattr(mod, "__loader__", None)
+        return mod
 
     def exec_module(self, module):  # already executed as quiver_tpu.*
-        pass
+        module.__spec__ = self._orig_spec
+        module.__loader__ = self._orig_loader
 
 
 class _AliasFinder(importlib.abc.MetaPathFinder):

@@ -38,6 +38,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 ID_PAD = np.int64(-1)
 
 
+def _ids_to_int32(arr: np.ndarray) -> np.ndarray:
+    """The exchange collective ships int32 row ids; reject >= 2^31 loudly
+    instead of wrapping into wrong (negative -> dropped) rows."""
+    arr = np.asarray(arr)
+    if arr.size and int(arr.max()) >= 2**31:
+        raise ValueError(
+            f"exchange ids must be owner-LOCAL row indices < 2^31 "
+            f"(got max {int(arr.max())}); the collective ships int32 — "
+            f"split the per-host table below 2^31 rows"
+        )
+    return arr.astype(np.int32, copy=False)
+
+
 class HostRankTable:
     """global rank <-> (host, local rank) mapping (reference comm.py:5-39)."""
 
@@ -163,7 +176,7 @@ def exchange_all(
     """
     h = mesh.shape[axis]
     req = jax.device_put(
-        jnp.asarray(np.asarray(requests, np.int32)), NamedSharding(mesh, P(axis))
+        jnp.asarray(_ids_to_int32(requests)), NamedSharding(mesh, P(axis))
     )
     tab = jax.device_put(jnp.asarray(tables, jnp.float32), NamedSharding(mesh, P(axis)))
     assert req.shape[0] == h and tab.shape[0] == h
@@ -271,7 +284,7 @@ class TpuComm:
             )
         sharding = NamedSharding(self.mesh, P(self.axis))
         req = jax.make_array_from_process_local_data(
-            sharding, np.asarray(req_mine, np.int32)
+            sharding, _ids_to_int32(req_mine)
         )
         # the table is invariant across exchanges: shard it onto the mesh
         # ONCE (mirrors the single-controller _tables_for_exchange cache;

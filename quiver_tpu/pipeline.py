@@ -116,21 +116,27 @@ class TieredFeaturePipeline:
             mapped = np.where(invalid, -1, mapped).astype(np.int32)
             mapped_dev = jax.device_put(mapped, self.device)
             self.rows_seen += W
-            if self.cold_np is None:
+            def _no_cold():
                 cold_rows = jnp.zeros((0, self.feature.dim), self.dtype, device=self.device)
                 cold_pos = jnp.zeros((0,), jnp.int32, device=self.device)
                 return mapped_dev, cold_rows, cold_pos
+
+            if self.cold_np is None:
+                return _no_cold()
             (cold_sel,) = np.nonzero(mapped >= self.hot_rows)
+            if cold_sel.size == 0:
+                # hot-dominated batch: skip the 256-row padded upload entirely
+                # (the step program already specializes on the 0-size shape)
+                return _no_cold()
             self.cold_rows_seen += int(cold_sel.shape[0])
-            b = round_up_pow2(max(cold_sel.shape[0], 1), floor=256)
+            b = round_up_pow2(cold_sel.shape[0], floor=256)
             pos = np.full(b, W, np.int32)  # W == out-of-range -> dropped
             pos[: cold_sel.shape[0]] = cold_sel
             rows = np.zeros((b, self.feature.dim), self.dtype)
-            if cold_sel.size:
-                with trace_scope("pipeline.cold_gather"):
-                    rows[: cold_sel.size] = self._gather(
-                        self.cold_np, mapped[cold_sel] - self.hot_rows
-                    )
+            with trace_scope("pipeline.cold_gather"):
+                rows[: cold_sel.size] = self._gather(
+                    self.cold_np, mapped[cold_sel] - self.hot_rows
+                )
             with trace_scope("pipeline.h2d"):
                 cold_rows = jax.device_put(rows, self.device)
                 cold_pos = jax.device_put(pos, self.device)

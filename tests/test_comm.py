@@ -168,3 +168,16 @@ def test_dist_feature_with_replication(host_mesh):
     dist = DistFeature(f, info, comm)
     ids = np.concatenate([remote, owned0[:3], np.nonzero(global2host == 2)[0][:3]])
     np.testing.assert_allclose(np.asarray(dist[ids]), full[ids], rtol=1e-6)
+
+
+def test_exchange_rejects_int64_overflow_ids(host_mesh):
+    # ADVICE r2: the exchange ships int32 row ids; ids >= 2^31 must fail
+    # loudly instead of wrapping into wrong (negative -> dropped) rows
+    from quiver_tpu.comm import exchange_all
+
+    h = host_mesh.shape["host"]
+    req = np.full((h, h, 4), -1, np.int64)
+    req[0, 0, 0] = 2**31 + 5
+    tables = np.zeros((h, 8, 3), np.float32)
+    with pytest.raises(ValueError, match="2\\^31"):
+        exchange_all(host_mesh, "host", req, tables)

@@ -64,3 +64,19 @@ def test_deep_imports_share_identity():
 
     with pytest.raises(ImportError):
         import quiver.definitely_not_a_module  # noqa: F401
+
+
+def test_alias_preserves_module_spec():
+    # ADVICE r2: the alias loader must NOT leave the quiver.* spec stamped on
+    # the shared module object — that breaks importlib.reload / introspection
+    # and trips "__package__ != __spec__.parent" on lazy relative imports
+    import quiver.utils as alias_mod
+    import quiver_tpu.utils as real_mod
+
+    assert alias_mod is real_mod
+    assert real_mod.__spec__ is not None
+    assert real_mod.__spec__.name == "quiver_tpu.utils"
+    assert real_mod.__package__ == real_mod.__spec__.parent
+    import importlib
+
+    importlib.reload(real_mod)  # must not raise
