@@ -5,7 +5,11 @@ from .sage_sampler import (
     DenseAdj,
     DenseSample,
     GraphSageSampler,
+    caps_from_counts,
     dense_to_pyg,
+    probe_hop_counts,
+    sample_and_gather_dedup,
+    sample_and_gather_fused,
     sample_dense_fused,
     sample_dense_pure,
 )
@@ -19,7 +23,11 @@ __all__ = [
     "MixedGraphSageSampler",
     "SampleJob",
     "TrainSampleJob",
+    "caps_from_counts",
     "dense_to_pyg",
+    "probe_hop_counts",
+    "sample_and_gather_dedup",
+    "sample_and_gather_fused",
     "sample_dense_fused",
     "sample_dense_pure",
 ]

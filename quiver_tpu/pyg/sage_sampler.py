@@ -38,6 +38,7 @@ from ..ops.sample import (
     pad_widths,
     sample_layer as _sample_layer_op,
     sample_prob as _sample_prob,
+    weighted_sample_layer as _weighted_sample_layer_op,
 )
 from ..ops.reindex import local_reindex
 
@@ -103,6 +104,7 @@ def sample_dense_fused(
     key: jax.Array,
     seeds: jax.Array,
     sizes: Tuple[int, ...],
+    sample_fn=None,
 ) -> DenseSample:
     """Fused multi-hop sample with NO per-layer dedup/reindex — the
     TPU-idiomatic hot path.
@@ -123,6 +125,9 @@ def sample_dense_fused(
     Use :func:`sample_dense_pure` when the unique-n_id contract matters
     (PyG-compat surface, cross-host dispatch).
     """
+    if sample_fn is None:
+        def sample_fn(cur, cur_valid, k, key):
+            return _sample_layer_op(indptr, indices, cur, cur_valid, k, key)
     B = seeds.shape[0]
     cur = seeds
     cur_valid = jnp.ones((B,), bool)
@@ -131,7 +136,7 @@ def sample_dense_fused(
     for k in sizes:
         key, sub = jax.random.split(key)
         w = cur.shape[0]
-        nbrs, valid = _sample_layer_op(indptr, indices, cur, cur_valid, k, sub)
+        nbrs, valid = sample_fn(cur, cur_valid, k, sub)
         # transposed flatten: a [big, tiny] row-major flatten costs ~40 s of
         # TPU compile (lane-tile relayout); [k, w] -> flat is free. Neighbor
         # (i, j) lands at n_id position w + j*w + i — the structural layout
@@ -152,6 +157,7 @@ def sample_and_gather_fused(
     seeds: jax.Array,
     sizes: Tuple[int, ...],
     gather_fn=None,
+    sample_fn=None,
 ) -> Tuple[DenseSample, jax.Array]:
     """Fused multi-hop sample with the FEATURE GATHER interleaved per hop.
 
@@ -168,10 +174,14 @@ def sample_and_gather_fused(
     the ICI collective per hop overlaps with sampling the same way.
     """
     B = seeds.shape[0]
-    n_rows = table.shape[0]
     if gather_fn is None:
+        n_rows = table.shape[0]
+
         def gather_fn(tab, ids):
             return jnp.take(tab, jnp.clip(ids, 0, n_rows - 1), axis=0)
+    if sample_fn is None:
+        def sample_fn(cur, cur_valid, k, key):
+            return _sample_layer_op(indptr, indices, cur, cur_valid, k, key)
     cur = seeds
     cur_valid = jnp.ones((B,), bool)
     adjs: List[DenseAdj] = []
@@ -180,7 +190,7 @@ def sample_and_gather_fused(
     for k in sizes:
         key, sub = jax.random.split(key)
         w = cur.shape[0]
-        nbrs, valid = _sample_layer_op(indptr, indices, cur, cur_valid, k, sub)
+        nbrs, valid = sample_fn(cur, cur_valid, k, sub)
         flat = nbrs.T.reshape(-1)
         xs.append(gather_fn(table, flat))
         n_id = jnp.concatenate([cur, flat])
@@ -192,6 +202,98 @@ def sample_and_gather_fused(
     return ds, jnp.concatenate(xs, axis=0)
 
 
+def sample_and_gather_dedup(
+    indptr: jax.Array,
+    indices: jax.Array,
+    table: jax.Array,
+    key: jax.Array,
+    seeds: jax.Array,
+    sizes: Tuple[int, ...],
+    caps: Optional[Tuple[Optional[int], ...]] = None,
+    gather_fn=None,
+    sample_fn=None,
+) -> Tuple[DenseSample, jax.Array]:
+    """Reference-parity dedup sampling with a STRUCTURAL last hop — the fast
+    formulation of the deduped e2e train step.
+
+    The sampling DAG is identical to `sample_dense_pure` (each hop draws k
+    neighbors of each node of the UNIQUE previous frontier — the reference's
+    hash-table reindex contract, sage_sampler.py:133-145): hops 1..L-1 run
+    dedup + sort-reindex exactly as `sample_dense_pure`. The LAST hop skips
+    the reindex: its leaves stay in the sampled ``[W_{L-1}, k]`` layout and
+    their feature rows are gathered straight from ``table`` into the
+    structural (cols=None) block. Per (target, slot) the sampled edge and
+    its feature row are exactly what the full-dedup pipeline feeds the
+    model, so model outputs match up to float association; what changes is
+    the data flow:
+
+    - the leaf aggregation becomes a slice+reshape (2.3x faster than the
+      equivalent take, PERF_NOTES.md) instead of a W_{L-1}*k_L-row gather
+      from computed activations;
+    - that gather's backward scatter disappears entirely — the structural
+      leaf rows read the CONSTANT feature table, so no gradient flows;
+    - the last (largest) reindex's sorts and the unique-leaf feature gather
+      are replaced by one structural gather.
+
+    Net on products shapes: ~1.0M gathered rows/step vs ~1.6M for gathering
+    unique n_id + cols-aggregation. Returns ``(ds, x)``; ``ds.n_id`` is the
+    hop-(L-1) unique frontier followed by the structural leaf block (NOT
+    globally unique — this is the e2e-internal surface; the public sampler
+    contract lives in `sample_dense_pure`/`GraphSageSampler.sample`).
+    """
+    if len(sizes) == 0:
+        raise ValueError("sizes must name at least one hop")
+    if gather_fn is None:
+        n_rows = table.shape[0]
+
+        def gather_fn(tab, ids):
+            return jnp.take(tab, jnp.clip(ids, 0, n_rows - 1), axis=0)
+
+    if sample_fn is None:
+        def sample_fn(cur, cur_valid, k, key):
+            return _sample_layer_op(indptr, indices, cur, cur_valid, k, key)
+
+    B = seeds.shape[0]
+    inner_caps = None if caps is None else tuple(caps[: len(sizes) - 1])
+    widths = pad_widths(B, sizes[:-1], inner_caps)
+    cur = seeds
+    cur_valid = jnp.ones((B,), bool)
+    adjs: List[DenseAdj] = []
+    prev_count = jnp.asarray(B, jnp.int32)
+    for l, k in enumerate(sizes[:-1]):
+        key, sub = jax.random.split(key)
+        nbrs, valid = sample_fn(cur, cur_valid, k, sub)
+        res = local_reindex(cur, cur_valid, nbrs, valid)
+        n_id, count = res.n_id, res.count
+        local_nbrs, nbr_valid = res.local_nbrs, res.nbr_valid
+        if widths[l + 1] < n_id.shape[0]:
+            cap = widths[l + 1]
+            n_id = n_id[:cap]
+            count = jnp.minimum(count, cap)
+            nbr_valid = nbr_valid & (local_nbrs < cap)
+        adjs.append(
+            DenseAdj(cols=local_nbrs, mask=nbr_valid, n_src=count, n_dst=prev_count)
+        )
+        cur = n_id
+        cur_valid = jnp.arange(n_id.shape[0], dtype=jnp.int32) < count
+        prev_count = count
+    # last hop: structural leaves, features straight off the table
+    k = sizes[-1]
+    key, sub = jax.random.split(key)
+    nbrs, valid = sample_fn(cur, cur_valid, k, sub)
+    flat = nbrs.T.reshape(-1)  # leaf (i, j) -> position W + j*W + i
+    x = jnp.concatenate([gather_fn(table, cur), gather_fn(table, flat)], axis=0)
+    n_src = prev_count + valid.sum().astype(jnp.int32)
+    adjs.append(DenseAdj(cols=None, mask=valid, n_src=n_src, n_dst=prev_count))
+    ds = DenseSample(
+        n_id=jnp.concatenate([cur, flat]),
+        count=n_src,
+        batch_size=B,
+        adjs=tuple(adjs[::-1]),
+    )
+    return ds, x
+
+
 def sample_dense_pure(
     indptr: jax.Array,
     indices: jax.Array,
@@ -199,12 +301,21 @@ def sample_dense_pure(
     seeds: jax.Array,
     sizes: Tuple[int, ...],
     caps: Optional[Tuple[Optional[int], ...]] = None,
+    sample_fn=None,
 ) -> DenseSample:
     """Pure, jittable multi-hop sample (static ``sizes``/``caps``).
 
     The reference's per-layer loop (sage_sampler.py:133-145) with the ragged
     hash-table reindex replaced by the static-shape sort reindex.
+
+    ``sample_fn(cur, cur_valid, k, key) -> (nbrs, valid)`` overrides the
+    local one-hop op — e.g. the collective
+    `quiver_tpu.parallel.topology.sharded_sample_layer` when the CSR is
+    row-sharded across the mesh (``indptr``/``indices`` may then be None).
     """
+    if sample_fn is None:
+        def sample_fn(cur, cur_valid, k, key):
+            return _sample_layer_op(indptr, indices, cur, cur_valid, k, key)
     B = seeds.shape[0]
     widths = pad_widths(B, sizes, caps)
     cur = seeds
@@ -213,7 +324,7 @@ def sample_dense_pure(
     prev_count = jnp.asarray(B, jnp.int32)
     for l, k in enumerate(sizes):
         key, sub = jax.random.split(key)
-        nbrs, valid = _sample_layer_op(indptr, indices, cur, cur_valid, k, sub)
+        nbrs, valid = sample_fn(cur, cur_valid, k, sub)
         res = local_reindex(cur, cur_valid, nbrs, valid)
         n_id, count = res.n_id, res.count
         local_nbrs, nbr_valid = res.local_nbrs, res.nbr_valid
@@ -229,6 +340,88 @@ def sample_dense_pure(
         cur_valid = jnp.arange(n_id.shape[0], dtype=jnp.int32) < count
         prev_count = count
     return DenseSample(n_id=cur, count=prev_count, batch_size=B, adjs=tuple(adjs[::-1]))
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("sizes",))
+def _probe_hop_counts_scan(ip, ix, key0, batches, sizes):
+    def body(_, i):
+        ds = sample_dense_pure(
+            ip, ix, jax.random.fold_in(key0, i), batches[i], sizes
+        )
+        return None, jnp.stack([a.n_src for a in ds.adjs[::-1]])
+
+    _, counts = jax.lax.scan(
+        body, None, jnp.arange(batches.shape[0], dtype=jnp.int32)
+    )
+    return counts
+
+
+def probe_hop_counts(
+    indptr: jax.Array,
+    indices: jax.Array,
+    key: jax.Array,
+    seeds_all: jax.Array,
+    sizes: Tuple[int, ...],
+    sample_fn=None,
+) -> np.ndarray:
+    """Per-hop unique-frontier counts over ``m`` probe batches: ``[m, L]``.
+
+    One jitted scan over the UNCAPPED dedup pipeline — one dispatch total,
+    so probing is cheap even through a high-latency link (PERF_NOTES.md
+    measurement discipline). The default path reuses one module-level
+    compiled program across calls; a custom ``sample_fn`` (e.g. a weighted
+    sampler's one-hop op — caps MUST be calibrated under the distribution
+    they will serve) traces its own scan per call.
+    """
+    seeds_all = jnp.asarray(seeds_all)
+    if sample_fn is None:
+        return np.asarray(
+            _probe_hop_counts_scan(indptr, indices, key, seeds_all, tuple(sizes))
+        )
+
+    @jax.jit
+    def run(key0, batches):
+        def body(_, i):
+            ds = sample_dense_pure(
+                None, None, jax.random.fold_in(key0, i), batches[i],
+                tuple(sizes), sample_fn=sample_fn,
+            )
+            return None, jnp.stack([a.n_src for a in ds.adjs[::-1]])
+
+        _, counts = jax.lax.scan(
+            body, None, jnp.arange(batches.shape[0], dtype=jnp.int32)
+        )
+        return counts
+
+    return np.asarray(run(key, seeds_all))
+
+
+def caps_from_counts(
+    counts: np.ndarray,
+    batch: int,
+    sizes: Tuple[int, ...],
+    margin: float = 1.2,
+    granule: int = 4096,
+) -> Tuple[int, ...]:
+    """Static per-hop n_id caps from probed unique counts.
+
+    ``max`` over the probe batches x ``margin`` safety factor, rounded up to
+    ``granule`` (shape granularity keeps recompiles away when recalibrating),
+    clipped to the uncapped worst case ``B*prod(1+k)``. This is the policy
+    the round-2 bench hand-rolled (bench.py:275-286) promoted into the
+    library — the reference needs no caps (ragged CUDA shapes); static-shape
+    TPU pipelines do, so choosing them is the framework's job.
+    """
+    counts = np.asarray(counts).reshape(-1, len(sizes))
+    worst = pad_widths(batch, sizes)[1:]
+    caps = []
+    for l in range(len(sizes)):
+        need = int(np.max(counts[:, l])) * margin
+        caps.append(int(min(-(-need // granule) * granule, worst[l])))
+    return tuple(caps)
 
 
 class GraphSageSampler:
@@ -260,6 +453,8 @@ class GraphSageSampler:
         caps: Optional[Sequence[Optional[int]]] = None,
         seed: int = 0,
         dedup: bool = True,
+        weighted: bool = False,
+        max_deg: int = 512,
     ):
         mode = self.MODE_ALIASES.get(mode, mode)
         if mode not in ("TPU", "HOST", "CPU"):
@@ -270,9 +465,24 @@ class GraphSageSampler:
         self.mode = mode
         self.device = device
         self.dedup = dedup
+        self.weighted = weighted
+        self.max_deg = int(max_deg)
+        if weighted:
+            if csr_topo.edge_weights is None:
+                raise ValueError(
+                    "weighted=True needs CSRTopo(edge_weights=...) "
+                    "(per-edge weights aligned with the COO input)"
+                )
+            if mode != "TPU":
+                raise ValueError(
+                    "weighted sampling runs on the device engine only "
+                    "(mirrors the reference, where weight_sample is "
+                    "CUDA-only, cuda_random.cu.hpp:177-221); use mode='TPU'"
+                )
         self._seed = seed
         self._call = 0
         self._dev_arrays = None
+        self._w_dev = None
         if mode == "TPU":
             self.lazy_init_quiver()
         self._host_engine = None
@@ -301,6 +511,25 @@ class GraphSageSampler:
         self._call += 1
         return key
 
+    def _weighted_sample_fn(self):
+        """sample_fn closure routing one-hop draws through the weighted
+        (Gumbel top-k) op; None when this sampler is uniform."""
+        if not self.weighted:
+            return None
+        indptr, indices = self.lazy_init_quiver()
+        if self._w_dev is None:
+            self._w_dev = jnp.asarray(
+                np.asarray(self.csr_topo.edge_weights, np.float32)
+            )
+        w, max_deg = self._w_dev, self.max_deg
+
+        def sample_fn(cur, cur_valid, k, key):
+            return _weighted_sample_layer_op(
+                indptr, indices, w, cur, cur_valid, k, key, max_deg
+            )
+
+        return sample_fn
+
     # -- dense static-shape surface --------------------------------------
     def sample_dense(self, seeds) -> DenseSample:
         """Sample a padded, jittable mini-batch. TPU mode runs fully on
@@ -308,12 +537,15 @@ class GraphSageSampler:
         if self.mode == "TPU":
             indptr, indices = self.lazy_init_quiver()
             seeds = jnp.asarray(np.asarray(seeds), indices.dtype)
+            sample_fn = self._weighted_sample_fn()
             if not self.dedup:
                 return sample_dense_fused(
-                    indptr, indices, self._next_key(), seeds, self.sizes
+                    indptr, indices, self._next_key(), seeds, self.sizes,
+                    sample_fn=sample_fn,
                 )
             return sample_dense_pure(
-                indptr, indices, self._next_key(), seeds, self.sizes, self.caps
+                indptr, indices, self._next_key(), seeds, self.sizes, self.caps,
+                sample_fn=sample_fn,
             )
         return self._host_sample_dense(np.asarray(seeds))
 
@@ -353,7 +585,8 @@ class GraphSageSampler:
             indptr, indices = self.lazy_init_quiver()
             seeds = jnp.asarray(np.asarray(input_nodes), indices.dtype)
             ds = sample_dense_pure(
-                indptr, indices, self._next_key(), seeds, self.sizes, self.caps
+                indptr, indices, self._next_key(), seeds, self.sizes, self.caps,
+                sample_fn=self._weighted_sample_fn(),
             )
         else:
             ds = self.sample_dense(input_nodes)
@@ -365,9 +598,16 @@ class GraphSageSampler:
         if self.mode == "TPU":
             indptr, indices = self.lazy_init_quiver()
             seeds_d = jnp.asarray(np.asarray(seeds), indices.dtype)
-            nbrs, valid = _sample_layer_op(
-                indptr, indices, seeds_d, jnp.ones(seeds_d.shape, bool), size, self._next_key()
-            )
+            fn = self._weighted_sample_fn()
+            if fn is None:
+                nbrs, valid = _sample_layer_op(
+                    indptr, indices, seeds_d, jnp.ones(seeds_d.shape, bool), size,
+                    self._next_key(),
+                )
+            else:
+                nbrs, valid = fn(
+                    seeds_d, jnp.ones(seeds_d.shape, bool), size, self._next_key()
+                )
             nbrs, valid = np.asarray(nbrs), np.asarray(valid)
         else:
             eng = self._host()
@@ -400,6 +640,50 @@ class GraphSageSampler:
         cols = np.asarray(res.local_nbrs)[np.asarray(res.nbr_valid)]
         return n_id, rows, cols
 
+    # -- static-cap calibration (TPU-only concern; see caps_from_counts) --
+    def calibrate_caps(
+        self,
+        probe_seeds,
+        margin: float = 1.2,
+        granule: int = 4096,
+        set_caps: bool = True,
+    ) -> Tuple[int, ...]:
+        """Probe-batch calibration of the per-hop static n_id caps.
+
+        ``probe_seeds``: [m, B] array (or list of m same-length batches) of
+        representative seed batches — use >= 8 so the max is stable. Returns
+        the caps and (by default) installs them on this sampler. Persist
+        alongside other offline artifacts via
+        ``checkpoint.save_partition_artifacts(path, caps=np.asarray(caps))``.
+        """
+        batches = np.stack([np.asarray(b) for b in probe_seeds])
+        if batches.ndim != 2:
+            raise ValueError(f"probe_seeds must be [m, B]; got {batches.shape}")
+        if self.mode == "TPU":
+            indptr, indices = self.lazy_init_quiver()
+            counts = probe_hop_counts(
+                indptr, indices, self._next_key(),
+                jnp.asarray(batches.astype(np.dtype(indices.dtype))), self.sizes,
+                sample_fn=self._weighted_sample_fn(),
+            )
+        else:
+            rows = []
+            for b in batches:  # host engine: uncapped dense sample per batch
+                saved = self.caps
+                self.caps = None
+                try:
+                    ds = self._host_sample_dense(b)
+                finally:
+                    self.caps = saved
+                rows.append([int(a.n_src) for a in ds.adjs[::-1]])
+            counts = np.asarray(rows)
+        caps = caps_from_counts(
+            counts, batches.shape[1], self.sizes, margin=margin, granule=granule
+        )
+        if set_caps:
+            self.caps = caps
+        return caps
+
     # -- hot-probability propagation (reference sage_sampler.py:149-157) --
     def sample_prob(self, train_idx, total_node_count: int):
         indptr, indices = self.lazy_init_quiver() if self.mode == "TPU" else (
@@ -414,15 +698,15 @@ class GraphSageSampler:
     def share_ipc(self):
         return (
             self.csr_topo, self.sizes, self.device, self.mode, self.caps,
-            self._seed, self.dedup,
+            self._seed, self.dedup, self.weighted, self.max_deg,
         )
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
-        csr_topo, sizes, device, mode, caps, seed, dedup = ipc_handle
+        csr_topo, sizes, device, mode, caps, seed, dedup, weighted, max_deg = ipc_handle
         return cls(
             csr_topo, sizes, device=device, mode=mode, caps=caps, seed=seed,
-            dedup=dedup,
+            dedup=dedup, weighted=weighted, max_deg=max_deg,
         )
 
 

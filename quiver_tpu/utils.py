@@ -79,6 +79,7 @@ class CSRTopo:
         indices=None,
         eid=None,
         num_nodes: Optional[int] = None,
+        edge_weights=None,
     ):
         if edge_index is not None:
             edge_index = np.asarray(edge_index)
@@ -99,15 +100,37 @@ class CSRTopo:
             np.cumsum(counts, out=self.indptr[1:])
             self.indices = dst[order]
             self.eid = order.astype(np.int64)  # original edge id per CSR slot
+            # optional per-edge weights for the weighted sampler
+            # (reference quiver.cu.hpp:61-82); stored CSR-aligned
+            if edge_weights is None:
+                self.edge_weights = None
+            else:
+                ew = np.asarray(edge_weights, np.float32)
+                if ew.shape != src.shape:
+                    raise ValueError(
+                        f"edge_weights shape {ew.shape} != edge count "
+                        f"{src.shape} of edge_index"
+                    )
+                self.edge_weights = ew[order]
         elif indptr is not None and indices is not None:
             self.indptr = np.ascontiguousarray(np.asarray(indptr, dtype=np.int64))
             self.indices = np.ascontiguousarray(np.asarray(indices, dtype=np.int64))
             self.eid = None if eid is None else np.asarray(eid, dtype=np.int64)
+            self.edge_weights = (
+                None
+                if edge_weights is None
+                else np.asarray(edge_weights, np.float32)
+            )
             if num_nodes is not None and num_nodes + 1 > self.indptr.shape[0]:
                 pad = np.full(num_nodes + 1 - self.indptr.shape[0], self.indptr[-1])
                 self.indptr = np.concatenate([self.indptr, pad])
         else:
             raise ValueError("need edge_index or (indptr, indices)")
+        if self.edge_weights is not None and self.edge_weights.shape != self.indices.shape:
+            raise ValueError(
+                f"edge_weights shape {self.edge_weights.shape} != indices "
+                f"shape {self.indices.shape}"
+            )
         self._feature_order: Optional[np.ndarray] = None
         self._device_cache = None
 

@@ -136,3 +136,34 @@ def test_train_pipeline_learns_and_prefetches():
     assert tp.stats.cold_rows > 0
     # the community task is easy: loss should drop across the epoch
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def test_train_pipeline_depth2_matches_depth1():
+    """depth=2 stages two batches ahead (generator serialized by a lock);
+    same sampler seed + same key must give the same loss sequence as
+    depth=1, just with a deeper ready queue."""
+    edge_index, feat, labels, n = community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    f = Feature(rank=0, device_list=[0], device_cache_size=(n // 2) * feat.shape[1] * 4,
+                cache_policy="device_replicate", csr_topo=topo)
+    f.from_cpu_tensor(feat)
+    model = GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
+    tx = optax.adam(5e-3)
+    pipe = TieredFeaturePipeline(f)
+    step_fn = make_tiered_train_step(model, tx, jnp.asarray(labels), pipe.hot_table)
+
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, n, 32).astype(np.int64) for _ in range(8)]
+    boot = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=1)
+    ds0 = boot.sample_dense(batches[0])
+    x0 = jnp.zeros((ds0.n_id.shape[0], feat.shape[1]), jnp.float32)
+    params0 = model.init(jax.random.key(0), x0, ds0.adjs)
+    opt0 = tx.init(params0)
+
+    out = {}
+    for depth in (1, 2):
+        sampler = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=7)
+        tp = TrainPipeline(sampler, f, step_fn, depth=depth)
+        _, _, losses = tp.run_epoch(batches, params0, opt0, jax.random.key(1))
+        out[depth] = losses
+    np.testing.assert_allclose(out[1], out[2], rtol=1e-5)
