@@ -6,22 +6,40 @@ from .collectives import (
     sharded_gather_a2a,
     sharded_gather_grouped,
 )
+from .topology import (
+    ShardedTopology,
+    sampling_comm_bytes,
+    shard_topology_rows,
+    sharded_sample_layer,
+    sharded_sample_layer_grouped,
+)
+from .collectives import sharded_gather_hot_cold
 from .train import (
     make_mesh,
+    make_sharded_topo_train_step,
     make_sharded_train_step,
     mesh_axes,
     replicate,
+    shard_feature_hot_cold,
     shard_feature_rows,
 )
 
 __all__ = [
+    "ShardedTopology",
     "make_mesh",
+    "make_sharded_topo_train_step",
     "make_sharded_train_step",
     "mesh_axes",
     "pad_to_multiple",
     "replicate",
+    "sampling_comm_bytes",
+    "shard_feature_hot_cold",
     "shard_feature_rows",
+    "sharded_gather_hot_cold",
+    "shard_topology_rows",
     "sharded_gather",
     "sharded_gather_a2a",
     "sharded_gather_grouped",
+    "sharded_sample_layer",
+    "sharded_sample_layer_grouped",
 ]

@@ -96,6 +96,72 @@ def sharded_gather_a2a(
     return lax.psum_scatter(rows, axis_name, scatter_dimension=0, tiled=False)
 
 
+def sharded_gather_hot_cold(
+    hot_block: jax.Array,
+    cold_block: jax.Array,
+    ids: jax.Array,
+    feat_axes,
+    group_axis: str,
+    hot_rows: int,
+    cold_budget: int,
+):
+    """Grouped gather with a per-host REPLICATED hot prefix — the in-jit
+    analog of the reference's `PartitionInfo.replicate` hot set
+    (feature.py:461-526; mag240m preprocess.py:117-179 replicates the hot
+    rows on every host for exactly this reason).
+
+    The plain `sharded_gather_grouped` pays ``axis_size(group_axis)`` x the
+    full gather width over the DCN axis for EVERY row. Here the table is
+    heat-ordered (reindex_by_config / Feature degree order) and split:
+
+    - rows ``< hot_rows``: replicated per host, striped over the non-group
+      axes — served by an ICI-only psum at full width;
+    - rows ``>= hot_rows``: striped over ALL ``feat_axes`` — the cold ids
+      are compacted (one cheap sort) into a static ``cold_budget``-lane
+      buffer and only THAT rides the grouped DCN path.
+
+    DCN row-volume drops from W to ``cold_budget`` — i.e. by the hot-tier
+    hit rate; calibrate the budget like the sampler caps (observed max cold
+    count x margin, `pyg.sage_sampler.caps_from_counts` policy). Returns
+    ``(rows [W, D], overflow)`` where ``overflow`` counts cold ids beyond
+    the budget this call (their rows come back ZERO — monitor it; a
+    persistent nonzero overflow means the budget needs recalibrating).
+
+    Inside shard_map only. ``ids`` identical across every non-group feat
+    axis; may differ across ``group_axis``.
+    """
+    ici_axes = tuple(a for a in feat_axes if a != group_axis)
+    if not ici_axes:
+        raise ValueError("hot/cold gather needs a non-group striping axis")
+    ids = ids.astype(jnp.int32)
+    w = ids.shape[0]
+    if isinstance(cold_budget, float):
+        # fraction of the gather width (handy when one policy must serve
+        # calls of several static widths, e.g. the fused per-hop gathers);
+        # 256-lane granule, never above the width itself
+        cold_budget = min(w, -(-int(w * cold_budget) // 256) * 256)
+    if cold_budget > w:
+        raise ValueError(f"cold_budget {cold_budget} exceeds gather width {w}")
+    # hot side: ids >= hot_rows fall out of the hot shards' range -> zeros
+    # (hot padding rows are zero, so cold ids landing in [hot_rows, padded)
+    # contribute nothing either)
+    hot_part = sharded_gather(hot_block, ids, ici_axes)
+    # cold side: compact the cold ids to the front (argsort of the hot flag
+    # is stable and costs ~0.5 ms/M lanes — sorts are the cheap primitive,
+    # PERF_NOTES.md), slice the static budget, gather grouped, scatter back
+    is_cold = ids >= hot_rows
+    n_cold = is_cold.sum().astype(jnp.int32)
+    order = jnp.argsort(jnp.where(is_cold, 0, 1), stable=True)
+    sel = order[:cold_budget]
+    lane_ok = jnp.arange(cold_budget, dtype=jnp.int32) < n_cold
+    cold_local = jnp.where(lane_ok, jnp.take(ids, sel) - hot_rows, -1)
+    cold_rows = sharded_gather_grouped(cold_block, cold_local, feat_axes, group_axis)
+    cold_rows = jnp.where(lane_ok[:, None], cold_rows, jnp.zeros_like(cold_rows))
+    out = hot_part.at[sel].add(cold_rows, mode="drop")
+    overflow = jnp.maximum(n_cold - cold_budget, 0)
+    return out, overflow
+
+
 def replicated_psum(x, axis_name: str):
     return lax.psum(x, axis_name)
 

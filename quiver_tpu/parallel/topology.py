@@ -1,0 +1,337 @@
+"""Row-sharded graph topology over the device mesh.
+
+The reference scales the *graph* past one device's memory with UVA: the CSR
+lives in pinned host DRAM and GPU kernels read it over PCIe
+(srcs/cpp/src/quiver/cuda/quiver_sample.cu:361-421 ZERO_COPY register;
+benchmarks/ogbn-papers100M/train_quiver_multi_node.py runs 100M+ nodes that
+way). The TPU-native equivalent keeps the CSR *in HBM* but row-shards it
+across the mesh, so total graph capacity scales with chip count and every
+topology read rides ICI/DCN collectives instead of PCIe:
+
+- each shard owns a CONTIGUOUS row range (edge-balanced, so the big
+  ``indices`` array splits evenly even on power-law graphs where
+  degree-ordered hot rows concentrate at low ids);
+- one-hop sampling becomes a collective: every chip draws neighbors for the
+  frontier rows it owns (degree-0 elsewhere) and a ``psum`` over the
+  topology axes assembles the full ``[W, k]`` neighbor matrix — the same
+  owner-exclusive-contribution pattern as
+  `quiver_tpu.parallel.collectives.sharded_gather`, riding the same axes.
+
+The alternative formulation — route each frontier id to its owner with a
+targeted all_to_all — is NOT better under XLA's static shapes: per-(owner)
+request budgets must be provisioned for the worst-case skew, which on
+degree-ordered power-law graphs is the full frontier width (the same
+analysis as the grouped feature gather, see NEXT.md round-2 note), so the
+lane count matches the all_gather/psum formulation while adding sorts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.sample import fisher_yates_positions, pad_widths
+
+
+class ShardedTopology(NamedTuple):
+    """Device-resident row-sharded CSR (see `shard_topology_rows`).
+
+    ``indptr``  [P, R_max+1] — per-shard LOCAL indptr (offsets into the
+                shard's own indices block), edge-padded so padding rows read
+                as degree 0;
+    ``indices`` [P, E_pad]   — per-shard neighbor block, zero-padded;
+    ``row_start`` [P+1]      — global row boundaries (replicated; shard p
+                owns rows ``row_start[p]:row_start[p+1]``).
+    """
+
+    indptr: jax.Array
+    indices: jax.Array
+    row_start: jax.Array
+
+    @property
+    def n_shards(self) -> int:
+        return self.indptr.shape[0]
+
+    def specs(self, feat_axes) -> "ShardedTopology":
+        """shard_map in_specs pytree for this topology striped over
+        ``feat_axes`` (row_start is replicated)."""
+        return topology_specs(feat_axes)
+
+
+def topology_specs(feat_axes) -> "ShardedTopology":
+    """The ONE place the ShardedTopology shard_map spec layout lives: CSR
+    blocks striped over ``feat_axes``, row boundaries replicated."""
+    return ShardedTopology(
+        indptr=P(feat_axes, None), indices=P(feat_axes, None), row_start=P()
+    )
+
+
+def partition_rows_by_edges(indptr: np.ndarray, n_shards: int) -> np.ndarray:
+    """Contiguous row boundaries with ~equal edges per shard.
+
+    Returns ``row_start`` [n_shards+1] with ``row_start[0]=0`` and
+    ``row_start[-1]=N``. Row ranges may be empty on pathological graphs
+    (one row owning nearly all edges); the sampler handles that (degree-0
+    ownership elsewhere).
+    """
+    indptr = np.asarray(indptr)
+    n = indptr.shape[0] - 1
+    e = int(indptr[-1])
+    targets = (np.arange(1, n_shards) * e) // n_shards
+    cuts = np.searchsorted(indptr, targets, side="left")
+    row_start = np.concatenate([[0], cuts, [n]]).astype(np.int64)
+    return np.maximum.accumulate(row_start)  # enforce monotone under ties
+
+
+def build_topology_shards(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    n_shards: int,
+    pad_multiple: int = 512,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side shard construction: (indptr_blocks, indices_blocks,
+    row_start) as stacked numpy arrays (see `ShardedTopology`)."""
+    indptr = np.asarray(indptr)
+    indices = np.asarray(indices)
+    row_start = partition_rows_by_edges(indptr, n_shards)
+    r_max = int(np.max(row_start[1:] - row_start[:-1])) if n_shards else 0
+    r_max = max(r_max, 1)
+    e_pad = 0
+    for p in range(n_shards):
+        e_pad = max(e_pad, int(indptr[row_start[p + 1]] - indptr[row_start[p]]))
+    e_pad = max(-(-e_pad // pad_multiple) * pad_multiple, pad_multiple)
+    ptr_dt = np.int32 if e_pad < 2**31 else np.int64
+    indptr_blocks = np.zeros((n_shards, r_max + 1), ptr_dt)
+    indices_blocks = np.zeros((n_shards, e_pad), indices.dtype)
+    for p in range(n_shards):
+        lo, hi = int(row_start[p]), int(row_start[p + 1])
+        local = (indptr[lo : hi + 1] - indptr[lo]).astype(ptr_dt)
+        indptr_blocks[p, : hi - lo + 1] = local
+        # edge-pad: rows past this shard's range read as degree 0
+        indptr_blocks[p, hi - lo + 1 :] = local[-1] if local.size else 0
+        blk = indices[int(indptr[lo]) : int(indptr[hi])]
+        indices_blocks[p, : blk.shape[0]] = blk
+    rs_dt = np.int32 if int(row_start[-1]) < 2**31 else np.int64
+    return indptr_blocks, indices_blocks, row_start.astype(rs_dt)
+
+
+def shard_topology_rows(
+    mesh: Mesh, topo, axes: Optional[Tuple[str, ...]] = None
+) -> ShardedTopology:
+    """Place a `CSRTopo` row-sharded over the mesh's feature axes.
+
+    Each device ends up holding ONLY its contiguous CSR block (~E/P edges;
+    edge-balanced), so total graph capacity scales with chip count — the
+    papers100M axis the reference serves with UVA (quiver_sample.cu:361-421).
+
+    ``axes`` defaults to the mesh's feature axes ((host, ici) on a 3-axis
+    mesh, else (ici,)); the blocks are replicated over the remaining axes.
+    """
+    from .train import mesh_axes
+
+    if axes is None:
+        _, axes, _ = mesh_axes(mesh)
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    n_shards = 1
+    for a in axes:
+        n_shards *= mesh.shape[a]
+    indptr_b, indices_b, row_start = build_topology_shards(
+        topo.indptr, topo.indices, n_shards
+    )
+    blk_sharding = NamedSharding(mesh, P(axes, None))
+    rep = NamedSharding(mesh, P())
+    return ShardedTopology(
+        indptr=jax.device_put(jnp.asarray(indptr_b), blk_sharding),
+        indices=jax.device_put(jnp.asarray(indices_b), blk_sharding),
+        row_start=jax.device_put(jnp.asarray(row_start), rep),
+    )
+
+
+def _flat_axis_index(axes: Tuple[str, ...]):
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def sharded_sample_layer(
+    indptr_blk: jax.Array,
+    indices_blk: jax.Array,
+    row_start: jax.Array,
+    cur: jax.Array,
+    cur_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+    axes,
+) -> Tuple[jax.Array, jax.Array]:
+    """Collective one-hop sample from a row-sharded CSR (inside shard_map).
+
+    ``cur`` must be identical across every axis in ``axes`` (use
+    `sharded_sample_layer_grouped` when a striping axis carries different
+    frontiers). Each shard draws neighbors for the frontier rows whose
+    global id falls in its ``row_start`` range — everything else reads as
+    degree 0 — and the psum over ``axes`` assembles the full result, since
+    row ownership is exclusive. Same contract as
+    `quiver_tpu.ops.sample.sample_layer`: ``(nbrs [W, k], valid [W, k])``
+    with global neighbor ids.
+    """
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    idx = _flat_axis_index(axes)
+    start = jnp.take(row_start, idx)
+    end = jnp.take(row_start, idx + 1)
+    r_max = indptr_blk.shape[0] - 1
+    e_pad = indices_blk.shape[0]
+    local = (cur - start).astype(jnp.int32)
+    mine = cur_valid & (cur >= start) & (cur < end)
+    s = jnp.clip(local, 0, r_max - 1)
+    ptr = jnp.take(indptr_blk, s)
+    deg = (jnp.take(indptr_blk, s + 1) - ptr).astype(jnp.int32)
+    deg = jnp.where(mine, deg, 0)
+    pos, valid = fisher_yates_positions(key, deg, k)
+    flat = jnp.clip(ptr[:, None] + pos.astype(ptr.dtype), 0, e_pad - 1)
+    nbrs = jnp.take(indices_blk, flat)
+    nbrs = jnp.where(valid, nbrs, 0)
+    nbrs = lax.psum(nbrs, axes)
+    valid = lax.psum(valid.astype(jnp.int32), axes) > 0
+    return nbrs, valid
+
+
+def sharded_sample_layer_grouped(
+    indptr_blk: jax.Array,
+    indices_blk: jax.Array,
+    row_start: jax.Array,
+    cur: jax.Array,
+    cur_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+    axes,
+    group_axis: str,
+) -> Tuple[jax.Array, jax.Array]:
+    """`sharded_sample_layer` for frontiers that DIFFER across ``group_axis``
+    (one of the striping axes, typically "host" — data-parallel groups span
+    it, so each host's frontier is distinct).
+
+    The frontiers are all_gathered over ``group_axis`` (making them identical
+    across every psum participant), sampled once for all groups, and each
+    group slices its own answer — the same grouped pattern (and the same
+    ``axis_size(group_axis)``x width price) as
+    `collectives.sharded_gather_grouped`.
+    """
+    h = lax.axis_size(group_axis)
+    w = cur.shape[0]
+    all_cur = lax.all_gather(cur, group_axis).reshape(-1)
+    all_valid = lax.all_gather(cur_valid, group_axis).reshape(-1)
+    nbrs, valid = sharded_sample_layer(
+        indptr_blk, indices_blk, row_start, all_cur, all_valid, k, key, axes
+    )
+    me = lax.axis_index(group_axis)
+    return nbrs.reshape(h, w, k)[me], valid.reshape(h, w, k)[me]
+
+
+def gather_comm_bytes(
+    mesh: Mesh,
+    width: int,
+    dim: int,
+    cold_budget: Optional[int] = None,
+    feat_bytes: int = 4,
+    id_bytes: int = 4,
+) -> Dict[str, float]:
+    """Per-gather collective-byte model (ring costs, same conventions as
+    `sampling_comm_bytes`) for ONE feature gather of ``width`` ids on a
+    multi-host mesh — the number that makes the replicated-hot win
+    quantitative: with ``cold_budget`` set (the `sharded_gather_hot_cold`
+    layout) only the cold lanes ride the DCN psum, so DCN bytes scale by
+    ``cold_budget / width`` ≈ the hot-tier miss rate."""
+    from .train import mesh_axes
+
+    _, feat_axes, _ = mesh_axes(mesh)
+    has_host = "host" in mesh.axis_names
+    hostsz = mesh.shape["host"] if has_host else 1
+    out = {"ici_bytes": 0.0, "dcn_bytes": 0.0}
+
+    def add_psum(n_elems, axes):
+        for a in axes:
+            sz = mesh.shape[a]
+            if sz == 1:
+                continue
+            b = 2.0 * (sz - 1) / sz * n_elems * feat_bytes
+            out["dcn_bytes" if a == "host" else "ici_bytes"] += b
+
+    ici_axes = tuple(a for a in feat_axes if a != "host")
+    if not has_host:
+        add_psum(width * dim, feat_axes)
+    elif cold_budget is None:
+        # grouped: all_gather W ids over host, psum [H*W, D] over (host, ici)
+        out["dcn_bytes"] += (hostsz - 1) / hostsz * width * hostsz * id_bytes
+        add_psum(width * hostsz * dim, feat_axes)
+    else:
+        # hot: ICI-only psum at full width (per host)
+        add_psum(width * dim, ici_axes)
+        # cold: grouped path at the budgeted width
+        out["dcn_bytes"] += (hostsz - 1) / hostsz * cold_budget * hostsz * id_bytes
+        add_psum(cold_budget * hostsz * dim, feat_axes)
+    out["total_bytes"] = out["ici_bytes"] + out["dcn_bytes"]
+    return out
+
+
+def sampling_comm_bytes(
+    mesh: Mesh,
+    sizes: Sequence[int],
+    batch_per_group: int,
+    feature_dim: int = 0,
+    caps: Optional[Sequence[Optional[int]]] = None,
+    id_bytes: int = 4,
+    feat_bytes: int = 4,
+) -> Dict[str, float]:
+    """Static per-step collective-traffic model for the sharded-topology
+    train step — the ICI/DCN byte accounting the multichip artifacts log.
+
+    Counts, per training step and per chip, the bytes each collective moves
+    over ICI (within a host) and DCN (the host axis), using the ring model
+    (psum ≈ 2(P-1)/P × payload, all_gather ≈ (P-1)/P × gathered payload; a
+    multi-axis psum decomposes into a per-axis ring each paying its own
+    (A-1)/A factor on the FULL payload, ICI legs first). Hop widths follow
+    `pad_widths`; ``feature_dim > 0`` adds the per-hop sharded feature-gather
+    psum of the fused pipeline. This is a *model* — on real hardware XLA may
+    pick other algorithms — but it makes relative layout costs comparable
+    without a pod.
+    """
+    from .train import mesh_axes
+
+    _, feat_axes, _ = mesh_axes(mesh)
+    has_host = "host" in mesh.axis_names
+    hostsz = mesh.shape["host"] if has_host else 1
+    out: Dict[str, float] = {"ici_bytes": 0.0, "dcn_bytes": 0.0}
+    widths = pad_widths(batch_per_group, sizes, caps)
+
+    def add_psum(n_elems: int, elem_bytes: int):
+        # per-axis rings over the striping axes; payload does not shrink
+        for a in feat_axes:
+            sz = mesh.shape[a]
+            if sz == 1:
+                continue
+            b = 2.0 * (sz - 1) / sz * n_elems * elem_bytes
+            out["dcn_bytes" if a == "host" else "ici_bytes"] += b
+
+    def add_all_gather_host(n_elems: int, elem_bytes: int):
+        if hostsz > 1:
+            out["dcn_bytes"] += (hostsz - 1) / hostsz * n_elems * hostsz * elem_bytes
+
+    group_mult = hostsz  # grouped formulations widen the payload by H
+    for l, k in enumerate(sizes):
+        w = widths[l] * group_mult
+        if has_host:
+            add_all_gather_host(widths[l], id_bytes + 1)  # frontier ids + valid
+        add_psum(w * k, id_bytes + 4)  # nbrs psum + int32 valid psum
+        if feature_dim:
+            add_psum(w * k * feature_dim, feat_bytes)
+    if feature_dim:
+        add_psum(widths[0] * group_mult * feature_dim, feat_bytes)  # seed rows
+    out["total_bytes"] = out["ici_bytes"] + out["dcn_bytes"]
+    return out

@@ -34,7 +34,11 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_fn
 
 from ..pyg.sage_sampler import sample_and_gather_fused, sample_dense_pure
-from .collectives import sharded_gather, sharded_gather_grouped
+from .collectives import (
+    sharded_gather,
+    sharded_gather_grouped,
+    sharded_gather_hot_cold,
+)
 
 
 def make_mesh(
@@ -106,13 +110,15 @@ def make_sharded_train_step(
     caps: Optional[Sequence[Optional[int]]] = None,
     train: bool = True,
     pipeline: str = "dedup",
+    hot_rows: Optional[int] = None,
+    cold_budget=None,
 ):
     """Build ``step(params, opt_state, key, indptr, indices, feat_block,
     labels, seeds) -> (params, opt_state, loss)``.
 
     Sharding contract (the full tp/dp layout of this framework):
-      - indptr/indices/labels: replicated (graph topology in every HBM; the
-        multi-host topology shard lands with the DCN layer);
+      - indptr/indices/labels: replicated (graph topology in every HBM; use
+        `make_sharded_topo_train_step` to row-shard the CSR instead);
       - feat_block: hot rows striped over the ici axis, replicated over dp
         (the p2p_clique_replicate layout, reference feature.py:225-265);
       - seeds: sharded over dp, replicated over ici;
@@ -122,6 +128,16 @@ def make_sharded_train_step(
     (no-dedup structural layout; per-hop ICI gathers interleave with
     sampling — the fastest path, same tradeoff as the single-chip
     pipelines, PERF_NOTES.md).
+
+    ``hot_rows``/``cold_budget`` (multi-host meshes only) switch the feature
+    gather to the replicated-hot layout (`sharded_gather_hot_cold`): the
+    heat-ordered table's first ``hot_rows`` rows are replicated per host
+    (striped over ici) and only up to ``cold_budget`` cold lanes per gather
+    ride the DCN grouped path. ``feat_block`` must then be the
+    ``(hot_block, cold_block)`` pair from `shard_feature_hot_cold`;
+    ``cold_budget`` may be a float fraction of each gather's width.
+    Overflowing cold ids come back as zero rows (calibrate the budget with
+    margin, like the sampler caps).
     """
     if pipeline not in ("dedup", "fused"):
         raise ValueError(f"unknown pipeline: {pipeline!r}")
@@ -134,11 +150,26 @@ def make_sharded_train_step(
     # stripes over (host, ici) and gradients sync over (host, dp)
     has_host = "host" in mesh.axis_names
     data_axes, feat_axes, _ = mesh_axes(mesh)
+    hot_cold = hot_rows is not None
+    if hot_cold and not has_host:
+        raise ValueError(
+            "hot_rows/cold_budget need a multi-host mesh: on a single host "
+            "the plain ici-sharded gather already pays no DCN cost"
+        )
+    if hot_cold and cold_budget is None:
+        raise ValueError("hot_rows set but cold_budget missing")
 
     def gather_rows(tab, ids):
         # hosts sample DIFFERENT seeds, so the host axis needs the grouped
         # gather (see sharded_gather_grouped: all_gather ids over host,
         # gather once, slice own answer)
+        if hot_cold:
+            hot_block, cold_block = tab
+            rows, _overflow = sharded_gather_hot_cold(
+                hot_block, cold_block, ids, feat_axes, "host",
+                hot_rows, cold_budget,
+            )
+            return rows
         if not has_host:
             return sharded_gather(tab, ids, feat_axes)
         return sharded_gather_grouped(tab, ids, feat_axes, "host")
@@ -179,6 +210,13 @@ def make_sharded_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    if hot_cold:
+        ici_axes = tuple(a for a in feat_axes if a != "host")
+        # hot block replicated over host (striped over ici); cold block
+        # striped over every feature axis
+        feat_spec = (P(ici_axes, None), P(feat_axes, None))
+    else:
+        feat_spec = P(feat_axes, None)
     sharded = _shard_map_fn(
         step_local,
         mesh=mesh,
@@ -188,6 +226,119 @@ def make_sharded_train_step(
             P(),            # rng key
             P(),            # indptr
             P(),            # indices
+            feat_spec,      # feature rows (see docstring)
+            P(),            # labels
+            P(data_axes),   # seeds sharded over (host?,) dp
+        ),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_sharded_topo_train_step(
+    mesh: Mesh,
+    model,
+    tx,
+    sizes: Sequence[int],
+    caps: Optional[Sequence[Optional[int]]] = None,
+    train: bool = True,
+    pipeline: str = "dedup",
+):
+    """`make_sharded_train_step` with the GRAPH row-sharded across the mesh.
+
+    Build ``step(params, opt_state, key, stopo: ShardedTopology, feat_block,
+    labels, seeds) -> (params, opt_state, loss)``. Unlike
+    `make_sharded_train_step` — which replicates indptr/indices in every
+    HBM — each device holds only its contiguous CSR block
+    (`topology.shard_topology_rows`), so total graph capacity scales with
+    chip count: the papers100M axis the reference reaches with UVA
+    (quiver_sample.cu:361-421, train_quiver_multi_node.py). Each hop's
+    neighbor draw becomes a psum collective over the topology axes
+    (`topology.sharded_sample_layer`); with a "host" axis the frontier is
+    first all_gathered over it (hosts sample different seeds), mirroring the
+    grouped feature gather.
+
+    Per-step collective traffic for this layout is statically modeled by
+    `topology.sampling_comm_bytes` — log it next to any multichip artifact.
+    """
+    from .topology import sharded_sample_layer, sharded_sample_layer_grouped
+
+    if pipeline not in ("dedup", "fused"):
+        raise ValueError(f"unknown pipeline: {pipeline!r}")
+    if pipeline == "fused" and caps is not None:
+        raise ValueError(
+            "caps only apply to the dedup pipeline: the fused layout is "
+            "structural (width is exactly B*prod(1+k), not cappable)"
+        )
+    has_host = "host" in mesh.axis_names
+    data_axes, feat_axes, _ = mesh_axes(mesh)
+
+    def gather_rows(tab, ids):
+        if not has_host:
+            return sharded_gather(tab, ids, feat_axes)
+        return sharded_gather_grouped(tab, ids, feat_axes, "host")
+
+    def step_local(params, opt_state, key, stopo, feat_block, labels, seeds):
+        indptr_blk = stopo.indptr[0]    # [R_max+1] this shard's local indptr
+        indices_blk = stopo.indices[0]  # [E_pad]   this shard's edge block
+        row_start = stopo.row_start     # [P+1] replicated boundaries
+
+        def sample_fn(cur, cur_valid, k, sub):
+            if not has_host:
+                return sharded_sample_layer(
+                    indptr_blk, indices_blk, row_start, cur, cur_valid, k,
+                    sub, feat_axes,
+                )
+            return sharded_sample_layer_grouped(
+                indptr_blk, indices_blk, row_start, cur, cur_valid, k, sub,
+                feat_axes, "host",
+            )
+
+        dp_idx = lax.axis_index("dp")
+        if has_host:
+            dp_idx = lax.axis_index("host") * lax.axis_size("dp") + dp_idx
+        key = jax.random.fold_in(key, dp_idx)
+        key, dropout_key = jax.random.split(key)
+        if pipeline == "fused":
+            ds, x = sample_and_gather_fused(
+                None, None, feat_block, key, seeds, tuple(sizes),
+                gather_fn=gather_rows, sample_fn=sample_fn,
+            )
+        else:
+            ds = sample_dense_pure(
+                None, None, key, seeds, tuple(sizes), caps, sample_fn=sample_fn
+            )
+            x = gather_rows(feat_block, ds.n_id)
+        y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
+
+        def objective(p):
+            logits = model.apply(
+                p, x, ds.adjs, train=train,
+                rngs={"dropout": dropout_key} if train else None,
+            )
+            ll = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(ll, y[:, None].astype(jnp.int32), axis=1)[:, 0]
+            return nll.mean()
+
+        loss, grads = jax.value_and_grad(objective)(params)
+        grads = lax.pmean(grads, data_axes)
+        loss = lax.pmean(loss, data_axes)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    from .topology import topology_specs
+
+    topo_specs = topology_specs(feat_axes)
+    sharded = _shard_map_fn(
+        step_local,
+        mesh=mesh,
+        in_specs=(
+            P(),            # params (replicated)
+            P(),            # opt_state
+            P(),            # rng key
+            topo_specs,     # row-sharded CSR blocks + replicated boundaries
             P(feat_axes, None),  # hot feature rows striped over (host?,) ici
             P(),            # labels
             P(data_axes),   # seeds sharded over (host?,) dp
@@ -211,6 +362,42 @@ def shard_feature_rows(mesh: Mesh, table) -> jax.Array:
     padded = pad_to_multiple(table, shards)
     sharding = NamedSharding(mesh, P(feat_axes, None))
     return jax.device_put(jnp.asarray(padded), sharding)
+
+
+def shard_feature_hot_cold(
+    mesh: Mesh, table, hot_rows: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Split a heat-ordered [N, D] table for `sharded_gather_hot_cold`:
+    rows ``< hot_rows`` replicated per host (striped over ici), the cold
+    remainder striped over every feature axis. Zero-pads both blocks to
+    their shard multiples (hot padding rows MUST be zero — cold ids landing
+    in the padded hot range rely on it). Order the table by heat first
+    (``Feature`` degree order / `utils.reindex_by_config`) — the analog of
+    the reference's replicate-hottest preprocessing
+    (mag240m preprocess.py:117-179)."""
+    import numpy as np
+
+    from .collectives import pad_to_multiple
+
+    _, feat_axes, _ = mesh_axes(mesh)
+    ici_axes = tuple(a for a in feat_axes if a != "host")
+    if ici_axes == feat_axes:
+        raise ValueError("hot/cold placement needs a multi-host mesh")
+    ici = 1
+    for a in ici_axes:
+        ici *= mesh.shape[a]
+    shards = ici
+    for a in feat_axes:
+        if a == "host":
+            shards *= mesh.shape[a]
+    table = np.asarray(table)
+    if not 0 < hot_rows < table.shape[0]:
+        raise ValueError(f"hot_rows {hot_rows} out of range for {table.shape}")
+    hot = pad_to_multiple(table[:hot_rows], ici)
+    cold = pad_to_multiple(table[hot_rows:], shards)
+    hot_dev = jax.device_put(jnp.asarray(hot), NamedSharding(mesh, P(ici_axes, None)))
+    cold_dev = jax.device_put(jnp.asarray(cold), NamedSharding(mesh, P(feat_axes, None)))
+    return hot_dev, cold_dev
 
 
 def replicate(mesh: Mesh, x):
