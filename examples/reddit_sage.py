@@ -44,8 +44,9 @@ def synthetic_reddit(n=50_000, dim=64, ncls=16, avg_deg=25, seed=0):
     labels = comm.astype(np.int32)
     perm = rng.permutation(n)
     train_idx = perm[: n // 10]
-    test_idx = perm[n // 10 : n // 10 + max(n // 20, 1)]
-    return np.stack([src, dst]), feat, labels, train_idx, test_idx
+    val_idx = perm[n // 10 : n // 10 + max(n // 20, 1)]
+    test_idx = perm[n // 10 + max(n // 20, 1) : n // 10 + 2 * max(n // 20, 1)]
+    return np.stack([src, dst]), feat, labels, train_idx, val_idx, test_idx
 
 
 def main():
@@ -81,9 +82,11 @@ def main():
         edge_index, feat, labels, train_idx = (
             data["edge_index"], data["features"], data["labels"], data["train_idx"],
         )
+        # export_ogb.py writes the OGB split name "valid_idx"
+        val_idx = data.get("valid_idx", data.get("val_idx"))
         test_idx = data.get("test_idx")
     else:
-        edge_index, feat, labels, train_idx, test_idx = synthetic_reddit(
+        edge_index, feat, labels, train_idx, val_idx, test_idx = synthetic_reddit(
             n=args.nodes, dim=args.dim
         )
     sizes = [int(s) for s in args.sizes.split(",")]
@@ -162,10 +165,10 @@ def main():
         )
 
     # held-out accuracy, mirroring the reference examples' final eval
-    if params is not None and test_idx is not None and len(test_idx):
+    def sampled_acc(idx):
         correct = total = 0
-        for lo in range(0, len(test_idx), args.batch_size):
-            seeds = np.asarray(test_idx[lo : lo + args.batch_size])
+        for lo in range(0, len(idx), args.batch_size):
+            seeds = np.asarray(idx[lo : lo + args.batch_size])
             n_real = seeds.shape[0]
             if n_real < args.batch_size:  # pad to keep one compiled shape
                 seeds = np.concatenate(
@@ -177,7 +180,22 @@ def main():
             pred = np.asarray(jnp.argmax(logits, axis=-1))[:n_real]
             correct += int((pred == labels_np[seeds[:n_real]]).sum())
             total += n_real
-        print(f"test acc: {correct / total:.4f} ({total} nodes)")
+        return correct / total, total
+
+    if params is not None:
+        for name, idx in (("val", val_idx), ("test", test_idx)):
+            if idx is not None and len(idx):
+                acc, total = sampled_acc(idx)
+                print(f"{name} acc: {acc:.4f} ({total} nodes)")
+        if args.model == "sage" and test_idx is not None and len(test_idx):
+            # exact layer-wise full-neighbor inference (reference
+            # SAGE.inference, dist_sampling_ogb_products_quiver.py:118-139)
+            from quiver_tpu.inference import full_inference_accuracy
+
+            facc = full_inference_accuracy(
+                model, params, csr_topo, feat, labels_np, test_idx
+            )
+            print(f"test acc (full inference): {facc:.4f}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,141 @@
+"""Evaluation / inference paths.
+
+The reference evaluates two ways: layer-wise FULL-neighbor inference (the
+`model.inference` loop of examples/multi_gpu/pyg/ogb-products/
+dist_sampling_ogb_products_quiver.py:118-139, subgraph loader over all
+nodes) and sampled eval with the training sampler. TPU equivalents:
+
+- `sage_full_inference`: exact layered embeddings for ALL nodes. The
+  full-neighbor mean aggregation is ONE edge-parallel pass over the CSR per
+  layer (chunked `lax.fori_loop`, same trick as `ops.sample.neighbor_prob`)
+  — no subgraph loader needed; XLA streams the gather/scatter chunks.
+- `sampled_eval`: high-fanout sampled accuracy for any model (GraphSAGE or
+  GAT — full-neighbor attention would need per-edge softmax passes; the
+  reference evaluates GAT by sampling too, dist_sampling_reddit_gat.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("edge_chunk",))
+def full_mean_aggregate(
+    indptr: jax.Array,
+    indices: jax.Array,
+    h: jax.Array,
+    edge_chunk: int = 1 << 20,
+) -> jax.Array:
+    """Exact mean over ALL neighbors for every node: ``out[u] =
+    mean_{v in N(u)} h[v]`` (zero where deg 0).
+
+    Edge-parallel chunked segment-sum over the CSR — the dense-batch analog
+    of `ops.sample.neighbor_prob`'s scalar pass; one traced chunk body
+    regardless of graph size.
+    """
+    n = indptr.shape[0] - 1
+    e = indices.shape[0]
+    d = h.shape[1]
+    out = jnp.zeros((n + 1, d), h.dtype)  # +1: out-of-range dump row
+    if e == 0:
+        return out[:n]
+    chunk = min(edge_chunk, e)
+    nchunks = -(-e // chunk)
+
+    def body(c, out):
+        start_u = c * chunk
+        start = jnp.minimum(start_u, e - chunk)
+        eidx = start + jnp.arange(chunk, dtype=indptr.dtype)
+        fresh = eidx >= start_u
+        src = jnp.searchsorted(indptr, eidx, side="right") - 1
+        dst = lax.dynamic_slice(indices, (start,), (chunk,))
+        rows = jnp.take(h, jnp.clip(dst, 0, h.shape[0] - 1), axis=0)
+        rows = jnp.where(fresh[:, None], rows, 0)
+        src = jnp.where(fresh, src, n)  # dump lane
+        return out.at[src].add(rows, mode="drop")
+
+    out = lax.fori_loop(0, nchunks, body, out)[:n]
+    deg = (indptr[1:] - indptr[:-1]).astype(h.dtype)
+    return out / jnp.maximum(deg, 1)[:, None]
+
+
+def sage_full_inference(
+    model,
+    params,
+    indptr: jax.Array,
+    indices: jax.Array,
+    x_all: jax.Array,
+) -> jax.Array:
+    """Layer-wise full-neighbor GraphSAGE inference over ALL nodes —
+    the reference `SAGE.inference` semantics
+    (dist_sampling_ogb_products_quiver.py:118-139) without a subgraph
+    loader: per layer, one full-graph mean aggregation + the layer's dense
+    projections, relu between layers (no dropout at eval).
+
+    Works for the `models.GraphSAGE` flax module (reads its
+    ``conv{i}/lin_l|lin_r`` params directly; GAT needs per-edge softmax —
+    use `sampled_eval` there)."""
+    p = params["params"] if "params" in params else params
+    num_layers = model.num_layers
+    h = jnp.asarray(x_all)
+    for i in range(num_layers):
+        layer = p[f"conv{i}"]
+        agg = full_mean_aggregate(indptr, indices, h)
+        out = agg @ layer["lin_l"]["kernel"]
+        if "bias" in layer["lin_l"]:
+            out = out + layer["lin_l"]["bias"]
+        out = out + h @ layer["lin_r"]["kernel"]
+        h = jax.nn.relu(out) if i != num_layers - 1 else out
+    return h
+
+
+def sampled_eval(
+    model,
+    params,
+    sampler,
+    feature,
+    labels: np.ndarray,
+    nodes: np.ndarray,
+    batch_size: int = 1024,
+) -> float:
+    """Sampled accuracy over ``nodes`` (any model; use an eval sampler with
+    higher fanouts than training for a tighter estimate — the reference's
+    eval runs the same loop with test seeds). Returns fraction correct."""
+    nodes = np.asarray(nodes)
+    labels = np.asarray(labels)
+    correct = 0
+    apply = jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
+    for lo in range(0, nodes.shape[0], batch_size):
+        batch = nodes[lo : lo + batch_size]
+        if batch.shape[0] < batch_size:  # pad to keep one compiled shape
+            batch = np.concatenate(
+                [batch, np.full(batch_size - batch.shape[0], batch[-1], batch.dtype)]
+            )
+        ds = sampler.sample_dense(batch)
+        if isinstance(feature, np.ndarray):  # raw [N, D] table
+            ids = np.clip(np.asarray(ds.n_id), 0, feature.shape[0] - 1)
+            x = jnp.asarray(feature[ids])
+        else:  # quiver Feature (tiered lookup)
+            x = feature[ds.n_id]
+        logits = apply(params, x, ds.adjs)
+        pred = np.asarray(jnp.argmax(logits, axis=-1))[: min(batch_size, nodes.shape[0] - lo)]
+        correct += int((pred == labels[nodes[lo : lo + batch_size]]).sum())
+    return correct / nodes.shape[0]
+
+
+def full_inference_accuracy(
+    model, params, topo, x_all, labels, nodes
+) -> float:
+    """Accuracy of `sage_full_inference` on a node subset."""
+    indptr, indices = topo.to_device()
+    h = sage_full_inference(model, params, indptr, indices, jnp.asarray(x_all))
+    pred = np.asarray(jnp.argmax(h, axis=-1))
+    nodes = np.asarray(nodes)
+    return float((pred[nodes] == np.asarray(labels)[nodes]).mean())
