@@ -88,16 +88,14 @@ def main():
     rest = np.setdiff1d(np.arange(n), train_idx)
     val_idx, test_idx = rest[: n // 20], rest[n // 20 : n // 10]
     if args.hot_frac:
-        # heat-order everything so the hot prefix is the replicated tier
-        order = np.argsort(
-            -(np.bincount(edge_index[0], minlength=n)
-              + np.bincount(edge_index[1], minlength=n))
-        ).astype(np.int64)
-        inv = np.empty(n, np.int64)
-        inv[order] = np.arange(n)
-        edge_index = inv[edge_index]
-        feat, labels = feat[order], labels[order]
-        train_idx, val_idx, test_idx = inv[train_idx], inv[val_idx], inv[test_idx]
+        # heat-order the id space so the hot prefix is the replicated tier
+        from quiver_tpu.utils import heat_reorder
+
+        edge_index, feat, labels, (train_idx, val_idx, test_idx), _, _ = (
+            heat_reorder(
+                edge_index, n, feat, labels, (train_idx, val_idx, test_idx)
+            )
+        )
     topo = CSRTopo(edge_index=edge_index)
 
     mesh = make_mesh(hosts=args.hosts or None)
@@ -116,12 +114,20 @@ def main():
     )
     tx = optax.adam(1e-3)
     hot_rows = int(n * args.hot_frac) if args.hot_frac else None
-    cold_budget = 0.5 if hot_rows else None
+    cold_budget = None
+    if hot_rows:
+        # probe-calibrated cold-lane fraction (margin like the sampler caps)
+        from quiver_tpu.parallel import calibrate_cold_budget
+        from quiver_tpu.pyg import GraphSageSampler
+
+        probe_sampler = GraphSageSampler(topo, sizes=sizes, mode="TPU", seed=7)
+        probes = [rng.choice(train_idx, min(64, len(train_idx))) for _ in range(4)]
+        cold_budget = calibrate_cold_budget(probe_sampler, probes, hot_rows)
+        print(f"hot tier: {hot_rows} rows, calibrated cold budget {cold_budget:.2f}")
     if args.topology == "sharded":
-        if hot_rows:
-            raise SystemExit("--hot-frac with --topology sharded: not wired yet")
         step = make_sharded_topo_train_step(
-            mesh, model, tx, sizes=sizes, pipeline=args.pipeline
+            mesh, model, tx, sizes=sizes, pipeline=args.pipeline,
+            hot_rows=hot_rows, cold_budget=cold_budget,
         )
         stopo = shard_topology_rows(mesh, topo)
     else:
@@ -160,21 +166,23 @@ def main():
                 jnp.asarray(rng.choice(train_idx, batch_global).astype(np.int32)),
                 NamedSharding(mesh, data_spec),
             )
+            step_key = jax.random.key(epoch * 100000 + i)
             if args.topology == "sharded":
-                params, opt_state, loss = step(
-                    params, opt_state, jax.random.key(epoch * 100000 + i),
-                    stopo, feat_sharded, labels_d, seeds,
-                )
+                out = step(params, opt_state, step_key, stopo, feat_sharded,
+                           labels_d, seeds)
             else:
-                params, opt_state, loss = step(
-                    params, opt_state, jax.random.key(epoch * 100000 + i),
-                    indptr, indices, feat_sharded, labels_d, seeds,
-                )
+                out = step(params, opt_state, step_key, indptr, indices,
+                           feat_sharded, labels_d, seeds)
+            if hot_rows:
+                params, opt_state, loss, overflow = out
+            else:
+                (params, opt_state, loss), overflow = out, None
         jax.block_until_ready(loss)
         dt = time.time() - t0
+        ov = f"  cold_overflow={int(overflow)}" if overflow is not None else ""
         print(
             f"epoch {epoch}: {dt:.2f}s  loss={float(loss):.4f}  "
-            f"{steps_per_epoch * batch_global / dt:.0f} seeds/s"
+            f"{steps_per_epoch * batch_global / dt:.0f} seeds/s{ov}"
         )
 
     # val/test accuracy (reference products example reports ~0.787 on the

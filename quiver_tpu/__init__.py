@@ -18,7 +18,9 @@ from .utils import (
     parse_size,
     reindex_by_config,
     reindex_feature,
+    show_tensor_info,
 )
+from . import inference
 from .partition import (
     load_quiver_feature_partition,
     partition_feature_without_replication,
@@ -60,9 +62,11 @@ __all__ = [
     "parse_size",
     "partition_feature_without_replication",
     "pyg",
+    "inference",
     "quiver_partition_feature",
     "reindex_by_config",
     "reindex_feature",
+    "show_tensor_info",
     "TieredBatch",
     "TieredFeaturePipeline",
     "TrainPipeline",

@@ -96,6 +96,20 @@ def sage_full_inference(
     return h
 
 
+_APPLY_CACHE = {}
+
+
+def _cached_apply(model):
+    """One jitted apply per model instance — a fresh jit per sampled_eval
+    call would recompile an identical program every invocation (flax
+    modules are frozen dataclasses, so instance identity is a fine key)."""
+    fn = _APPLY_CACHE.get(id(model))
+    if fn is None:
+        fn = jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
+        _APPLY_CACHE[id(model)] = fn
+    return fn
+
+
 def sampled_eval(
     model,
     params,
@@ -111,7 +125,7 @@ def sampled_eval(
     nodes = np.asarray(nodes)
     labels = np.asarray(labels)
     correct = 0
-    apply = jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
+    apply = _cached_apply(model)
     for lo in range(0, nodes.shape[0], batch_size):
         batch = nodes[lo : lo + batch_size]
         if batch.shape[0] < batch_size:  # pad to keep one compiled shape

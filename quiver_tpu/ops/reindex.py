@@ -41,6 +41,8 @@ from __future__ import annotations
 import functools
 from typing import NamedTuple, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -188,18 +190,53 @@ def local_reindex(
     )
 
 
-def reindex_single(seeds: jax.Array, inputs: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+def reindex_single(
+    seeds: jax.Array, inputs: jax.Array, counts=None
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Free-function analog of the reference's standalone ``reindex_single``
-    (quiver_sample.cu:305-357): given seeds and a flat neighbor array (one
-    row already implied), return (n_id, count, local_ids_of_inputs)."""
+    (quiver_sample.cu:305-357): given seeds and their sampled neighbors,
+    return (n_id, count, local_ids_of_inputs).
+
+    ``inputs`` is either a padded ``[S, k]`` matrix, or the reference's
+    FLAT ragged concatenation — in which case ``counts`` (neighbors per
+    seed, the shape the reference call sites actually pass) is REQUIRED
+    unless the flat length happens to be uniform: a flat ragged list whose
+    length is coincidentally divisible by S must not be silently gridded.
+    Returned local ids for a ragged input are the positions of the real
+    (unpadded) entries, in input order.
+    """
     S = seeds.shape[0]
-    flat = inputs.reshape(S, -1) if inputs.ndim == 1 and inputs.shape[0] % S == 0 else inputs
-    if flat.ndim == 1:
-        flat = flat[None, :]
-    res = local_reindex(
-        seeds,
-        jnp.ones((S,), bool),
-        flat,
-        jnp.ones(flat.shape, bool),
-    )
-    return res.n_id, res.count, res.local_nbrs.reshape(-1)
+    if inputs.ndim == 2:
+        res = local_reindex(
+            seeds, jnp.ones((S,), bool), inputs, jnp.ones(inputs.shape, bool)
+        )
+        return res.n_id, res.count, res.local_nbrs.reshape(-1)
+    if counts is None:
+        if inputs.shape[0] % S != 0:
+            raise ValueError(
+                f"flat ragged neighbor list (len {inputs.shape[0]}, {S} "
+                f"seeds): pass counts= (neighbors per seed) — guessing a "
+                f"uniform [S, k] grid would mis-assign neighbors"
+            )
+        flat = inputs.reshape(S, -1)
+        res = local_reindex(
+            seeds, jnp.ones((S,), bool), flat, jnp.ones(flat.shape, bool)
+        )
+        return res.n_id, res.count, res.local_nbrs.reshape(-1)
+    counts = np.asarray(counts)
+    if counts.shape[0] != S or int(counts.sum()) != inputs.shape[0]:
+        raise ValueError(
+            f"counts {counts.shape}/{int(counts.sum())} inconsistent with "
+            f"{S} seeds and {inputs.shape[0]} flat neighbors"
+        )
+    k = max(int(counts.max()), 1) if S else 1
+    flat_np = np.asarray(inputs)
+    padded = np.zeros((S, k), flat_np.dtype)
+    mask = np.zeros((S, k), bool)
+    off = 0
+    for i, c in enumerate(counts):
+        padded[i, : int(c)] = flat_np[off : off + int(c)]
+        mask[i, : int(c)] = True
+        off += int(c)
+    res = local_reindex(seeds, jnp.ones((S,), bool), jnp.asarray(padded), jnp.asarray(mask))
+    return res.n_id, res.count, np.asarray(res.local_nbrs)[np.asarray(mask)]

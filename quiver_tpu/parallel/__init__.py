@@ -15,6 +15,7 @@ from .topology import (
 )
 from .collectives import sharded_gather_hot_cold
 from .train import (
+    calibrate_cold_budget,
     make_mesh,
     make_sharded_topo_train_step,
     make_sharded_train_step,
@@ -26,6 +27,7 @@ from .train import (
 
 __all__ = [
     "ShardedTopology",
+    "calibrate_cold_budget",
     "make_mesh",
     "make_sharded_topo_train_step",
     "make_sharded_train_step",

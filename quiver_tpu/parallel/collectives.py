@@ -44,7 +44,10 @@ def sharded_gather(table_block: jax.Array, ids: jax.Array, axis_name) -> jax.Arr
     idx = lax.axis_index(axes[0])
     for a in axes[1:]:
         idx = idx * lax.axis_size(a) + lax.axis_index(a)
-    local = ids.astype(jnp.int32) - idx * rows_per_shard
+    # keep int64 ids wide (>2^31-row global tables, x64 mode); everything
+    # else runs int32 (cheaper TPU gathers)
+    id_dt = ids.dtype if ids.dtype == jnp.int64 else jnp.int32
+    local = ids.astype(id_dt) - idx.astype(id_dt) * rows_per_shard
     in_range = (local >= 0) & (local < rows_per_shard)
     rows = jnp.take(table_block, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
     rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))
@@ -85,10 +88,11 @@ def sharded_gather_a2a(
     all_to_all return trip for bandwidth-balanced assembly.
     """
     rows_per_shard = table_block.shape[0]
-    # [P, B_local] all chips' requests
-    all_ids = lax.all_gather(ids.astype(jnp.int32), axis_name)
+    # [P, B_local] all chips' requests (int64 preserved for >2^31-row tables)
+    id_dt = ids.dtype if ids.dtype == jnp.int64 else jnp.int32
+    all_ids = lax.all_gather(ids.astype(id_dt), axis_name)
     idx = lax.axis_index(axis_name)
-    local = all_ids - idx * rows_per_shard
+    local = all_ids - idx.astype(id_dt) * rows_per_shard
     in_range = (local >= 0) & (local < rows_per_shard)
     rows = jnp.take(table_block, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
     rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))  # [P, B, D]
@@ -148,8 +152,13 @@ def sharded_gather_hot_cold(
     hot_part = sharded_gather(hot_block, ids, ici_axes)
     # cold side: compact the cold ids to the front (argsort of the hot flag
     # is stable and costs ~0.5 ms/M lanes — sorts are the cheap primitive,
-    # PERF_NOTES.md), slice the static budget, gather grouped, scatter back
-    is_cold = ids >= hot_rows
+    # PERF_NOTES.md), slice the static budget, gather grouped, scatter back.
+    # Out-of-range ids (padding sentinels: reindex pads with intmax) are
+    # NEITHER hot nor cold — they must not consume budget lanes
+    n_cold_global = cold_block.shape[0]
+    for a in feat_axes:
+        n_cold_global = n_cold_global * lax.axis_size(a)
+    is_cold = (ids >= hot_rows) & (ids < hot_rows + n_cold_global)
     n_cold = is_cold.sum().astype(jnp.int32)
     order = jnp.argsort(jnp.where(is_cold, 0, 1), stable=True)
     sel = order[:cold_budget]

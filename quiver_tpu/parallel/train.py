@@ -33,7 +33,10 @@ try:  # jax >= 0.6 moved shard_map out of experimental
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_fn
 
-from ..pyg.sage_sampler import sample_and_gather_fused, sample_dense_pure
+from ..pyg.sage_sampler import (
+    sample_and_gather_dedup,
+    sample_and_gather_fused,
+)
 from .collectives import (
     sharded_gather,
     sharded_gather_grouped,
@@ -136,8 +139,10 @@ def make_sharded_train_step(
     ride the DCN grouped path. ``feat_block`` must then be the
     ``(hot_block, cold_block)`` pair from `shard_feature_hot_cold`;
     ``cold_budget`` may be a float fraction of each gather's width.
-    Overflowing cold ids come back as zero rows (calibrate the budget with
-    margin, like the sampler caps).
+    Overflowing cold ids come back as zero rows, and the step returns a
+    FOURTH output — the worst summed overflow across data groups this
+    step; persistently nonzero means the budget needs raising
+    (`calibrate_cold_budget` produces a float budget with margin).
     """
     if pipeline not in ("dedup", "fused"):
         raise ValueError(f"unknown pipeline: {pipeline!r}")
@@ -159,22 +164,25 @@ def make_sharded_train_step(
     if hot_cold and cold_budget is None:
         raise ValueError("hot_rows set but cold_budget missing")
 
-    def gather_rows(tab, ids):
-        # hosts sample DIFFERENT seeds, so the host axis needs the grouped
-        # gather (see sharded_gather_grouped: all_gather ids over host,
-        # gather once, slice own answer)
-        if hot_cold:
-            hot_block, cold_block = tab
-            rows, _overflow = sharded_gather_hot_cold(
-                hot_block, cold_block, ids, feat_axes, "host",
-                hot_rows, cold_budget,
-            )
-            return rows
-        if not has_host:
-            return sharded_gather(tab, ids, feat_axes)
-        return sharded_gather_grouped(tab, ids, feat_axes, "host")
-
     def step_local(params, opt_state, key, indptr, indices, feat_block, labels, seeds):
+        overflow_acc = []
+
+        def gather_rows(tab, ids):
+            # hosts sample DIFFERENT seeds, so the host axis needs the
+            # grouped gather (see sharded_gather_grouped: all_gather ids
+            # over host, gather once, slice own answer)
+            if hot_cold:
+                hot_block, cold_block = tab
+                rows, overflow = sharded_gather_hot_cold(
+                    hot_block, cold_block, ids, feat_axes, "host",
+                    hot_rows, cold_budget,
+                )
+                overflow_acc.append(overflow)
+                return rows
+            if not has_host:
+                return sharded_gather(tab, ids, feat_axes)
+            return sharded_gather_grouped(tab, ids, feat_axes, "host")
+
         dp_idx = lax.axis_index("dp")
         if has_host:
             dp_idx = lax.axis_index("host") * lax.axis_size("dp") + dp_idx
@@ -188,10 +196,13 @@ def make_sharded_train_step(
                 gather_fn=gather_rows,
             )
         else:
-            ds = sample_dense_pure(indptr, indices, key, seeds, tuple(sizes), caps)
-            # hot rows are striped across the feature axes (replicated over
-            # dp); one psum assembles full rows for this group's n_id
-            x = gather_rows(feat_block, ds.n_id)
+            # struct-leaf dedup (same formulation as the single-chip e2e):
+            # reference-parity sampling DAG, last hop's features gathered
+            # straight through the sharded gather in structural layout
+            ds, x = sample_and_gather_dedup(
+                indptr, indices, feat_block, key, seeds, tuple(sizes), caps,
+                gather_fn=gather_rows,
+            )
         y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
 
         def objective(p):
@@ -208,6 +219,12 @@ def make_sharded_train_step(
         loss = lax.pmean(loss, data_axes)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if hot_cold:
+            # worst cold-budget overflow across groups this step: a
+            # persistently nonzero value means zeroed feature rows — raise
+            # the budget (see sharded_gather_hot_cold docstring)
+            overflow = lax.pmax(sum(overflow_acc), data_axes)
+            return params, opt_state, loss, overflow
         return params, opt_state, loss
 
     if hot_cold:
@@ -215,8 +232,10 @@ def make_sharded_train_step(
         # hot block replicated over host (striped over ici); cold block
         # striped over every feature axis
         feat_spec = (P(ici_axes, None), P(feat_axes, None))
+        out_specs = (P(), P(), P(), P())
     else:
         feat_spec = P(feat_axes, None)
+        out_specs = (P(), P(), P())
     sharded = _shard_map_fn(
         step_local,
         mesh=mesh,
@@ -230,7 +249,7 @@ def make_sharded_train_step(
             P(),            # labels
             P(data_axes),   # seeds sharded over (host?,) dp
         ),
-        out_specs=(P(), P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -244,6 +263,8 @@ def make_sharded_topo_train_step(
     caps: Optional[Sequence[Optional[int]]] = None,
     train: bool = True,
     pipeline: str = "dedup",
+    hot_rows: Optional[int] = None,
+    cold_budget=None,
 ):
     """`make_sharded_train_step` with the GRAPH row-sharded across the mesh.
 
@@ -259,6 +280,11 @@ def make_sharded_topo_train_step(
     first all_gathered over it (hosts sample different seeds), mirroring the
     grouped feature gather.
 
+    ``hot_rows``/``cold_budget`` compose the replicated-hot feature tier
+    with the sharded topology (multi-host meshes; same contract as
+    `make_sharded_train_step`): pass ``(hot_block, cold_block)`` from
+    `shard_feature_hot_cold` as ``feat_block``.
+
     Per-step collective traffic for this layout is statically modeled by
     `topology.sampling_comm_bytes` — log it next to any multichip artifact.
     """
@@ -273,13 +299,31 @@ def make_sharded_topo_train_step(
         )
     has_host = "host" in mesh.axis_names
     data_axes, feat_axes, _ = mesh_axes(mesh)
-
-    def gather_rows(tab, ids):
-        if not has_host:
-            return sharded_gather(tab, ids, feat_axes)
-        return sharded_gather_grouped(tab, ids, feat_axes, "host")
+    hot_cold = hot_rows is not None
+    if hot_cold and not has_host:
+        raise ValueError(
+            "hot_rows/cold_budget need a multi-host mesh: on a single host "
+            "the plain ici-sharded gather already pays no DCN cost"
+        )
+    if hot_cold and cold_budget is None:
+        raise ValueError("hot_rows set but cold_budget missing")
 
     def step_local(params, opt_state, key, stopo, feat_block, labels, seeds):
+        overflow_acc = []
+
+        def gather_rows(tab, ids):
+            if hot_cold:
+                hot_block, cold_block = tab
+                rows, overflow = sharded_gather_hot_cold(
+                    hot_block, cold_block, ids, feat_axes, "host",
+                    hot_rows, cold_budget,
+                )
+                overflow_acc.append(overflow)
+                return rows
+            if not has_host:
+                return sharded_gather(tab, ids, feat_axes)
+            return sharded_gather_grouped(tab, ids, feat_axes, "host")
+
         indptr_blk = stopo.indptr[0]    # [R_max+1] this shard's local indptr
         indices_blk = stopo.indices[0]  # [E_pad]   this shard's edge block
         row_start = stopo.row_start     # [P+1] replicated boundaries
@@ -306,10 +350,10 @@ def make_sharded_topo_train_step(
                 gather_fn=gather_rows, sample_fn=sample_fn,
             )
         else:
-            ds = sample_dense_pure(
-                None, None, key, seeds, tuple(sizes), caps, sample_fn=sample_fn
+            ds, x = sample_and_gather_dedup(
+                None, None, feat_block, key, seeds, tuple(sizes), caps,
+                gather_fn=gather_rows, sample_fn=sample_fn,
             )
-            x = gather_rows(feat_block, ds.n_id)
         y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
 
         def objective(p):
@@ -326,11 +370,21 @@ def make_sharded_topo_train_step(
         loss = lax.pmean(loss, data_axes)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if hot_cold:
+            overflow = lax.pmax(sum(overflow_acc), data_axes)
+            return params, opt_state, loss, overflow
         return params, opt_state, loss
 
     from .topology import topology_specs
 
     topo_specs = topology_specs(feat_axes)
+    if hot_cold:
+        ici_axes = tuple(a for a in feat_axes if a != "host")
+        feat_spec = (P(ici_axes, None), P(feat_axes, None))
+        out_specs = (P(), P(), P(), P())
+    else:
+        feat_spec = P(feat_axes, None)
+        out_specs = (P(), P(), P())
     sharded = _shard_map_fn(
         step_local,
         mesh=mesh,
@@ -339,11 +393,11 @@ def make_sharded_topo_train_step(
             P(),            # opt_state
             P(),            # rng key
             topo_specs,     # row-sharded CSR blocks + replicated boundaries
-            P(feat_axes, None),  # hot feature rows striped over (host?,) ici
+            feat_spec,      # feature rows (see docstring)
             P(),            # labels
             P(data_axes),   # seeds sharded over (host?,) dp
         ),
-        out_specs=(P(), P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -398,6 +452,40 @@ def shard_feature_hot_cold(
     hot_dev = jax.device_put(jnp.asarray(hot), NamedSharding(mesh, P(ici_axes, None)))
     cold_dev = jax.device_put(jnp.asarray(cold), NamedSharding(mesh, P(feat_axes, None)))
     return hot_dev, cold_dev
+
+
+def calibrate_cold_budget(
+    sampler,
+    probe_seeds,
+    hot_rows: int,
+    margin: float = 1.3,
+) -> float:
+    """Cold-lane budget FRACTION for `sharded_gather_hot_cold`, calibrated
+    like the sampler caps: max observed cold share of the sampled id space
+    over probe batches x ``margin`` (capped at 1.0).
+
+    A fraction — not a lane count — because the train steps gather at
+    several static widths per step (frontier block, structural leaf block);
+    `sharded_gather_hot_cold` scales a float budget to each call's width
+    with a 256-lane granule. The id space must be heat-ordered (rows <
+    ``hot_rows`` are the replicated tier) — the convention the gather
+    itself assumes."""
+    import numpy as np
+
+    shares = []
+    for seeds in probe_seeds:
+        ds = sampler.sample_dense(np.asarray(seeds))
+        n_id = np.asarray(ds.n_id)
+        # prefix-valid (dedup) samples: count real lanes only; structural
+        # samples interleave invalid lanes that carry real sampled ids, so
+        # counting every lane is the conservative choice there
+        if all(a.cols is not None for a in ds.adjs):
+            n_id = n_id[: int(ds.count)]
+        if n_id.shape[0]:
+            shares.append(float((n_id >= hot_rows).mean()))
+    if not shares:
+        raise ValueError("calibrate_cold_budget needs at least one probe batch")
+    return float(min(max(shares) * margin, 1.0))
 
 
 def replicate(mesh: Mesh, x):

@@ -158,6 +158,12 @@ class PipelineStats:
     batches: int = 0
     cold_rows: int = 0
     hot_rows: int = 0
+    # mixed-sampler feedback (populated by run_epoch_iter when the source
+    # is a MixedGraphSageSampler): measured per-task averages + the split
+    # the sampler chose — the inputs to suggest_num_workers
+    avg_device_sample_s: float = 0.0
+    avg_cpu_sample_s: float = 0.0
+    device_share: Optional[float] = None
 
 
 class TrainPipeline:
@@ -238,7 +244,17 @@ class TrainPipeline:
                 ds = item if isinstance(item, DenseSample) else item[1]
                 yield self._stage_ds(ds)
 
-        return self._run(staged(), params, opt_state, key)
+        out = self._run(staged(), params, opt_state, key)
+        # feed the mixed sampler's measurements back into the stats so
+        # callers can auto-tune (suggest_num_workers / auto_tune_workers)
+        for attr, field in (
+            ("avg_device_time", "avg_device_sample_s"),
+            ("avg_cpu_time", "avg_cpu_sample_s"),
+            ("last_device_share", "device_share"),
+        ):
+            if hasattr(samples, attr):
+                setattr(self.stats, field, getattr(samples, attr))
+        return out
 
     def _run(self, batches, params, opt_state, key: jax.Array):
         """The double-buffered loop: the generator's work (sampling, cold

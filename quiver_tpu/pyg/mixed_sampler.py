@@ -121,6 +121,8 @@ class MixedGraphSageSampler:
         mode: str = "TPU_CPU_MIXED",
         caps: Optional[Sequence[Optional[int]]] = None,
         seed: int = 0,
+        auto_tune_workers: bool = False,
+        device_share_target: float = 0.5,
     ):
         mode = self.MODE_ALIASES.get(mode, mode)
         if mode not in ("TPU_CPU_MIXED", "HOST_CPU_MIXED", "TPU_ONLY", "CPU_ONLY"):
@@ -149,6 +151,9 @@ class MixedGraphSageSampler:
         # avg_device_time/avg_cpu_time, sage_sampler.py:262-270)
         self.avg_device_time = 0.0
         self.avg_cpu_time = 0.0
+        self.auto_tune_workers = auto_tune_workers and "MIXED" in mode
+        self.device_share_target = float(device_share_target)
+        self.last_device_share = None  # measured split of the last epoch
 
     # -- worker lifecycle (reference lazy_init, sage_sampler.py:298-313) ----
     def lazy_init(self) -> None:
@@ -223,6 +228,41 @@ class MixedGraphSageSampler:
         prev = getattr(self, attr)
         setattr(self, attr, dt if prev == 0 else 0.9 * prev + 0.1 * dt)
 
+    def suggest_num_workers(
+        self,
+        device_share_target: Optional[float] = None,
+        max_workers: Optional[int] = None,
+    ) -> int:
+        """Worker count that pushes the device's task share down to
+        ``device_share_target`` given the measured per-task averages.
+
+        The device competes with TRAINING for the same chip (the reason the
+        hybrid sampler exists, reference sage_sampler.py:207-230), so a
+        lower device share frees step time; more workers only help while
+        host cores are spare. From ``share = dev_rate/(dev_rate+cpu_rate)``
+        and ``cpu_rate = w/avg_cpu``: ``w = avg_cpu*(1-t)/(t*avg_dev)``.
+        """
+        import os as _os
+
+        t = self.device_share_target if device_share_target is None else device_share_target
+        if self.avg_device_time <= 0 or self.avg_cpu_time <= 0 or not 0 < t < 1:
+            return self.num_workers
+        if max_workers is None:
+            max_workers = max(_os.cpu_count() or 1, 1)
+        w = self.avg_cpu_time * (1.0 - t) / (t * self.avg_device_time)
+        return int(np.clip(round(w), 1, max_workers))
+
+    def _maybe_retune_workers(self) -> None:
+        """auto_tune_workers: re-spawn the worker pool between epochs when
+        the measured averages call for a different size (the feedback loop
+        the reference leaves manual)."""
+        if not self.auto_tune_workers:
+            return
+        want = self.suggest_num_workers()
+        if want != self.num_workers and self._workers:
+            self.shutdown()
+            self.num_workers = want
+
     def _to_dense(self, n_id, count, adjs) -> DenseSample:
         import jax.numpy as jnp
 
@@ -246,6 +286,7 @@ class MixedGraphSageSampler:
 
     # -- epoch iterator (reference iter_sampler, sage_sampler.py:316-368) ---
     def __iter__(self) -> Iterator:
+        self._maybe_retune_workers()
         self.lazy_init()
         self.job.shuffle()
         # stale-epoch fencing: an abandoned iterator (break/GeneratorExit)
@@ -255,6 +296,7 @@ class MixedGraphSageSampler:
         epoch = self._epoch
         total = len(self.job)
         device_num = self.decide_task_num(total)
+        self.last_device_share = device_num / max(total, 1)
 
         def recv(block: bool):
             """Next CPU result of THIS epoch, or None."""

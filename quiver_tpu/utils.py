@@ -183,6 +183,16 @@ class CSRTopo:
 
         if id_dtype is None:
             id_dtype = _best_id_dtype(max(self.edge_count, self.node_count + 1))
+        if np.dtype(id_dtype) == np.int64 and not jax.config.jax_enable_x64:
+            # jnp.asarray would SILENTLY wrap int64 -> int32 here (jax
+            # default); >2^31 ids would corrupt instead of erroring
+            raise ValueError(
+                "graph needs int64 ids on device but jax x64 is disabled "
+                "(ids would silently wrap to int32): enable it via "
+                'jax.config.update("jax_enable_x64", True) before first jax '
+                "use, or keep the graph host-side with mode='HOST' (the "
+                "native engine is int64 end to end)"
+            )
         key = (str(device), np.dtype(id_dtype).name)
         if self._device_cache is not None and self._device_cache[0] == key:
             return self._device_cache[1]
@@ -193,6 +203,73 @@ class CSRTopo:
             indices = jax.device_put(indices, device)
         self._device_cache = (key, (indptr, indices))
         return self._device_cache[1]
+
+
+def heat_reorder(
+    edge_index,
+    num_nodes: Optional[int] = None,
+    features=None,
+    labels=None,
+    index_sets=(),
+):
+    """Renumber the WHOLE id space degree-descending (in+out degree), so
+    the hot prefix convention of `shard_feature_hot_cold` /
+    `sharded_gather_hot_cold` ("rows < hot_rows are the replicated tier")
+    holds for graph, features, labels and index sets alike — the ONE
+    implementation of that convention.
+
+    Returns ``(edge_index_r, features_r, labels_r, sets_r, order, inv)``
+    with ``order[new_id] = old_id`` and ``inv[old_id] = new_id``; pass-
+    through ``None`` for absent features/labels. (`reindex_by_config` /
+    `Feature.from_cpu_tensor` reorder only the TABLE and translate ids at
+    lookup; this reorders the id space itself, which collective gathers
+    need — they test hotness by raw id.)"""
+    edge_index = np.asarray(edge_index)
+    n = int(num_nodes) if num_nodes is not None else int(edge_index.max()) + 1
+    deg = np.bincount(edge_index[0], minlength=n) + np.bincount(
+        edge_index[1], minlength=n
+    )
+    order = np.argsort(-deg, kind="stable").astype(np.int64)
+    inv = np.empty(n, np.int64)
+    inv[order] = np.arange(n)
+    edge_r = inv[edge_index]
+    feats_r = None if features is None else np.asarray(features)[order]
+    labels_r = None if labels is None else np.asarray(labels)[order]
+    sets_r = tuple(inv[np.asarray(s)] for s in index_sets)
+    return edge_r, feats_r, labels_r, sets_r, order, inv
+
+
+def show_tensor_info(x, name: str = "", file=None) -> str:
+    """Debug dump of an array's identity — the TPU analog of the
+    reference's ``show_tensor_info`` (srcs/cpp/src/quiver/cpu/tensor.cpp:
+    74-95: dtype/shape/device/data pointer). Handles jax arrays (device +
+    sharding), numpy arrays (memmap path included), and anything exposing
+    shape/dtype. Returns the line (also printed)."""
+    parts = [name or type(x).__name__]
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    parts.append(f"shape={tuple(shape) if shape is not None else '?'}")
+    parts.append(f"dtype={dtype}")
+    nbytes = getattr(x, "nbytes", None)
+    if nbytes is not None:
+        parts.append(f"nbytes={nbytes:,}")
+    if isinstance(x, np.memmap):
+        parts.append(f"memmap={getattr(x, 'filename', '?')}")
+    elif isinstance(x, np.ndarray):
+        parts.append("host=numpy")
+    else:
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None:
+            devs = getattr(x, "devices", None)
+            parts.append(f"sharding={sharding}")
+            if callable(devs):
+                parts.append(f"devices={sorted(str(d) for d in devs())}")
+        committed = getattr(x, "committed", None)
+        if committed is not None:
+            parts.append(f"committed={committed}")
+    line = " ".join(str(p) for p in parts)
+    print(line, file=file)
+    return line
 
 
 def reindex_by_config(adj_csr: CSRTopo, graph_feature, gpu_portion: float):

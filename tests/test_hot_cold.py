@@ -150,23 +150,19 @@ def test_hot_cold_train_step_learns(pipeline):
     from quiver_tpu.pyg.sage_sampler import sample_dense_fused, sample_dense_pure
 
     edge_index, feat_np, labels, n = make_community_graph(per_comm=40)
-    topo = CSRTopo(edge_index=edge_index)
     mesh = _mesh3()
-    # heat-order by degree; remap graph + labels to match the table order
-    order = np.argsort(-np.asarray(topo.degree)).astype(np.int64)
-    inv = np.empty(n, np.int64)
-    inv[order] = np.arange(n)
-    edge_remap = inv[edge_index]
-    topo_r = CSRTopo(edge_index=edge_remap)
-    feat_r = feat_np[order]
-    labels_r = labels[order]
+    # heat-order the id space (the convention the hot/cold gather assumes)
+    from quiver_tpu.utils import heat_reorder
+
+    edge_r, feat_r, labels_r, _, _, _ = heat_reorder(edge_index, n, feat_np, labels)
+    topo_r = CSRTopo(edge_index=edge_r)
     hot_rows = n // 4
     hot_dev, cold_dev = shard_feature_hot_cold(mesh, feat_r, hot_rows)
     model = GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
     tx = optax.adam(1e-2)
     step = make_sharded_train_step(
         mesh, model, tx, sizes=[4, 4], pipeline=pipeline,
-        hot_rows=hot_rows, cold_budget=0.6,
+        hot_rows=hot_rows, cold_budget=1.0,  # generous: no overflow expected
     )
     indptr = replicate(mesh, topo_r.indptr.astype(np.int32))
     indices = replicate(mesh, topo_r.indices.astype(np.int32))
@@ -188,10 +184,11 @@ def test_hot_cold_train_step_learns(pipeline):
             rng.choice(n, batch_global, replace=False).astype(np.int32),
             NamedSharding(mesh, P(("host", "dp"))),
         )
-        params, opt_state, loss = step(
+        params, opt_state, loss, overflow = step(
             params, opt_state, jax.random.key(i), indptr, indices,
             (hot_dev, cold_dev), labels_d, seeds,
         )
+        assert int(overflow) == 0, int(overflow)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses
 
@@ -207,3 +204,78 @@ def test_hot_cold_validation_errors():
         make_sharded_train_step(mesh3, None, None, sizes=[4], hot_rows=8)
     with pytest.raises(ValueError, match="multi-host"):
         shard_feature_hot_cold(mesh, np.zeros((10, 2), np.float32), 4)
+
+
+def test_calibrate_cold_budget_bounds_probe_batches():
+    from quiver_tpu.parallel import calibrate_cold_budget
+    from quiver_tpu.pyg import GraphSageSampler
+
+    edge_index, feat_np, labels, n = make_community_graph(per_comm=40)
+    # heat-order the id space (the convention the gather assumes)
+    from quiver_tpu.utils import heat_reorder
+
+    edge_r, _, _, _, _, _ = heat_reorder(edge_index, n)
+    topo = CSRTopo(edge_index=edge_r)
+    sampler = GraphSageSampler(topo, sizes=[4, 4], mode="TPU", seed=0)
+    hot = n // 4
+    rng = np.random.default_rng(0)
+    probes = [rng.choice(n, 32, replace=False) for _ in range(6)]
+    budget = calibrate_cold_budget(sampler, probes, hot, margin=1.3)
+    assert isinstance(budget, float) and 0 < budget <= 1.0
+    # fresh batches: valid-lane cold share stays within the budgeted fraction
+    for _ in range(6):
+        ds = sampler.sample_dense(rng.choice(n, 32, replace=False))
+        n_id = np.asarray(ds.n_id)[: int(ds.count)]
+        assert float((n_id >= hot).mean()) <= budget
+
+
+@pytest.mark.parametrize("pipeline", ["dedup", "fused"])
+def test_sharded_topology_with_hot_cold_tier(pipeline):
+    """The combined layout: CSR row-sharded over (host, ici) AND the
+    feature table split into a per-host replicated hot tier + DCN cold
+    remainder — the full papers100M-scale configuration in one step."""
+    from quiver_tpu.parallel import (
+        make_sharded_topo_train_step,
+        shard_topology_rows,
+    )
+    from quiver_tpu.pyg.sage_sampler import sample_dense_fused, sample_dense_pure
+
+    edge_index, feat_np, labels, n = make_community_graph(per_comm=40)
+    from quiver_tpu.utils import heat_reorder
+
+    edge_r, feat_r, labels_r, _, _, _ = heat_reorder(edge_index, n, feat_np, labels)
+    topo = CSRTopo(edge_index=edge_r)
+    mesh = _mesh3()
+    stopo = shard_topology_rows(mesh, topo)
+    hot_rows = n // 4
+    hot_dev, cold_dev = shard_feature_hot_cold(mesh, feat_r, hot_rows)
+    model = GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
+    tx = optax.adam(1e-2)
+    step = make_sharded_topo_train_step(
+        mesh, model, tx, sizes=[4, 4], pipeline=pipeline,
+        hot_rows=hot_rows, cold_budget=1.0,
+    )
+    labels_d = replicate(mesh, labels_r.astype(np.int32))
+    _, _, groups = mesh_axes(mesh)
+    per_group = 8
+    ip = jnp.asarray(topo.indptr.astype(np.int32))
+    ix = jnp.asarray(topo.indices.astype(np.int32))
+    make0 = sample_dense_fused if pipeline == "fused" else sample_dense_pure
+    ds0 = make0(ip, ix, jax.random.key(0), jnp.arange(per_group, dtype=jnp.int32), (4, 4))
+    x0 = jnp.zeros((ds0.n_id.shape[0], feat_np.shape[1]), jnp.float32)
+    params = replicate(mesh, model.init(jax.random.key(1), x0, ds0.adjs))
+    opt_state = jax.device_put(tx.init(params), NamedSharding(mesh, P()))
+    rng = np.random.default_rng(3)
+    losses = []
+    for i in range(25):
+        seeds = jax.device_put(
+            rng.choice(n, per_group * groups, replace=False).astype(np.int32),
+            NamedSharding(mesh, P(("host", "dp"))),
+        )
+        params, opt_state, loss, overflow = step(
+            params, opt_state, jax.random.key(i), stopo,
+            (hot_dev, cold_dev), labels_d, seeds,
+        )
+        assert int(overflow) == 0, int(overflow)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.75, losses

@@ -1,0 +1,138 @@
+"""int64 id hardening (VERDICT r2 item 7).
+
+The reference's papers100M-scale graphs overflow int32 EDGE ids (1.6B
+directed edges symmetrize past 2^31; quiver_sample.cu indexes with int64).
+Here: the native host engine is exercised against a REAL >2^31 edge-id
+space via a sparse memmap (holes cost nothing — only the tail block is
+materialized), and the device paths are proven to fail LOUDLY, not wrap,
+when int64 ids meet jax's x64-disabled default.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu.ops.cpu_kernels import HostSampler
+from quiver_tpu.utils import CSRTopo, _best_id_dtype
+
+TAIL_BASE = 2**31  # first real edge id sits past the int32 boundary
+
+
+def _giant_graph(tmp_path, n=64, deg=4):
+    """CSR whose indices array spans [0, 2^31 + n*deg) — all zeros except
+    the written tail block (sparse file: ~KBs of real disk)."""
+    e_virtual = TAIL_BASE + n * deg
+    idx = np.memmap(tmp_path / "indices.i64", dtype=np.int64, mode="w+",
+                    shape=(e_virtual,))
+    indptr = np.empty(n + 1, np.int64)
+    indptr[0] = TAIL_BASE
+    for u in range(n):
+        nbrs = (u + 1 + np.arange(deg)) % n
+        idx[TAIL_BASE + u * deg : TAIL_BASE + (u + 1) * deg] = nbrs
+        indptr[u + 1] = TAIL_BASE + (u + 1) * deg
+    return indptr, idx, n, deg
+
+
+def test_best_id_dtype_boundary():
+    # conservative boundary: the argument is a COUNT (max index + 1)
+    assert _best_id_dtype(2**31 - 2) == np.int32
+    assert _best_id_dtype(2**31 - 1) == np.int64
+    assert _best_id_dtype(2**31) == np.int64
+
+
+def test_host_sampler_above_2e31_edge_ids(tmp_path):
+    indptr, idx, n, deg = _giant_graph(tmp_path)
+    s = HostSampler(indptr, idx)
+    assert s.indices is idx or s.indices.base is not None  # no 17 GB copy
+    nbrs, valid = s.sample_layer(np.arange(n), 3, seed=7)
+    assert valid.all()  # deg 4 > k 3
+    for u in range(n):
+        expected = {(u + 1 + j) % n for j in range(deg)}
+        got = set(nbrs[u].tolist())
+        assert got <= expected, (u, got, expected)
+        assert len(got) == 3  # without replacement
+
+
+def test_host_multilayer_above_2e31_edge_ids(tmp_path):
+    indptr, idx, n, deg = _giant_graph(tmp_path)
+    s = HostSampler(indptr, idx)
+    n_id, count, adjs = s.sample_multilayer(np.arange(8), (3, 2), seed=1)
+    assert 0 < count <= n_id.shape[0]
+    assert (n_id[:count] >= 0).all() and (n_id[:count] < n).all()
+    for a in adjs:
+        m = a["mask"]
+        assert m.any()
+
+
+def test_host_mode_sampler_surface_above_2e31(tmp_path):
+    # through the public GraphSageSampler HOST surface (= the reference's
+    # UVA big-graph mode)
+    from quiver_tpu.pyg import GraphSageSampler
+
+    indptr, idx, n, deg = _giant_graph(tmp_path)
+    topo = CSRTopo(indptr=indptr, indices=idx)
+    s = GraphSageSampler(topo, sizes=[3, 2], mode="HOST", seed=0)
+    ds = s.sample_dense(np.arange(8))
+    n_id = np.asarray(ds.n_id)[: int(ds.count)]
+    assert (n_id >= 0).all() and (n_id < n).all()
+
+
+def test_to_device_rejects_int64_without_x64():
+    # jnp.asarray would SILENTLY wrap int64 -> int32 under jax's default
+    # config; the device binding must refuse instead
+    assert not jax.config.jax_enable_x64
+    topo = CSRTopo(edge_index=np.array([[0, 1], [1, 0]]))
+    with pytest.raises(ValueError, match="x64"):
+        topo.to_device(id_dtype=np.int64)
+
+
+def test_device_paths_run_int64_under_x64():
+    """With x64 enabled (subprocess — the flag is global), device sampling,
+    reindex and the sharded gather all run on int64 ids end to end."""
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from quiver_tpu.utils import CSRTopo
+from quiver_tpu.pyg.sage_sampler import sample_dense_pure
+from quiver_tpu.parallel import make_mesh, replicate, shard_feature_rows, sharded_gather
+
+rng = np.random.default_rng(0)
+ei = np.stack([rng.integers(0, 50, 600), rng.integers(0, 50, 600)])
+topo = CSRTopo(edge_index=ei)
+ip, ix = topo.to_device(id_dtype=np.int64)
+assert ix.dtype == jnp.int64, ix.dtype
+ds = sample_dense_pure(ip, ix, jax.random.key(0), jnp.arange(8, dtype=jnp.int64), (3, 2))
+assert ds.n_id.dtype == jnp.int64, ds.n_id.dtype
+n_id = np.asarray(ds.n_id)[: int(ds.count)]
+assert (n_id >= 0).all() and (n_id < 50).all()
+
+mesh = make_mesh(8)
+table = rng.standard_normal((64, 4)).astype(np.float32)
+ids = rng.integers(0, 64, 17).astype(np.int64)
+block = shard_feature_rows(mesh, table)
+out = jax.jit(jax.shard_map(
+    lambda b, i: sharded_gather(b, i, "ici"), mesh=mesh,
+    in_specs=(P("ici", None), P()), out_specs=P(), check_vma=False,
+))(block, replicate(mesh, ids))
+np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
+print("INT64 OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "INT64 OK" in out.stdout

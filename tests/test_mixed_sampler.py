@@ -93,3 +93,102 @@ def test_decide_task_num_adapts(graph):
     # device much slower -> CPU takes (nearly) everything
     s.avg_device_time, s.avg_cpu_time = 1.0, 0.001
     assert s.decide_task_num(8) == 0
+
+
+def test_split_converges_to_throughput_ratio(graph):
+    """VERDICT r2 item 9 'done' criterion: the epoch split must converge to
+    the measured throughput ratio device_rate/(device_rate+cpu_rate)."""
+    job = TrainSampleJob(np.arange(graph.node_count), batch_size=16, seed=0)
+    s = MixedGraphSageSampler(job, graph, sizes=[3, 2], num_workers=2,
+                              mode="TPU_CPU_MIXED")
+    total = 1000
+    # inject measured averages: device 2x faster per task than one worker,
+    # but TWO workers -> cpu_rate == device_rate -> 50/50 split
+    s.avg_device_time, s.avg_cpu_time = 0.01, 0.02
+    assert s.decide_task_num(total) == 500
+    # one worker only: device_rate 100/s vs cpu 50/s -> 2/3 device
+    s.num_workers = 1
+    assert s.decide_task_num(total) == round(total * 100 / 150)
+    # slow device: 10/s vs 50/s -> 1/6 device
+    s.avg_device_time = 0.1
+    assert s.decide_task_num(total) == round(total * 10 / 60)
+
+
+def test_suggest_num_workers_formula(graph):
+    import os
+
+    job = TrainSampleJob(np.arange(graph.node_count), batch_size=16, seed=0)
+    s = MixedGraphSageSampler(job, graph, sizes=[3, 2], num_workers=2,
+                              mode="TPU_CPU_MIXED")
+    # no measurements yet -> keep current
+    assert s.suggest_num_workers() == 2
+    # cpu task 4x the device task: target 50% share needs 4 workers
+    s.avg_device_time, s.avg_cpu_time = 0.01, 0.04
+    assert s.suggest_num_workers(0.5, max_workers=32) == 4
+    # target 20% device share -> w = 0.04*0.8/(0.2*0.01) = 16
+    assert s.suggest_num_workers(0.2, max_workers=32) == 16
+    # host core cap applies
+    assert s.suggest_num_workers(0.2) <= max(os.cpu_count() or 1, 1)
+    # degenerate targets keep current
+    assert s.suggest_num_workers(0.0) == s.num_workers
+
+
+def test_auto_tune_respawns_worker_pool(graph):
+    job = TrainSampleJob(np.arange(64), batch_size=16, seed=0)
+    s = MixedGraphSageSampler(job, graph, sizes=[3, 2], num_workers=1,
+                              mode="TPU_CPU_MIXED", auto_tune_workers=True)
+    try:
+        # epoch 1: even split, measurements accumulate
+        for _ in s:
+            pass
+        assert s.avg_device_time > 0 and s.avg_cpu_time > 0
+        want = s.suggest_num_workers()
+        for _ in s:  # epoch 2 retunes at entry
+            pass
+        assert s.num_workers == want
+        # measured split recorded for the stats feedback
+        assert s.last_device_share is not None
+        assert 0 <= s.last_device_share <= 1
+    finally:
+        s.shutdown()
+
+
+def test_pipeline_stats_carry_mixed_measurements(graph):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu import Feature
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.pipeline import (
+        TieredFeaturePipeline,
+        TrainPipeline,
+        make_tiered_train_step,
+    )
+    from quiver_tpu.pyg import GraphSageSampler
+
+    n = graph.node_count
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((n, 8)).astype(np.float32)
+    labels = rng.integers(0, 3, n).astype(np.int32)
+    f = Feature(rank=0, device_list=[0], device_cache_size="1G")
+    f.from_cpu_tensor(feat)
+    job = TrainSampleJob(np.arange(64), batch_size=16, seed=0)
+    mixed = MixedGraphSageSampler(job, graph, sizes=[3, 2], num_workers=1,
+                                  mode="TPU_CPU_MIXED")
+    model = GraphSAGE(hidden_dim=8, out_dim=3, num_layers=2, dropout=0.0)
+    tx = optax.adam(1e-2)
+    pipe = TieredFeaturePipeline(f)
+    step_fn = make_tiered_train_step(model, tx, jnp.asarray(labels), pipe.hot_table)
+    boot = GraphSageSampler(graph, sizes=[3, 2], mode="TPU", seed=1)
+    ds0 = boot.sample_dense(np.arange(16))
+    x0 = jnp.zeros((ds0.n_id.shape[0], 8), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    tp = TrainPipeline(boot, f, step_fn)
+    try:
+        tp.run_epoch_iter(mixed, params, tx.init(params), jax.random.key(1))
+    finally:
+        mixed.shutdown()
+    assert tp.stats.device_share is not None
+    assert tp.stats.avg_device_sample_s > 0
+    assert tp.stats.avg_cpu_sample_s > 0

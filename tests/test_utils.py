@@ -63,3 +63,19 @@ def test_feature_order_slot():
     topo = CSRTopo(indptr=[0, 1, 2], indices=[1, 0])
     topo.feature_order = [1, 0]
     assert list(topo.feature_order) == [1, 0]
+
+
+def test_show_tensor_info_variants(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from quiver_tpu.utils import show_tensor_info
+
+    line = show_tensor_info(np.zeros((3, 4), np.float32), "host_arr")
+    assert "host_arr" in line and "shape=(3, 4)" in line and "numpy" in line
+    mm = np.memmap(tmp_path / "m.bin", dtype=np.int64, mode="w+", shape=(8,))
+    line = show_tensor_info(mm)
+    assert "memmap" in line and "m.bin" in line
+    line = show_tensor_info(jnp.arange(5), "dev_arr")
+    assert "dev_arr" in line and "sharding=" in line
+    out = capsys.readouterr().out
+    assert out.count("\n") == 3  # each call printed one line
