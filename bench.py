@@ -241,6 +241,23 @@ def bench_feature(context, table_dev, iters=10, batch=262_144):
     context["feature_tiered20_gbps"] = round(tiered_gbps, 2)
 
 
+def calibrate_bench_caps(indptr, indices, seeds_all, batch, sizes=(15, 10, 5)):
+    """THE cap policy for every dedup section of this bench (one definition
+    so logged caps always match the caps the e2e step runs): probe over ALL
+    seed batches, margin 1.1, granule 2048. The tight margin (vs the 1.2
+    library default) is safe because the probe pool IS the epoch's seed pool
+    — and any residual drop shows up in the reported cap_overflow counter
+    (0 == exact reference semantics)."""
+    from quiver_tpu.pyg.sage_sampler import caps_from_counts, probe_hop_counts
+
+    import jax
+
+    counts = probe_hop_counts(indptr, indices, jax.random.key(0), seeds_all, sizes)
+    caps = caps_from_counts(counts, batch, sizes, margin=1.1, granule=2048)
+    log(f"dedup hop unique counts max {counts.max(axis=0).tolist()} -> caps {caps}")
+    return caps
+
+
 def bench_e2e(context, indptr, indices, seeds_all, table, iters=10, classes=47, caps=None):
     """Epoch-equivalent e2e: ONE jitted program scans `iters` full train
     steps (sample -> feature gather -> 3-layer GraphSAGE fwd/bwd -> adam).
@@ -254,8 +271,6 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=10, classes=47, 
 
     from quiver_tpu.models import GraphSAGE
     from quiver_tpu.pyg.sage_sampler import (
-        caps_from_counts,
-        probe_hop_counts,
         sample_and_gather_dedup,
         sample_and_gather_fused,
     )
@@ -271,13 +286,7 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=10, classes=47, 
     tx = optax.adam(1e-3)
 
     if caps is None:
-        # dedup path: static n_id caps calibrated by the library API (probe
-        # batches -> max unique count x margin, granule-rounded — the policy
-        # the round-2 bench hand-rolled, now GraphSageSampler.calibrate_caps
-        # / caps_from_counts). One jitted scan over 8 probe batches.
-        counts = probe_hop_counts(indptr, indices, jax.random.key(0), seeds_all[:8], sizes)
-        caps = caps_from_counts(counts, batch, sizes)
-        log(f"dedup hop unique counts max {counts.max(axis=0).tolist()} -> caps {caps}")
+        caps = calibrate_bench_caps(indptr, indices, seeds_all, batch, sizes)
 
     def make_epoch(sample_fn, sample_caps):
         def one_step(params, opt_state, ip, ix, tab, lab, key, seeds):
@@ -303,7 +312,8 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=10, classes=47, 
             loss, grads = jax.value_and_grad(objective)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            ov = jnp.int32(0) if ds.cap_overflow is None else ds.cap_overflow
+            return params, opt_state, loss, ov
 
         @jax.jit
         def epoch(params, opt_state, ip, ix, tab, lab, key0, seeds_all):
@@ -312,15 +322,15 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=10, classes=47, 
             def body(carry, i):
                 params, opt_state = carry
                 key = jax.random.fold_in(key0, i)
-                params, opt_state, loss = one_step(
+                params, opt_state, loss, ov = one_step(
                     params, opt_state, ip, ix, tab, lab, key, seeds_all[i % m]
                 )
-                return (params, opt_state), loss
+                return (params, opt_state), (loss, ov)
 
-            (params, opt_state), losses = lax.scan(
+            (params, opt_state), (losses, ovs) = lax.scan(
                 body, (params, opt_state), jnp.arange(iters, dtype=jnp.int32)
             )
-            return params, opt_state, losses
+            return params, opt_state, losses, ovs.sum()
 
         return epoch
 
@@ -347,25 +357,31 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=10, classes=47, 
         epoch_fn = make_epoch(sample_fn, sample_caps)
         log(f"compiling e2e {name} step...")
         t0 = time.time()
-        params, opt_state, losses = epoch_fn(
+        params, opt_state, losses, ov = epoch_fn(
             params, opt_state, indptr, indices, table, labels, jax.random.key(2), seeds_all
         )
         float(losses[-1])
         compile_s = time.time() - t0
         t0 = time.time()
-        params, opt_state, losses = epoch_fn(
+        params, opt_state, losses, ov = epoch_fn(
             params, opt_state, indptr, indices, table, labels, jax.random.key(3), seeds_all
         )
         float(losses[-1])  # dependent fetch == all steps executed
         step_s = (time.time() - t0) / iters
         epoch_s = step_s * steps_per_epoch
+        overflow = int(ov)
         log(
             f"e2e {name}: {step_s*1e3:.1f} ms/step -> epoch {epoch_s:.2f}s "
-            f"(compile {compile_s:.1f}s, ref 1-GPU epoch {BASELINE_EPOCH_S}s)"
+            f"(compile {compile_s:.1f}s, cap_overflow {overflow}, "
+            f"ref 1-GPU epoch {BASELINE_EPOCH_S}s)"
         )
         context[f"e2e_{name}_epoch_s"] = round(epoch_s, 2)
         context[f"e2e_{name}_compile_s"] = round(compile_s, 1)
         context[f"e2e_{name}_vs_ref_epoch"] = round(BASELINE_EPOCH_S / epoch_s, 2)
+        if name == "dedup":
+            # unique nodes dropped by the static caps across the timed run:
+            # 0 means the tight margin cost nothing semantically
+            context["e2e_dedup_cap_overflow"] = overflow
 
 
 def bench_tiered_pipeline(
@@ -531,13 +547,7 @@ def main():
         log(f"feature bench failed: {exc}")
     caps = None
     try:
-        from quiver_tpu.pyg.sage_sampler import caps_from_counts, probe_hop_counts
-
-        counts = probe_hop_counts(
-            indptr, indices, jax.random.key(0), seeds_all[:8], (15, 10, 5)
-        )
-        caps = caps_from_counts(counts, batch, (15, 10, 5))
-        log(f"dedup hop unique counts max {counts.max(axis=0).tolist()} -> caps {caps}")
+        caps = calibrate_bench_caps(indptr, indices, seeds_all, batch)
     except Exception as exc:
         log(f"cap calibration failed: {exc}")
     try:

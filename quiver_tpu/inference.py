@@ -96,17 +96,31 @@ def sage_full_inference(
     return h
 
 
-_APPLY_CACHE = {}
+import weakref
+
+# value-keyed weak cache: equal-config models share one jitted apply (flax
+# modules are frozen dataclasses, hashable by field values) and entries die
+# with their last model — an id()-keyed dict would pin every model plus its
+# compiled executable for the process lifetime (hyperparameter sweeps OOM)
+_APPLY_CACHE = weakref.WeakKeyDictionary()
 
 
 def _cached_apply(model):
-    """One jitted apply per model instance — a fresh jit per sampled_eval
-    call would recompile an identical program every invocation (flax
-    modules are frozen dataclasses, so instance identity is a fine key)."""
-    fn = _APPLY_CACHE.get(id(model))
+    """One jitted apply per model VALUE — a fresh jit per sampled_eval call
+    would recompile an identical program every invocation.
+
+    The cached closure must NOT capture ``model`` strongly: the value would
+    pin its own WeakKeyDictionary key forever and nothing would ever evict.
+    It closes over a weakref proxy instead — tracing only happens while the
+    model is alive (the cache entry dies with it)."""
+    try:
+        fn = _APPLY_CACHE.get(model)
+    except TypeError:  # unhashable custom module: skip caching
+        return jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
     if fn is None:
-        fn = jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
-        _APPLY_CACHE[id(model)] = fn
+        ref = weakref.proxy(model)
+        fn = jax.jit(lambda p, x, adjs: ref.apply(p, x, adjs))
+        _APPLY_CACHE[model] = fn
     return fn
 
 

@@ -137,7 +137,9 @@ def sharded_gather_hot_cold(
     ici_axes = tuple(a for a in feat_axes if a != group_axis)
     if not ici_axes:
         raise ValueError("hot/cold gather needs a non-group striping axis")
-    ids = ids.astype(jnp.int32)
+    # same int64 treatment as sharded_gather/_a2a: this is the layout built
+    # for the LARGEST tables, so >2^31-row global id spaces must not wrap
+    ids = ids.astype(ids.dtype if ids.dtype == jnp.int64 else jnp.int32)
     w = ids.shape[0]
     if isinstance(cold_budget, float):
         # fraction of the gather width (handy when one policy must serve

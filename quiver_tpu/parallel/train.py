@@ -105,6 +105,102 @@ def mesh_axes(mesh: Mesh) -> Tuple[Tuple[str, ...], Tuple[str, ...], int]:
     return data_axes, feat_axes, n_groups
 
 
+def _validate_step_config(mesh, pipeline, caps, hot_rows, cold_budget):
+    """Shared precondition checks + layout facts for both step factories.
+    Returns (has_host, data_axes, feat_axes, hot_cold)."""
+    if pipeline not in ("dedup", "fused"):
+        raise ValueError(f"unknown pipeline: {pipeline!r}")
+    if pipeline == "fused" and caps is not None:
+        raise ValueError(
+            "caps only apply to the dedup pipeline: the fused layout is "
+            "structural (width is exactly B*prod(1+k), not cappable)"
+        )
+    has_host = "host" in mesh.axis_names
+    data_axes, feat_axes, _ = mesh_axes(mesh)
+    hot_cold = hot_rows is not None
+    if hot_cold and not has_host:
+        raise ValueError(
+            "hot_rows/cold_budget need a multi-host mesh: on a single host "
+            "the plain ici-sharded gather already pays no DCN cost"
+        )
+    if hot_cold and cold_budget is None:
+        raise ValueError("hot_rows set but cold_budget missing")
+    return has_host, data_axes, feat_axes, hot_cold
+
+
+def _make_gather_rows(has_host, hot_cold, feat_axes, hot_rows, cold_budget,
+                      overflow_acc):
+    """The per-step feature gather closure both factories share: plain
+    ici-sharded, host-grouped, or replicated-hot/cold (appending each
+    call's overflow to ``overflow_acc``)."""
+    def gather_rows(tab, ids):
+        # hosts sample DIFFERENT seeds, so the host axis needs the grouped
+        # gather (see sharded_gather_grouped: all_gather ids over host,
+        # gather once, slice own answer)
+        if hot_cold:
+            hot_block, cold_block = tab
+            rows, overflow = sharded_gather_hot_cold(
+                hot_block, cold_block, ids, feat_axes, "host",
+                hot_rows, cold_budget,
+            )
+            overflow_acc.append(overflow)
+            return rows
+        if not has_host:
+            return sharded_gather(tab, ids, feat_axes)
+        return sharded_gather_grouped(tab, ids, feat_axes, "host")
+
+    return gather_rows
+
+
+def _fold_group_key(key, has_host):
+    """Distinct sample stream per data-parallel group, identical within an
+    ici group."""
+    dp_idx = lax.axis_index("dp")
+    if has_host:
+        dp_idx = lax.axis_index("host") * lax.axis_size("dp") + dp_idx
+    return jax.random.fold_in(key, dp_idx)
+
+
+def _loss_and_update(model, tx, train, data_axes, hot_cold, overflow_acc,
+                     params, opt_state, dropout_key, ds, x, labels, seeds):
+    """Shared tail of both step functions: objective, grad pmean over the
+    data axes (the DDP-analog allreduce), optimizer update — plus, on
+    hot/cold layouts, the worst cold-budget overflow across groups as a
+    FOURTH output (persistently nonzero means the budget needs raising,
+    see `sharded_gather_hot_cold`)."""
+    y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
+
+    def objective(p):
+        logits = model.apply(
+            p, x, ds.adjs, train=train,
+            rngs={"dropout": dropout_key} if train else None,
+        )
+        ll = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(ll, y[:, None].astype(jnp.int32), axis=1)[:, 0]
+        return nll.mean()
+
+    loss, grads = jax.value_and_grad(objective)(params)
+    grads = lax.pmean(grads, data_axes)
+    loss = lax.pmean(loss, data_axes)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    if hot_cold:
+        overflow = lax.pmax(sum(overflow_acc), data_axes)
+        return params, opt_state, loss, overflow
+    return params, opt_state, loss
+
+
+def _step_specs(hot_cold, feat_axes):
+    """(feat_spec, out_specs) for shard_map: hot block replicated over host
+    (striped over ici) + cold block striped over every feature axis on
+    hot/cold layouts; a single striped table otherwise."""
+    if hot_cold:
+        ici_axes = tuple(a for a in feat_axes if a != "host")
+        feat_spec = (P(ici_axes, None), P(feat_axes, None))
+        return feat_spec, (P(), P(), P(), P())
+    return P(feat_axes, None), (P(), P(), P())
+
+
 def make_sharded_train_step(
     mesh: Mesh,
     model,
@@ -144,52 +240,18 @@ def make_sharded_train_step(
     step; persistently nonzero means the budget needs raising
     (`calibrate_cold_budget` produces a float budget with margin).
     """
-    if pipeline not in ("dedup", "fused"):
-        raise ValueError(f"unknown pipeline: {pipeline!r}")
-    if pipeline == "fused" and caps is not None:
-        raise ValueError(
-            "caps only apply to the dedup pipeline: the fused layout is "
-            "structural (width is exactly B*prod(1+k), not cappable)"
-        )
     # with a "host" DCN axis (make_mesh(hosts=...)), the feature table
     # stripes over (host, ici) and gradients sync over (host, dp)
-    has_host = "host" in mesh.axis_names
-    data_axes, feat_axes, _ = mesh_axes(mesh)
-    hot_cold = hot_rows is not None
-    if hot_cold and not has_host:
-        raise ValueError(
-            "hot_rows/cold_budget need a multi-host mesh: on a single host "
-            "the plain ici-sharded gather already pays no DCN cost"
-        )
-    if hot_cold and cold_budget is None:
-        raise ValueError("hot_rows set but cold_budget missing")
+    has_host, data_axes, feat_axes, hot_cold = _validate_step_config(
+        mesh, pipeline, caps, hot_rows, cold_budget
+    )
 
     def step_local(params, opt_state, key, indptr, indices, feat_block, labels, seeds):
         overflow_acc = []
-
-        def gather_rows(tab, ids):
-            # hosts sample DIFFERENT seeds, so the host axis needs the
-            # grouped gather (see sharded_gather_grouped: all_gather ids
-            # over host, gather once, slice own answer)
-            if hot_cold:
-                hot_block, cold_block = tab
-                rows, overflow = sharded_gather_hot_cold(
-                    hot_block, cold_block, ids, feat_axes, "host",
-                    hot_rows, cold_budget,
-                )
-                overflow_acc.append(overflow)
-                return rows
-            if not has_host:
-                return sharded_gather(tab, ids, feat_axes)
-            return sharded_gather_grouped(tab, ids, feat_axes, "host")
-
-        dp_idx = lax.axis_index("dp")
-        if has_host:
-            dp_idx = lax.axis_index("host") * lax.axis_size("dp") + dp_idx
-        # distinct sample stream per data-parallel group, identical within
-        # an ici group
-        key = jax.random.fold_in(key, dp_idx)
-        key, dropout_key = jax.random.split(key)
+        gather_rows = _make_gather_rows(
+            has_host, hot_cold, feat_axes, hot_rows, cold_budget, overflow_acc
+        )
+        key, dropout_key = jax.random.split(_fold_group_key(key, has_host))
         if pipeline == "fused":
             ds, x = sample_and_gather_fused(
                 indptr, indices, feat_block, key, seeds, tuple(sizes),
@@ -203,39 +265,12 @@ def make_sharded_train_step(
                 indptr, indices, feat_block, key, seeds, tuple(sizes), caps,
                 gather_fn=gather_rows,
             )
-        y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
+        return _loss_and_update(
+            model, tx, train, data_axes, hot_cold, overflow_acc,
+            params, opt_state, dropout_key, ds, x, labels, seeds,
+        )
 
-        def objective(p):
-            logits = model.apply(
-                p, x, ds.adjs, train=train,
-                rngs={"dropout": dropout_key} if train else None,
-            )
-            ll = jax.nn.log_softmax(logits)
-            nll = -jnp.take_along_axis(ll, y[:, None].astype(jnp.int32), axis=1)[:, 0]
-            return nll.mean()
-
-        loss, grads = jax.value_and_grad(objective)(params)
-        grads = lax.pmean(grads, data_axes)
-        loss = lax.pmean(loss, data_axes)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if hot_cold:
-            # worst cold-budget overflow across groups this step: a
-            # persistently nonzero value means zeroed feature rows — raise
-            # the budget (see sharded_gather_hot_cold docstring)
-            overflow = lax.pmax(sum(overflow_acc), data_axes)
-            return params, opt_state, loss, overflow
-        return params, opt_state, loss
-
-    if hot_cold:
-        ici_axes = tuple(a for a in feat_axes if a != "host")
-        # hot block replicated over host (striped over ici); cold block
-        # striped over every feature axis
-        feat_spec = (P(ici_axes, None), P(feat_axes, None))
-        out_specs = (P(), P(), P(), P())
-    else:
-        feat_spec = P(feat_axes, None)
-        out_specs = (P(), P(), P())
+    feat_spec, out_specs = _step_specs(hot_cold, feat_axes)
     sharded = _shard_map_fn(
         step_local,
         mesh=mesh,
@@ -290,39 +325,15 @@ def make_sharded_topo_train_step(
     """
     from .topology import sharded_sample_layer, sharded_sample_layer_grouped
 
-    if pipeline not in ("dedup", "fused"):
-        raise ValueError(f"unknown pipeline: {pipeline!r}")
-    if pipeline == "fused" and caps is not None:
-        raise ValueError(
-            "caps only apply to the dedup pipeline: the fused layout is "
-            "structural (width is exactly B*prod(1+k), not cappable)"
-        )
-    has_host = "host" in mesh.axis_names
-    data_axes, feat_axes, _ = mesh_axes(mesh)
-    hot_cold = hot_rows is not None
-    if hot_cold and not has_host:
-        raise ValueError(
-            "hot_rows/cold_budget need a multi-host mesh: on a single host "
-            "the plain ici-sharded gather already pays no DCN cost"
-        )
-    if hot_cold and cold_budget is None:
-        raise ValueError("hot_rows set but cold_budget missing")
+    has_host, data_axes, feat_axes, hot_cold = _validate_step_config(
+        mesh, pipeline, caps, hot_rows, cold_budget
+    )
 
     def step_local(params, opt_state, key, stopo, feat_block, labels, seeds):
         overflow_acc = []
-
-        def gather_rows(tab, ids):
-            if hot_cold:
-                hot_block, cold_block = tab
-                rows, overflow = sharded_gather_hot_cold(
-                    hot_block, cold_block, ids, feat_axes, "host",
-                    hot_rows, cold_budget,
-                )
-                overflow_acc.append(overflow)
-                return rows
-            if not has_host:
-                return sharded_gather(tab, ids, feat_axes)
-            return sharded_gather_grouped(tab, ids, feat_axes, "host")
+        gather_rows = _make_gather_rows(
+            has_host, hot_cold, feat_axes, hot_rows, cold_budget, overflow_acc
+        )
 
         indptr_blk = stopo.indptr[0]    # [R_max+1] this shard's local indptr
         indices_blk = stopo.indices[0]  # [E_pad]   this shard's edge block
@@ -339,11 +350,7 @@ def make_sharded_topo_train_step(
                 feat_axes, "host",
             )
 
-        dp_idx = lax.axis_index("dp")
-        if has_host:
-            dp_idx = lax.axis_index("host") * lax.axis_size("dp") + dp_idx
-        key = jax.random.fold_in(key, dp_idx)
-        key, dropout_key = jax.random.split(key)
+        key, dropout_key = jax.random.split(_fold_group_key(key, has_host))
         if pipeline == "fused":
             ds, x = sample_and_gather_fused(
                 None, None, feat_block, key, seeds, tuple(sizes),
@@ -354,37 +361,15 @@ def make_sharded_topo_train_step(
                 None, None, feat_block, key, seeds, tuple(sizes), caps,
                 gather_fn=gather_rows, sample_fn=sample_fn,
             )
-        y = jnp.take(labels, jnp.clip(ds.n_id[: seeds.shape[0]], 0, labels.shape[0] - 1))
-
-        def objective(p):
-            logits = model.apply(
-                p, x, ds.adjs, train=train,
-                rngs={"dropout": dropout_key} if train else None,
-            )
-            ll = jax.nn.log_softmax(logits)
-            nll = -jnp.take_along_axis(ll, y[:, None].astype(jnp.int32), axis=1)[:, 0]
-            return nll.mean()
-
-        loss, grads = jax.value_and_grad(objective)(params)
-        grads = lax.pmean(grads, data_axes)
-        loss = lax.pmean(loss, data_axes)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if hot_cold:
-            overflow = lax.pmax(sum(overflow_acc), data_axes)
-            return params, opt_state, loss, overflow
-        return params, opt_state, loss
+        return _loss_and_update(
+            model, tx, train, data_axes, hot_cold, overflow_acc,
+            params, opt_state, dropout_key, ds, x, labels, seeds,
+        )
 
     from .topology import topology_specs
 
     topo_specs = topology_specs(feat_axes)
-    if hot_cold:
-        ici_axes = tuple(a for a in feat_axes if a != "host")
-        feat_spec = (P(ici_axes, None), P(feat_axes, None))
-        out_specs = (P(), P(), P(), P())
-    else:
-        feat_spec = P(feat_axes, None)
-        out_specs = (P(), P(), P())
+    feat_spec, out_specs = _step_specs(hot_cold, feat_axes)
     sharded = _shard_map_fn(
         step_local,
         mesh=mesh,

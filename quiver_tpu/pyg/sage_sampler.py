@@ -96,6 +96,14 @@ class DenseSample(NamedTuple):
     count: jax.Array         # scalar int32 valid length of n_id
     batch_size: int
     adjs: Tuple[DenseAdj, ...]  # outermost hop first (reference reverses too)
+    # dedup pipelines only (None elsewhere): the machinery that lets static
+    # caps run TIGHT margins without silently changing sampling semantics.
+    # cap_overflow: scalar int32, unique frontier nodes dropped by the caps
+    # this batch (0 == bit-exact reference semantics); raw_counts: [L] int32
+    # PRE-cap unique counts per hop (innermost-sampled last) — feed them to
+    # `caps_from_counts` to recalibrate instead of re-probing.
+    cap_overflow: Optional[jax.Array] = None
+    raw_counts: Optional[jax.Array] = None
 
 
 def sample_dense_fused(
@@ -259,16 +267,20 @@ def sample_and_gather_dedup(
     cur = seeds
     cur_valid = jnp.ones((B,), bool)
     adjs: List[DenseAdj] = []
+    raws: List[jax.Array] = []
+    overflow = jnp.asarray(0, jnp.int32)
     prev_count = jnp.asarray(B, jnp.int32)
     for l, k in enumerate(sizes[:-1]):
         key, sub = jax.random.split(key)
         nbrs, valid = sample_fn(cur, cur_valid, k, sub)
         res = local_reindex(cur, cur_valid, nbrs, valid)
         n_id, count = res.n_id, res.count
+        raws.append(count)
         local_nbrs, nbr_valid = res.local_nbrs, res.nbr_valid
         if widths[l + 1] < n_id.shape[0]:
             cap = widths[l + 1]
             n_id = n_id[:cap]
+            overflow = overflow + jnp.maximum(count - cap, 0)
             count = jnp.minimum(count, cap)
             nbr_valid = nbr_valid & (local_nbrs < cap)
         adjs.append(
@@ -285,11 +297,14 @@ def sample_and_gather_dedup(
     x = jnp.concatenate([gather_fn(table, cur), gather_fn(table, flat)], axis=0)
     n_src = prev_count + valid.sum().astype(jnp.int32)
     adjs.append(DenseAdj(cols=None, mask=valid, n_src=n_src, n_dst=prev_count))
+    raws.append(n_src)  # structural leaves are never capped
     ds = DenseSample(
         n_id=jnp.concatenate([cur, flat]),
         count=n_src,
         batch_size=B,
         adjs=tuple(adjs[::-1]),
+        cap_overflow=overflow,
+        raw_counts=jnp.stack(raws),
     )
     return ds, x
 
@@ -321,16 +336,20 @@ def sample_dense_pure(
     cur = seeds
     cur_valid = jnp.ones((B,), bool)
     adjs: List[DenseAdj] = []
+    raws: List[jax.Array] = []
+    overflow = jnp.asarray(0, jnp.int32)
     prev_count = jnp.asarray(B, jnp.int32)
     for l, k in enumerate(sizes):
         key, sub = jax.random.split(key)
         nbrs, valid = sample_fn(cur, cur_valid, k, sub)
         res = local_reindex(cur, cur_valid, nbrs, valid)
         n_id, count = res.n_id, res.count
+        raws.append(count)
         local_nbrs, nbr_valid = res.local_nbrs, res.nbr_valid
         if widths[l + 1] < n_id.shape[0]:
             cap = widths[l + 1]
             n_id = n_id[:cap]
+            overflow = overflow + jnp.maximum(count - cap, 0)
             count = jnp.minimum(count, cap)
             nbr_valid = nbr_valid & (local_nbrs < cap)
         adjs.append(
@@ -339,7 +358,14 @@ def sample_dense_pure(
         cur = n_id
         cur_valid = jnp.arange(n_id.shape[0], dtype=jnp.int32) < count
         prev_count = count
-    return DenseSample(n_id=cur, count=prev_count, batch_size=B, adjs=tuple(adjs[::-1]))
+    return DenseSample(
+        n_id=cur,
+        count=prev_count,
+        batch_size=B,
+        adjs=tuple(adjs[::-1]),
+        cap_overflow=overflow,
+        raw_counts=jnp.stack(raws),
+    )
 
 
 import functools as _functools
@@ -440,6 +466,15 @@ class GraphSageSampler:
     dedup : True (default) dedups every hop like the reference's hash-table
         reindex; False uses the fused no-reindex hot path
         (`sample_dense_fused`) — fastest on TPU, n_id may repeat nodes
+    auto_grow_caps : opt-in overflow ladder for TIGHT caps. When a dedup
+        batch overflows its caps (``DenseSample.cap_overflow > 0`` — unique
+        nodes would have been dropped), recalibrate the caps from that
+        batch's pre-cap ``raw_counts`` (margin/granule from the last
+        `calibrate_caps` call) and resample. Costs one host sync per
+        ``sample_dense`` call and a recompile per cap change, so use with
+        granule-rounded caps where regrowth is rare; the payoff is running
+        margins like 1.1 instead of 1.2 — less padded gather width — while
+        keeping exact reference sampling semantics.
     """
 
     MODE_ALIASES = {"GPU": "TPU", "UVA": "HOST", "ZERO_COPY": "HOST", "DMA": "TPU"}
@@ -455,6 +490,7 @@ class GraphSageSampler:
         dedup: bool = True,
         weighted: bool = False,
         max_deg: int = 512,
+        auto_grow_caps: bool = False,
     ):
         mode = self.MODE_ALIASES.get(mode, mode)
         if mode not in ("TPU", "HOST", "CPU"):
@@ -467,6 +503,11 @@ class GraphSageSampler:
         self.dedup = dedup
         self.weighted = weighted
         self.max_deg = int(max_deg)
+        self.auto_grow_caps = bool(auto_grow_caps)
+        # recalibration policy for the overflow ladder; updated by
+        # calibrate_caps so regrowth uses the margin the caps were born with
+        self.cap_margin = 1.2
+        self.cap_granule = 4096
         if weighted:
             if csr_topo.edge_weights is None:
                 raise ValueError(
@@ -543,10 +584,41 @@ class GraphSageSampler:
                     indptr, indices, self._next_key(), seeds, self.sizes,
                     sample_fn=sample_fn,
                 )
-            return sample_dense_pure(
+            ds = sample_dense_pure(
                 indptr, indices, self._next_key(), seeds, self.sizes, self.caps,
                 sample_fn=sample_fn,
             )
+            if self.auto_grow_caps and self.caps is not None:
+                # overflow ladder: regrow caps from the observed pre-cap
+                # counts and resample until nothing is dropped. raw_counts of
+                # hop l+1 are measured under hop l's (possibly capped)
+                # frontier, so one regrow can reveal more demand — iterate,
+                # bounded (caps_from_counts clips at the uncapped worst case,
+                # where overflow is impossible by construction).
+                for _ in range(len(self.sizes) + 1):
+                    if int(ds.cap_overflow) == 0:
+                        break
+                    grown = caps_from_counts(
+                        np.asarray(ds.raw_counts)[None, :], seeds.shape[0],
+                        self.sizes, margin=self.cap_margin,
+                        granule=self.cap_granule,
+                    )
+                    # monotone merge: one batch's raw_counts must only ever
+                    # RAISE caps — taking them wholesale would shrink hops
+                    # that didn't overflow this batch (raw_counts are a
+                    # single sample, not the calibrated max), ping-ponging
+                    # caps and recompiling every few batches. None stays
+                    # None: an uncapped hop cannot overflow, so capping it
+                    # would force a shape change no overflow ever demanded.
+                    self.caps = tuple(
+                        None if o is None else max(o, n)
+                        for o, n in zip(self.caps, grown)
+                    )
+                    ds = sample_dense_pure(
+                        indptr, indices, self._next_key(), seeds, self.sizes,
+                        self.caps, sample_fn=sample_fn,
+                    )
+            return ds
         return self._host_sample_dense(np.asarray(seeds))
 
     def _host_sample_dense(self, seeds: np.ndarray) -> DenseSample:
@@ -680,6 +752,7 @@ class GraphSageSampler:
         caps = caps_from_counts(
             counts, batches.shape[1], self.sizes, margin=margin, granule=granule
         )
+        self.cap_margin, self.cap_granule = float(margin), int(granule)
         if set_caps:
             self.caps = caps
         return caps
@@ -699,14 +772,17 @@ class GraphSageSampler:
         return (
             self.csr_topo, self.sizes, self.device, self.mode, self.caps,
             self._seed, self.dedup, self.weighted, self.max_deg,
+            self.auto_grow_caps,
         )
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
-        csr_topo, sizes, device, mode, caps, seed, dedup, weighted, max_deg = ipc_handle
+        (csr_topo, sizes, device, mode, caps, seed, dedup, weighted, max_deg,
+         auto_grow_caps) = ipc_handle
         return cls(
             csr_topo, sizes, device=device, mode=mode, caps=caps, seed=seed,
             dedup=dedup, weighted=weighted, max_deg=max_deg,
+            auto_grow_caps=auto_grow_caps,
         )
 
 

@@ -1,0 +1,72 @@
+"""Emit the predicted multi-chip scaling table (SCALING.md + one JSON line).
+
+Usage:
+    python scripts/scaling_model.py [--step-ms 55] [--bench BENCH.json]
+        [--ici-gbps 90] [--dcn-gbps 25] [--out SCALING.md]
+
+Single-chip step time comes from --step-ms, or is pulled from a bench
+artifact's e2e context (fused epoch / 193 steps) with --bench. See
+quiver_tpu/parallel/scaling.py for the model and its assumptions; the
+reference's measured counterpart is docs/Introduction_en.md:144-158."""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--step-ms", type=float, default=None)
+    ap.add_argument("--bench", default=None, help="BENCH_r*.json to read e2e from")
+    ap.add_argument("--ici-gbps", type=float, default=90.0)
+    ap.add_argument("--dcn-gbps", type=float, default=25.0)
+    ap.add_argument("--steps-per-epoch", type=int, default=193)
+    ap.add_argument("--out", default=None, help="write a markdown table here")
+    args = ap.parse_args()
+
+    step_s = (args.step_ms or 0) / 1e3
+    source = f"--step-ms {args.step_ms}"
+    if not step_s and args.bench:
+        with open(args.bench) as fh:
+            data = json.load(fh)
+        ctx = (data.get("parsed") or data).get("context", {})
+        epoch = ctx.get("e2e_fused_epoch_s")
+        if epoch:
+            step_s = epoch / args.steps_per_epoch
+            source = f"{args.bench} e2e_fused_epoch_s={epoch}"
+    if not step_s:
+        step_s = 0.055  # PERF_NOTES.md measured products step
+        source = "PERF_NOTES.md default 55 ms"
+
+    from quiver_tpu.parallel.scaling import format_markdown, products_scaling_table
+
+    bw = {"ici_bytes_per_s": args.ici_gbps * 1e9, "dcn_bytes_per_s": args.dcn_gbps * 1e9}
+    rows = products_scaling_table(
+        step_s, steps_per_epoch_1chip=args.steps_per_epoch, bandwidths=bw
+    )
+    md = format_markdown(rows, step_s, bw)
+    print(md, file=sys.stderr)
+    if args.out:
+        header = (
+            "# Predicted multi-chip scaling (static model)\n\n"
+            "Reference publishes measured 1-4 GPU scaling "
+            "(docs/Introduction_en.md:144-158: epochs 11.1 / 6.0 / 4.0 / 3.2 s);\n"
+            "this table is the analytic counterpart for the TPU layouts — see\n"
+            "`quiver_tpu/parallel/scaling.py` for the model, assumptions, and\n"
+            "how to swap predictions for measurements on real hardware.\n"
+            f"Single-chip step source: {source}.\n\n"
+        )
+        with open(args.out, "w") as fh:
+            fh.write(header + md + "\n")
+    print(json.dumps({
+        "step_s_1chip": step_s,
+        "source": source,
+        "rows": [r._asdict() for r in rows],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -384,3 +384,94 @@ def test_weighted_sampler_end_to_end(graph):
         GraphSageSampler(graph, sizes=[3], weighted=True)
     with pytest.raises(ValueError, match="TPU"):
         GraphSageSampler(topo, sizes=[3], mode="HOST", weighted=True)
+
+
+def test_cap_overflow_counter(graph):
+    """Static caps must never SILENTLY drop frontier nodes: the dedup
+    pipelines report the dropped-unique-node count (cap_overflow) and the
+    pre-cap per-hop counts (raw_counts) so callers can recalibrate. The
+    reference never drops (ragged CUDA shapes) — the counter is what makes
+    tight static-shape margins semantically honest on TPU."""
+    from quiver_tpu.pyg.sage_sampler import sample_dense_pure
+
+    indptr, indices = graph.to_device()
+    seeds = jnp.arange(24, dtype=indices.dtype)
+    key = jax.random.key(3)
+
+    free = sample_dense_pure(indptr, indices, key, seeds, (4, 3))
+    assert int(free.cap_overflow) == 0
+    raw = np.asarray(free.raw_counts)
+    assert raw.shape == (2,)
+    assert raw.tolist() == [int(a.n_src) for a in free.adjs[::-1]]
+
+    # cap the first hop below its observed unique count: overflow must equal
+    # exactly the excess, and the capped run's own raw_counts must agree
+    cap0 = int(raw[0]) - 5
+    capped = sample_dense_pure(indptr, indices, key, seeds, (4, 3), caps=(cap0, None))
+    craw = np.asarray(capped.raw_counts)
+    assert craw[0] == raw[0]  # first hop's pre-cap count is cap-independent
+    expected = max(int(craw[0]) - cap0, 0) + 0  # second hop uncapped
+    assert int(capped.cap_overflow) == expected > 0
+
+
+def test_structleaf_cap_overflow(graph):
+    """sample_and_gather_dedup: inner-hop caps feed the counter; the
+    structural leaf hop is never capped, so its raw count equals n_src."""
+    from quiver_tpu.pyg.sage_sampler import sample_and_gather_dedup
+
+    feat = jnp.zeros((graph.node_count, 4), jnp.float32)
+    indptr, indices = graph.to_device()
+    seeds = jnp.arange(16, dtype=indices.dtype)
+    ds, _ = sample_and_gather_dedup(
+        indptr, indices, feat, jax.random.key(1), seeds, (4, 3), caps=(20, None)
+    )
+    raw = np.asarray(ds.raw_counts)
+    assert raw.shape == (2,)
+    assert int(ds.cap_overflow) == max(int(raw[0]) - 20, 0) > 0
+    assert int(raw[1]) == int(ds.count)  # leaf hop: raw == n_src, uncapped
+
+
+def test_auto_grow_caps_restores_semantics(graph):
+    """auto_grow_caps: a sampler born with absurdly tight caps must regrow
+    them from observed raw counts until nothing is dropped."""
+    s = GraphSageSampler(
+        graph, sizes=[4, 3], mode="TPU", seed=0,
+        caps=(8, 16), auto_grow_caps=True,
+    )
+    s.cap_margin, s.cap_granule = 1.1, 8
+    ds = s.sample_dense(np.arange(24))
+    assert int(ds.cap_overflow) == 0
+    assert s.caps[0] > 8  # the ladder actually grew the caps
+    # and the result matches an uncapped sample's frontier size
+    assert int(ds.count) == int(np.asarray(ds.raw_counts)[-1])
+
+
+def test_auto_grow_caps_never_shrinks(graph):
+    """Regrowing from ONE batch's raw_counts must merge monotonically: a
+    generous cap on a non-overflowing hop stays put (taking the single
+    batch's counts wholesale would shrink it, ping-ponging caps and
+    recompiling every few batches)."""
+    s = GraphSageSampler(
+        graph, sizes=[4, 3], mode="TPU", seed=0,
+        caps=(8, 512), auto_grow_caps=True,
+    )
+    s.cap_margin, s.cap_granule = 1.1, 8
+    ds = s.sample_dense(np.arange(24))
+    assert int(ds.cap_overflow) == 0
+    assert s.caps[0] > 8
+    assert s.caps[1] == 512  # generous hop untouched by the hop-0 regrow
+
+
+def test_auto_grow_caps_preserves_none(graph):
+    """An uncapped hop (caps entry None) must STAY uncapped through the
+    ladder: None means overflow there is impossible, and capping it would
+    force a shape change no overflow ever demanded."""
+    s = GraphSageSampler(
+        graph, sizes=[4, 3], mode="TPU", seed=0,
+        caps=(8, None), auto_grow_caps=True,
+    )
+    s.cap_margin, s.cap_granule = 1.1, 8
+    ds = s.sample_dense(np.arange(24))
+    assert int(ds.cap_overflow) == 0
+    assert s.caps[0] > 8
+    assert s.caps[1] is None

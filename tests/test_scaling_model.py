@@ -1,0 +1,109 @@
+"""Scaling-model sanity: the static predictor must behave like the physics
+it models (reference anchor: the measured 1-4 GPU tables in
+docs/Introduction_en.md:123-158, which this environment cannot measure)."""
+
+import numpy as np
+
+from quiver_tpu.parallel.scaling import (
+    ShapeMesh,
+    comm_seconds,
+    grad_psum_bytes,
+    predict_layout,
+    products_scaling_table,
+)
+
+
+STEP = 0.055  # measured single-chip products step (PERF_NOTES.md)
+
+
+def test_dp_replicated_near_linear():
+    """Gradient-psum-only layout: tiny comm, so dp scaling must stay near
+    linear (the reference's DDP epochs scale 11.1 -> 3.2 s at 4 GPUs =
+    87% efficiency; the model should predict at least that well for the
+    collective the TPU step actually runs)."""
+    rows = products_scaling_table(STEP)
+    dp = [r for r in rows if r.layout == "dp_replicated"]
+    assert [r.n_devices for r in dp] == [1, 2, 4, 8]
+    assert dp[0].epoch_s_pessimistic >= STEP * 193 * 0.99
+    for r in dp[1:]:
+        assert r.efficiency_pessimistic > 0.9, r
+    # epochs shrink monotonically with chips
+    es = [r.epoch_s_pessimistic for r in dp]
+    assert es == sorted(es, reverse=True)
+
+
+def test_comm_grows_with_layout_richness():
+    """At the same chip count, each richer layout pays at least as much
+    comm: replicated <= ici-sharded features <= sharded topology."""
+    mesh = ShapeMesh(("dp", "ici"), {"dp": 2, "ici": 2})
+    kw = dict(
+        step_s_1chip=STEP, steps_per_epoch_1chip=193, sizes=(15, 10, 5),
+        batch_per_group=1024, feature_dim=100, param_bytes=1_650_000,
+    )
+    a = predict_layout("dp_replicated", mesh, **kw)
+    b = predict_layout("dp_ici_features", mesh, **kw)
+    c = predict_layout("sharded_topology", mesh, **kw)
+    assert a.step_comm_s < b.step_comm_s < c.step_comm_s
+    assert b.ici_bytes > a.ici_bytes
+    assert c.ici_bytes > b.ici_bytes
+
+
+def test_host_axis_bytes_ride_dcn():
+    """Adding a host axis must move bytes onto the DCN account, and DCN
+    bytes must cost more seconds than the same bytes on ICI."""
+    kw = dict(
+        step_s_1chip=STEP, steps_per_epoch_1chip=193, sizes=(15, 10, 5),
+        batch_per_group=1024, feature_dim=100, param_bytes=1_650_000,
+    )
+    single = predict_layout(
+        "sharded_topology", ShapeMesh(("dp", "ici"), {"dp": 2, "ici": 2}), **kw
+    )
+    multi = predict_layout(
+        "sharded_topology",
+        ShapeMesh(("host", "dp", "ici"), {"host": 2, "dp": 2, "ici": 2}), **kw
+    )
+    assert single.dcn_bytes == 0.0
+    assert multi.dcn_bytes > 0.0
+    assert comm_seconds(0.0, 1e9) > comm_seconds(1e9, 0.0)
+
+
+def test_grad_psum_ring_model():
+    pb = 4_000_000
+    m = ShapeMesh(("dp", "ici"), {"dp": 4, "ici": 1})
+    out = grad_psum_bytes(pb, m)
+    np.testing.assert_allclose(out["ici_bytes"], 2 * 3 / 4 * pb)
+    assert out["dcn_bytes"] == 0.0
+    m2 = ShapeMesh(("host", "dp", "ici"), {"host": 2, "dp": 2, "ici": 1})
+    out2 = grad_psum_bytes(pb, m2)
+    np.testing.assert_allclose(out2["dcn_bytes"], 2 * 1 / 2 * pb)
+
+
+def test_caps_shrink_comm():
+    """Tighter sampler caps must shrink the modeled collective payloads —
+    the multichip face of the bench's tight-margin work."""
+    mesh = ShapeMesh(("dp", "ici"), {"dp": 2, "ici": 2})
+    kw = dict(
+        step_s_1chip=STEP, steps_per_epoch_1chip=193, sizes=(15, 10, 5),
+        batch_per_group=1024, feature_dim=100, param_bytes=1_650_000,
+    )
+    loose = predict_layout("sharded_topology", mesh, **kw)
+    tight = predict_layout(
+        "sharded_topology", mesh, caps=(8192, 65536, 262144), **kw
+    )
+    assert tight.ici_bytes < loose.ici_bytes
+
+
+def test_hot_cold_tier_cuts_dcn():
+    """The replicated-hot tier must cut the modeled DCN feature payload to
+    the cold fraction while leaving ICI untouched — the static face of
+    tests/test_hot_cold.py::test_hot_cold_dcn_reduction_at_measured_hit_rate."""
+    mesh = ShapeMesh(("host", "dp", "ici"), {"host": 2, "dp": 2, "ici": 2})
+    kw = dict(
+        step_s_1chip=STEP, steps_per_epoch_1chip=193, sizes=(15, 10, 5),
+        batch_per_group=1024, feature_dim=100, param_bytes=1_650_000,
+    )
+    full = predict_layout("sharded_topology", mesh, **kw)
+    hc = predict_layout("sharded_topology_hot_cold", mesh, **kw)
+    assert hc.ici_bytes == full.ici_bytes
+    assert hc.dcn_bytes < full.dcn_bytes
+    assert hc.layout == "sharded_topology_hot_cold"
