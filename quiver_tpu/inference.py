@@ -96,32 +96,26 @@ def sage_full_inference(
     return h
 
 
-import weakref
-
-# value-keyed weak cache: equal-config models share one jitted apply (flax
-# modules are frozen dataclasses, hashable by field values) and entries die
-# with their last model — an id()-keyed dict would pin every model plus its
-# compiled executable for the process lifetime (hyperparameter sweeps OOM)
-_APPLY_CACHE = weakref.WeakKeyDictionary()
+@functools.lru_cache(maxsize=32)
+def _cached_apply_hashable(model):
+    return jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
 
 
 def _cached_apply(model):
     """One jitted apply per model VALUE — a fresh jit per sampled_eval call
     would recompile an identical program every invocation.
 
-    The cached closure must NOT capture ``model`` strongly: the value would
-    pin its own WeakKeyDictionary key forever and nothing would ever evict.
-    It closes over a weakref proxy instead — tracing only happens while the
-    model is alive (the cache entry dies with it)."""
+    Value-keyed (flax modules are frozen dataclasses, hashable by field
+    values: equal configs share one entry) and BOUNDED: the lru_cache holds
+    at most 32 models + executables, so repeated model construction (e.g. a
+    hyperparameter sweep) evicts old entries instead of growing without
+    bound. Weak-keyed variants were rejected — a closure capturing the key
+    pins it (no eviction), and a weakref proxy raises ReferenceError when a
+    retrace outlives the first-seen equal model."""
     try:
-        fn = _APPLY_CACHE.get(model)
+        return _cached_apply_hashable(model)
     except TypeError:  # unhashable custom module: skip caching
         return jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
-    if fn is None:
-        ref = weakref.proxy(model)
-        fn = jax.jit(lambda p, x, adjs: ref.apply(p, x, adjs))
-        _APPLY_CACHE[model] = fn
-    return fn
 
 
 def sampled_eval(

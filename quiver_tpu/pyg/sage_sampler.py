@@ -618,6 +618,20 @@ class GraphSageSampler:
                         indptr, indices, self._next_key(), seeds, self.sizes,
                         self.caps, sample_fn=sample_fn,
                     )
+                if int(ds.cap_overflow) > 0:
+                    # ladder bound exhausted (per-key count fluctuation can
+                    # outrun a small margin): surface it — the caller still
+                    # sees cap_overflow, but silence here would contradict
+                    # the "resample until nothing is dropped" contract
+                    import warnings
+
+                    warnings.warn(
+                        f"auto_grow_caps: still dropping "
+                        f"{int(ds.cap_overflow)} nodes after regrowth to "
+                        f"caps={self.caps}; raise cap_margin/cap_granule",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
             return ds
         return self._host_sample_dense(np.asarray(seeds))
 
