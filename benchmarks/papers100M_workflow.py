@@ -89,10 +89,10 @@ def run_sharded(args, edge_index, feat, labels, train_idx, val_idx):
 
     rng = np.random.default_rng(0)
     sampler = GraphSageSampler(topo, sizes=sizes, mode="TPU", seed=7)
-    # probe at the TRAINING batch size: caps scale with B, so calibrating
-    # on a different width would mis-size every hop
+    # probe at the TRAINING batch size (caps scale with B) over >= 8
+    # batches (calibrate_caps docstring: fewer gives an unstable max)
     probe_b = min(args.batch_per_dp, len(train_idx))
-    probes = [rng.choice(train_idx, probe_b) for _ in range(4)]
+    probes = [rng.choice(train_idx, probe_b) for _ in range(8)]
     caps = sampler.calibrate_caps(np.stack(probes), margin=1.2)
     hot_rows = int(n * args.hot_frac) if args.hot_frac and args.hosts else None
     cold_budget = (
@@ -124,11 +124,13 @@ def run_sharded(args, edge_index, feat, labels, train_idx, val_idx):
 
     from quiver_tpu.pyg.sage_sampler import sample_dense_pure
 
+    # init-shape probe through the sampler's own device arrays: CSRTopo
+    # picks the id dtype (and refuses int64 when x64 is off) instead of a
+    # hand-rolled int32 cast that would wrap >2^31-edge graphs
+    ip0, ix0 = sampler.lazy_init_quiver()
     ds0 = sample_dense_pure(
-        jnp.asarray(topo.indptr.astype(np.int32)),
-        jnp.asarray(topo.indices.astype(np.int32)),
-        jax.random.key(0),
-        jnp.arange(args.batch_per_dp, dtype=jnp.int32), sizes, caps,
+        ip0, ix0, jax.random.key(0),
+        jnp.arange(args.batch_per_dp, dtype=ix0.dtype), sizes, caps,
     )
     x0 = jnp.zeros((ds0.n_id.shape[0], feat.shape[1]), jnp.float32)
     params = replicate(
@@ -152,13 +154,16 @@ def run_sharded(args, edge_index, feat, labels, train_idx, val_idx):
             out = step(params, opt_state, jax.random.key(epoch * 10000 + i),
                        stopo, feat_d, labels_d, seeds)
             if hot_rows:
-                params, opt_state, loss, _ov = out
+                params, opt_state, loss, overflow = out
             else:
-                params, opt_state, loss = out
+                (params, opt_state, loss), overflow = out, None
         jax.block_until_ready(loss)
         dt = time.time() - t0
+        # persistent nonzero overflow = cold rows silently zeroed: raise
+        # the budget (same monitoring as examples/products_multichip.py)
+        ov = f"  cold_overflow={int(overflow)}" if overflow is not None else ""
         print(f"epoch {epoch}: {dt:.2f}s  loss={float(loss):.4f}  "
-              f"{steps * batch_global / dt:.0f} seeds/s")
+              f"{steps * batch_global / dt:.0f} seeds/s{ov}")
     # fresh UNCAPPED sampler for eval: the training caps were calibrated
     # for batch_per_dp-seed batches and would truncate bigger eval batches
     eval_sampler = GraphSageSampler(topo, sizes=sizes, mode="TPU", seed=123)
@@ -208,7 +213,8 @@ def run_host(args, edge_index, feat, labels, train_idx, val_idx, mmap_dir):
 
     pipe = TieredFeaturePipeline(feature)
     step_fn = make_tiered_train_step(model, tx, labels_d, pipe.hot_table)
-    tp = TrainPipeline(sampler, feature, step_fn, depth=2)
+    # share the ONE tiered pipeline (step_fn closes over its hot_table)
+    tp = TrainPipeline(sampler, feature, step_fn, depth=2, tiered=pipe)
 
     rng = np.random.default_rng(0)
     b0 = tp._stage(rng.choice(train_idx, args.batch_per_dp))

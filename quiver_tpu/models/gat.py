@@ -9,7 +9,7 @@ no segment ops.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -22,25 +22,32 @@ class GATConv(nn.Module):
     """Single GAT layer (PyG GATConv semantics, mean of heads optional).
 
     out[i] = sum_j alpha_ij * (W x_j), alpha over sampled neighbors + self.
+    ``dtype`` is the compute dtype (params stay float32; attention softmax
+    always runs float32 for stability).
     """
 
     out_dim: int
     heads: int = 1
     concat: bool = True
     negative_slope: float = 0.2
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x_src: jax.Array, adj: DenseAdj) -> jax.Array:
         h, d = self.heads, self.out_dim
+        if self.dtype is not None:
+            x_src = x_src.astype(self.dtype)
         w_dst = adj.w_dst
         x_dst = x_src[:w_dst]
 
-        proj = nn.Dense(h * d, use_bias=False, name="lin")
+        proj = nn.Dense(h * d, use_bias=False, dtype=self.dtype, name="lin")
         hs = proj(x_src).reshape(-1, h, d)          # [W_src, H, D]
         hd = hs[:w_dst]                              # [W_dst, H, D]
 
         a_src = self.param("att_src", nn.initializers.glorot_uniform(), (1, h, d))
         a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (1, h, d))
+        a_src = a_src.astype(hs.dtype)
+        a_dst = a_dst.astype(hs.dtype)
 
         hn = adj.gather_src(hs)                      # [W_dst, k, H, D]
         e_src = (hn * a_src[None]).sum(-1)           # [W_dst, k, H]
@@ -57,7 +64,9 @@ class GATConv(nn.Module):
         neg = jnp.asarray(-1e9, e.dtype)
         e = jnp.where(mask, e, neg)
         all_e = jnp.concatenate([e, e_self[:, None, :]], axis=1)  # [W_dst, k+1, H]
-        alpha = jax.nn.softmax(all_e, axis=1)
+        # softmax in f32 regardless of compute dtype: bf16 exp/normalize
+        # loses attention mass on long tails
+        alpha = jax.nn.softmax(all_e.astype(jnp.float32), axis=1).astype(hs.dtype)
         vals = jnp.concatenate([hn, hd[:, None]], axis=1)         # [W_dst, k+1, H, D]
         out = (alpha[..., None] * vals).sum(axis=1)               # [W_dst, H, D]
         if self.concat:
@@ -74,6 +83,7 @@ class GAT(nn.Module):
     heads: int = 4
     num_layers: int = 2
     dropout: float = 0.5
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -86,9 +96,10 @@ class GAT(nn.Module):
                 out_dim=self.out_dim if last else self.hidden_dim,
                 heads=1 if last else self.heads,
                 concat=not last,
+                dtype=self.dtype,
                 name=f"gat{i}",
             )(x, adj)
             if not last:
                 x = jax.nn.elu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return x
+        return x.astype(jnp.float32)

@@ -13,7 +13,7 @@ Semantics match PyG SAGEConv(mean): ``out = lin_l(mean_j x_j) + lin_r(x_i)``.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -38,30 +38,48 @@ def masked_mean_aggregate(x_src: jax.Array, adj: DenseAdj) -> jax.Array:
 
 
 class SAGEConv(nn.Module):
-    """One GraphSAGE layer (PyG SAGEConv, mean aggregator)."""
+    """One GraphSAGE layer (PyG SAGEConv, mean aggregator).
+
+    ``dtype`` is the COMPUTE dtype (e.g. ``jnp.bfloat16`` to run the
+    matmuls on the MXU's native precision); params stay float32 (flax
+    ``param_dtype`` default) — the standard TPU mixed-precision recipe."""
 
     out_dim: int
     use_bias: bool = True
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x_src: jax.Array, adj: DenseAdj) -> jax.Array:
+        if self.dtype is not None:
+            x_src = x_src.astype(self.dtype)
         w_dst = adj.w_dst
         x_dst = x_src[:w_dst]  # targets are the prefix of the source n_id
         agg = masked_mean_aggregate(x_src, adj)
-        h = nn.Dense(self.out_dim, use_bias=self.use_bias, name="lin_l")(agg)
-        h = h + nn.Dense(self.out_dim, use_bias=False, name="lin_r")(x_dst)
+        h = nn.Dense(
+            self.out_dim, use_bias=self.use_bias, dtype=self.dtype, name="lin_l"
+        )(agg)
+        h = h + nn.Dense(
+            self.out_dim, use_bias=False, dtype=self.dtype, name="lin_r"
+        )(x_dst)
         return h
 
 
 class GraphSAGE(nn.Module):
     """Multi-layer GraphSAGE matching the reference example models
     (examples/pyg/reddit_quiver.py SAGE class: relu + dropout between
-    layers, log_softmax head is left to the loss)."""
+    layers, log_softmax head is left to the loss).
+
+    ``dtype=jnp.bfloat16`` runs every layer's compute in bf16 (params and
+    returned logits stay float32, so losses/optimizers are unchanged) —
+    the feature gather itself is row-rate-bound and dtype-invariant
+    (PERF_NOTES.md), so this buys matmul time and activation memory, not
+    gather time."""
 
     hidden_dim: int
     out_dim: int
     num_layers: int = 2
     dropout: float = 0.5
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -74,8 +92,8 @@ class GraphSAGE(nn.Module):
         assert len(adjs) == self.num_layers, (len(adjs), self.num_layers)
         for i, adj in enumerate(adjs):
             dim = self.out_dim if i == self.num_layers - 1 else self.hidden_dim
-            x = SAGEConv(dim, name=f"conv{i}")(x, adj)
+            x = SAGEConv(dim, dtype=self.dtype, name=f"conv{i}")(x, adj)
             if i != self.num_layers - 1:
                 x = jax.nn.relu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return x
+        return x.astype(jnp.float32)

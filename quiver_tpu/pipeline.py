@@ -182,9 +182,13 @@ class TrainPipeline:
         feature: Feature,
         step_fn,
         depth: int = 2,
+        tiered: "TieredFeaturePipeline" = None,
     ):
         self.sampler = sampler
-        self.tiered = TieredFeaturePipeline(feature)
+        # callers that already built a TieredFeaturePipeline (e.g. to hand
+        # its hot_table to make_tiered_train_step) pass it in — two
+        # instances over one Feature would drift apart on stats
+        self.tiered = tiered if tiered is not None else TieredFeaturePipeline(feature)
         self.step_fn = step_fn
         self.depth = max(depth, 1)
         self.stats = PipelineStats()

@@ -1,0 +1,72 @@
+"""bf16 compute-dtype path: the MXU-native mixed-precision recipe (params
+float32, compute bfloat16, logits float32). The reference has no bf16 story
+(f32-only CUDA); on TPU it is the idiomatic default for matmul-heavy
+models, so the model zoo must support it without touching the loss or
+optimizer."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.models import GAT, GraphSAGE
+from quiver_tpu.pyg import GraphSageSampler
+from conftest import make_random_graph
+
+
+def _batch(seed=0):
+    topo = CSRTopo(edge_index=make_random_graph(200, 3000, seed=seed))
+    s = GraphSageSampler(topo, sizes=[5, 4], mode="TPU", seed=1)
+    ds = s.sample_dense(np.arange(32))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((int(ds.n_id.shape[0]), 16)).astype(np.float32)
+    )
+    return ds, x
+
+
+def _check(model_f32, model_bf16, ds, x):
+    params = model_f32.init(jax.random.key(0), x, ds.adjs)
+    # same param tree either way: param_dtype stays float32 under bf16 compute
+    params_b = model_bf16.init(jax.random.key(0), x, ds.adjs)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(params_b)
+    for leaf in jax.tree_util.tree_leaves(params_b):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+
+    out32 = model_f32.apply(params, x, ds.adjs)
+    out16 = model_bf16.apply(params, x, ds.adjs)
+    assert out16.dtype == jnp.float32  # logits come back f32 for the loss
+    scale = np.maximum(np.abs(np.asarray(out32)).max(), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(out16) / scale, np.asarray(out32) / scale, atol=0.05
+    )
+
+    # gradients flow and land in f32 (optimizer-compatible)
+    def loss(p, m):
+        return (m.apply(p, x, ds.adjs) ** 2).mean()
+
+    g = jax.grad(loss)(params, model_bf16)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert leaf.dtype == jnp.float32
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_sage_bf16_matches_f32():
+    ds, x = _batch()
+    _check(
+        GraphSAGE(hidden_dim=32, out_dim=5, num_layers=2, dropout=0.0),
+        GraphSAGE(hidden_dim=32, out_dim=5, num_layers=2, dropout=0.0,
+                  dtype=jnp.bfloat16),
+        ds, x,
+    )
+
+
+def test_gat_bf16_matches_f32():
+    ds, x = _batch(seed=3)
+    _check(
+        GAT(hidden_dim=16, out_dim=5, heads=2, num_layers=2, dropout=0.0),
+        GAT(hidden_dim=16, out_dim=5, heads=2, num_layers=2, dropout=0.0,
+            dtype=jnp.bfloat16),
+        ds, x,
+    )
