@@ -438,7 +438,7 @@ def bench_tiered_pipeline(
     seed_batches = [
         rng.integers(0, n_nodes, batch).astype(np.int32) for _ in range(batches)
     ]
-    tp = TrainPipeline(sampler, feat, step_fn, depth=1)
+    tp = TrainPipeline(sampler, feat, step_fn, depth=1, tiered=pipe)
     # bootstrap params + compile the step off the clock
     b0 = tp._stage(seed_batches[0])
     from quiver_tpu.pipeline import tiered_lookup
@@ -466,7 +466,7 @@ def bench_tiered_pipeline(
 
     pipe_s = {}
     for depth in (1, 2):
-        tp_d = TrainPipeline(sampler, feat, step_fn, depth=depth)
+        tp_d = TrainPipeline(sampler, feat, step_fn, depth=depth, tiered=pipe)
         t0 = time.time()
         params, opt_state, losses = tp_d.run_epoch(
             seed_batches, params, opt_state, jax.random.key(4)

@@ -52,6 +52,8 @@ def main():
                     choices=["replicated", "sharded"],
                     help="sharded = row-shard the CSR over the mesh (no chip "
                          "holds the full graph; the papers100M layout)")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bfloat16 compute (MXU-native; params/logits stay f32)")
     ap.add_argument("--hot-frac", type=float, default=0.0,
                     help="replicate this heat-ordered fraction of the feature "
                          "table per host; only the cold remainder rides DCN "
@@ -110,7 +112,8 @@ def main():
 
     sizes = tuple(int(s) for s in args.sizes.split(","))
     model = GraphSAGE(
-        hidden_dim=args.hidden, out_dim=args.classes, num_layers=len(sizes), dropout=0.5
+        hidden_dim=args.hidden, out_dim=args.classes, num_layers=len(sizes),
+        dropout=0.5, dtype=jnp.bfloat16 if args.bf16 else None,
     )
     tx = optax.adam(1e-3)
     hot_rows = int(n * args.hot_frac) if args.hot_frac else None

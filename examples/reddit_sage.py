@@ -61,6 +61,8 @@ def main():
     ap.add_argument("--dim", type=int, default=64, help="synthetic feature dim")
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--bf16", action="store_true",
+                    help="bfloat16 compute (MXU-native; params/logits stay f32)")
     ap.add_argument("--model", default="sage", choices=["sage", "gat"],
                     help="gat mirrors the reference's reddit GAT example "
                          "(dist_sampling_reddit_gat.py)")
@@ -105,10 +107,12 @@ def main():
         model = GAT(
             hidden_dim=args.hidden, out_dim=ncls, heads=4,
             num_layers=len(sizes), dropout=0.5,
+            dtype=jnp.bfloat16 if args.bf16 else None,
         )
     else:
         model = GraphSAGE(
-            hidden_dim=args.hidden, out_dim=ncls, num_layers=len(sizes), dropout=0.5
+            hidden_dim=args.hidden, out_dim=ncls, num_layers=len(sizes), dropout=0.5,
+            dtype=jnp.bfloat16 if args.bf16 else None,
         )
     tx = optax.adam(args.lr)
     params = opt_state = None

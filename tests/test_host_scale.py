@@ -52,7 +52,7 @@ def test_host_mode_tiered_pipeline_trains(tmp_path):
     params = model.init(jax.random.key(0), x0, ds0.adjs)
     opt_state = tx.init(params)
 
-    tp = TrainPipeline(sampler, feature, step_fn)
+    tp = TrainPipeline(sampler, feature, step_fn, tiered=pipe)
     params, opt_state, losses = tp.run_epoch(batches, params, opt_state, jax.random.key(1))
     assert np.isfinite(losses).all()
     # the cold tier carried real traffic (90% of rows live there)
@@ -86,7 +86,7 @@ def test_pipeline_checkpoint_resume(tmp_path):
     params = model.init(jax.random.key(0), x0, ds0.adjs)
     opt_state = tx.init(params)
 
-    tp = TrainPipeline(sampler, feature, step_fn)
+    tp = TrainPipeline(sampler, feature, step_fn, tiered=pipe)
     params, opt_state, l1 = tp.run_epoch(batches[:3], params, opt_state, jax.random.key(1))
 
     mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
@@ -102,7 +102,7 @@ def test_pipeline_checkpoint_resume(tmp_path):
     sampler2 = GraphSageSampler(topo, sizes=[5, 4], mode="TPU", seed=7)
     sampler2._call = int(state["sampler_call"])
     assert sampler2._call == sampler._call  # RNG cursor continues, not restarts
-    tp2 = TrainPipeline(sampler2, feature, step_fn)
+    tp2 = TrainPipeline(sampler2, feature, step_fn, tiered=pipe)
     p2, o2, l2 = tp2.run_epoch(
         batches[3:], state["params"], state["opt_state"], jax.random.key(2)
     )
