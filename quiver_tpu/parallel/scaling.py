@@ -69,6 +69,53 @@ class LayoutPrediction(NamedTuple):
     dcn_bytes: float
 
 
+import re as _re
+
+# sync collectives are counted by their RESULT shape; async pairs by the
+# `-done` op's result only (a `-start` result tuple carries BOTH operand
+# and result buffers, which would double-count the payload)
+_HLO_COLLECTIVE_LINE_RE = _re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter)"
+    r"(-start|-done)?\("
+)
+_HLO_SHAPE_RE = _re.compile(
+    r"(f64|f32|f16|bf16|s64|s32|s16|s8|u64|u32|u16|u8|pred)\[([0-9,]*)\]"
+)
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s64": 8, "s32": 4,
+    "s16": 2, "s8": 1, "u64": 8, "u32": 4, "u16": 2, "u8": 1, "pred": 1,
+}
+
+
+def collective_payload_bytes(hlo_text: str) -> Dict[str, int]:
+    """Measured counterpart of the ring model: parse a COMPILED program's
+    HLO and sum the payload bytes of every collective, per op kind.
+
+    Returns e.g. ``{"all-reduce": 123456, "all-gather": 789}`` — payloads
+    are the per-device program's result shapes (tuples summed), i.e. the
+    quantity the ring model multiplies by ``2(P-1)/P`` per axis. Feed it
+    ``jax.jit(step).lower(*args).compile().as_text()``; pairing these
+    measured bytes with `sampling_comm_bytes`' predictions turns the
+    scaling table's traffic column from arithmetic into evidence (see
+    tests/test_scaling_model.py::test_model_matches_compiled_step).
+    """
+    out: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_COLLECTIVE_LINE_RE.search(line)
+        if not m or m.group(3) == "-start":
+            continue
+        total = 0
+        for dt, dims in _HLO_SHAPE_RE.findall(m.group(1)):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _HLO_DTYPE_BYTES[dt]
+        out[m.group(2)] = out.get(m.group(2), 0) + total
+    return out
+
+
 def comm_seconds(
     ici_bytes: float,
     dcn_bytes: float,
