@@ -14,6 +14,11 @@ from .topology import (
     sharded_sample_layer_grouped,
 )
 from .collectives import sharded_gather_hot_cold
+from .scaling import (
+    collective_payload_bytes,
+    predict_layout,
+    products_scaling_table,
+)
 from .train import (
     calibrate_cold_budget,
     make_mesh,
@@ -28,6 +33,9 @@ from .train import (
 __all__ = [
     "ShardedTopology",
     "calibrate_cold_budget",
+    "collective_payload_bytes",
+    "predict_layout",
+    "products_scaling_table",
     "make_mesh",
     "make_sharded_topo_train_step",
     "make_sharded_train_step",
