@@ -705,19 +705,19 @@ class GraphSageSampler:
 
     def reindex(self, inputs, outputs, counts):
         """Reference-compatible reindex of a ragged one-hop result
-        (sage_sampler.py:115-116): returns (n_id, row, col)."""
+        (sage_sampler.py:115-116): returns (n_id, row, col).
+
+        The ragged->padded conversion is vectorized (row-major mask
+        assignment matches the ragged concatenation order) — a per-row
+        Python loop here was the compat surface's bottleneck at products
+        batch sizes."""
         inputs = np.asarray(inputs)
-        counts = np.asarray(counts)
+        counts = np.asarray(counts, np.int64)
         S = inputs.shape[0]
         k = int(counts.max()) if S else 0
         padded = np.zeros((S, max(k, 1)), np.int64)
-        mask = np.zeros((S, max(k, 1)), bool)
-        off = 0
-        flat = np.asarray(outputs)
-        for i, c in enumerate(counts):
-            padded[i, : int(c)] = flat[off : off + int(c)]
-            mask[i, : int(c)] = True
-            off += int(c)
+        mask = np.arange(max(k, 1))[None, :] < counts[:, None]
+        padded[mask] = np.asarray(outputs)
         res = local_reindex(
             jnp.asarray(inputs), jnp.ones((S,), bool), jnp.asarray(padded), jnp.asarray(mask)
         )

@@ -475,3 +475,21 @@ def test_auto_grow_caps_preserves_none(graph):
     assert int(ds.cap_overflow) == 0
     assert s.caps[0] > 8
     assert s.caps[1] is None
+
+
+def test_pyg_compat_reindex_ragged(graph):
+    """GraphSageSampler.reindex (reference sage_sampler.py:115-116 compat):
+    ragged (inputs, outputs, counts) -> (n_id, row, col) with n_id starting
+    at the inputs, cols pointing into n_id, and (row, col) reproducing the
+    ragged neighbor lists exactly."""
+    s = GraphSageSampler(graph, sizes=[7], mode="TPU", seed=4)
+    inputs = np.arange(40)
+    nbrs, counts = s.sample_layer(inputs, 7)
+    n_id, rows, cols = s.reindex(inputs, nbrs, counts)
+    assert n_id[: len(inputs)].tolist() == inputs.tolist()
+    assert len(rows) == len(cols) == counts.sum()
+    # every (row, col) pair maps back to the exact ragged outputs, in order
+    np.testing.assert_array_equal(n_id[cols], nbrs)
+    np.testing.assert_array_equal(rows, np.repeat(np.arange(40), counts))
+    # n_id is unique (the dedup contract)
+    assert len(np.unique(n_id)) == len(n_id)
