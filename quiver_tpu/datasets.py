@@ -125,6 +125,49 @@ def synthetic_powerlaw(
     return edge_index, features, labels, train_idx
 
 
+def synthetic_community(
+    n_nodes: int,
+    communities: int = 4,
+    avg_deg: int = 10,
+    inter_frac: float = 0.05,
+    dim: int = 16,
+    feature_signal: float = 0.0,
+    train_frac: float = 0.5,
+    seed: int = 0,
+):
+    """Stochastic-block-model-flavoured graph: edges land inside the node's
+    community except an ``inter_frac`` leak. With ``feature_signal=0`` the
+    features are pure noise, so only the STRUCTURE carries the labels —
+    the honest benchmark for unsupervised/structural embedding methods
+    (examples/graph_sage_unsup.py); raise it to mix in a supervised-style
+    class nudge.
+
+    Returns (edge_index [2,E], features [N,dim], labels [N], train_idx).
+    """
+    rng = np.random.default_rng(seed)
+    # one boundary array drives BOTH labels and edge blocks, so intra-
+    # community edges stay intra even when communities don't divide n
+    bounds = (np.arange(communities + 1, dtype=np.int64) * n_nodes) // communities
+    labels = (
+        np.searchsorted(bounds, np.arange(n_nodes), side="right") - 1
+    ).astype(np.int32)
+    src = np.repeat(np.arange(n_nodes, dtype=np.int64), avg_deg)
+    lab_src = labels[src].astype(np.int64)
+    width = (bounds[lab_src + 1] - bounds[lab_src]).astype(np.float64)
+    dst = bounds[lab_src] + (rng.random(src.shape[0]) * width).astype(np.int64)
+    leak = rng.random(src.shape[0]) < inter_frac
+    dst[leak] = rng.integers(0, n_nodes, int(leak.sum()))
+    edge_index = np.stack([src, np.minimum(dst, n_nodes - 1)])
+    features = rng.standard_normal((n_nodes, dim)).astype(np.float32)
+    if feature_signal:
+        basis = rng.standard_normal((communities, dim)).astype(np.float32)
+        features += basis[labels] * feature_signal
+    train_idx = rng.choice(
+        n_nodes, max(int(n_nodes * train_frac), 1), replace=False
+    )
+    return edge_index, features, labels, train_idx
+
+
 def products_like(scale: float = 1.0, dim: Optional[int] = None,
                   classes: Optional[int] = None, seed: int = 0):
     """products-shaped graph at ``scale`` (1.0 = full 2.45M nodes / 61.9M

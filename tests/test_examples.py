@@ -105,3 +105,25 @@ def test_papers100m_workflow_host_mmap():
     assert "cold tier on disk (mmap)" in r.stdout and "val acc" in r.stdout
     losses = _epoch_losses(r.stdout)
     assert len(losses) == 2 and losses[1] < losses[0], r.stdout
+
+
+def test_unsup_example_learns():
+    """Unsupervised GraphSAGE (reference graph_sage_unsup_quiver.py
+    workflow): random-walk positives + uniform negatives + logsigmoid link
+    loss; a linear probe on frozen full-graph embeddings must far exceed
+    chance (0.25) on the community graph."""
+    import re
+
+    r = _run(
+        [
+            "examples/graph_sage_unsup.py",
+            "--nodes", "2000", "--epochs", "6", "--batch-size", "128",
+            "--hidden", "32", "--sizes", "8,5",
+        ],
+        {"JAX_PLATFORMS": "cpu"},
+        timeout=560,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    m = re.search(r"test ([0-9.]+)", r.stdout)
+    assert m, r.stdout
+    assert float(m.group(1)) > 0.8, r.stdout
