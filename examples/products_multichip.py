@@ -58,6 +58,10 @@ def main():
                     help="replicate this heat-ordered fraction of the feature "
                          "table per host; only the cold remainder rides DCN "
                          "(needs --hosts >= 2)")
+    ap.add_argument("--label-signal", type=float, default=1.5,
+                    help="class-signal strength of the synthetic features; "
+                         "lower = harder task (accuracy anchors use a value "
+                         "that keeps the anchor off the 1.0 ceiling)")
     args = ap.parse_args()
 
     import jax
@@ -85,7 +89,8 @@ def main():
     # learnable power-law graph (class-dependent feature nudge) so the run
     # reports a meaningful accuracy like the reference products example
     edge_index, feat, labels, train_idx = synthetic_powerlaw(
-        n, e, dim=args.dim, classes=args.classes, train_frac=0.3, seed=0
+        n, e, dim=args.dim, classes=args.classes, train_frac=0.3, seed=0,
+        label_signal=args.label_signal,
     )
     rest = np.setdiff1d(np.arange(n), train_idx)
     val_idx, test_idx = rest[: n // 20], rest[n // 20 : n // 10]

@@ -344,6 +344,16 @@ class TpuComm:
     # reference-compatible raw verbs (comm.py send/recv/allreduce) expressed
     # as collectives; useful for ported scripts that used them directly
     def allreduce(self, x):
+        if jax.process_count() > 1:
+            # a host-side identity would be silently WRONG here: each
+            # process holds only its local addends. Ported scripts should
+            # move the reduction inside their jitted step (psum over the
+            # mesh) or use exchange(); failing loudly beats corrupt sums.
+            raise NotImplementedError(
+                "TpuComm.allreduce is host-side and single-controller only; "
+                "in multi-process mode use lax.psum inside the jitted step "
+                "(see parallel/train.py) or TpuComm.exchange"
+            )
         return jnp.asarray(x)  # single-controller: already global
 
     def send(self, *_a, **_k):

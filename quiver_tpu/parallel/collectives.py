@@ -35,42 +35,81 @@ def sharded_gather(table_block: jax.Array, ids: jax.Array, axis_name) -> jax.Arr
     Returns full rows, replicated across the axis/axes. Out-of-range ids
     (e.g. padding sentinels) return zero rows.
     """
-    rows_per_shard = table_block.shape[0]
     if isinstance(axis_name, str):
         axes = (axis_name,)
     else:
         axes = tuple(axis_name)
-    # flat shard index, major-to-minor — the block order of P((a, b), ...)
+    return lax.psum(_partial_rows(table_block, ids, axes), axes)
+
+
+def _partial_rows(table_block: jax.Array, ids: jax.Array, axes) -> jax.Array:
+    """This shard's un-reduced contribution to a row gather: its in-range
+    rows, zeros elsewhere. Callers choose the reduction (psum, psum_scatter,
+    or a scatter/psum mix). Shard index is flat major-to-minor over ``axes``
+    — the block order of ``P((a, b), ...)``. int64 ids stay wide (>2^31-row
+    global tables, x64 mode); everything else runs int32 (cheaper TPU
+    gathers)."""
+    rows_per_shard = table_block.shape[0]
     idx = lax.axis_index(axes[0])
     for a in axes[1:]:
         idx = idx * lax.axis_size(a) + lax.axis_index(a)
-    # keep int64 ids wide (>2^31-row global tables, x64 mode); everything
-    # else runs int32 (cheaper TPU gathers)
     id_dt = ids.dtype if ids.dtype == jnp.int64 else jnp.int32
     local = ids.astype(id_dt) - idx.astype(id_dt) * rows_per_shard
     in_range = (local >= 0) & (local < rows_per_shard)
     rows = jnp.take(table_block, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
-    rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))
-    return lax.psum(rows, axes)
+    return jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))
 
 
 def sharded_gather_grouped(
-    table_block: jax.Array, ids: jax.Array, feat_axes, group_axis: str
+    table_block: jax.Array, ids: jax.Array, feat_axes, group_axis: str,
+    via: str = "scatter",
 ) -> jax.Array:
     """`sharded_gather` for id lists that DIFFER across ``group_axis`` (one
     of the table's striping axes, typically "host").
 
     `sharded_gather` requires ids identical across every psum axis; when
     data-parallel groups span the host axis, each host samples different
-    seeds, so the lists are first all_gathered over ``group_axis`` (making
-    them identical everywhere), gathered once for all groups, and each
-    group slices its own answer. Costs ``axis_size(group_axis)`` x the
-    gather rows — the naive-stripe price; a targeted id exchange (the
-    comm.exchange pattern) is the optimization path.
+    seeds, so the lists are first all_gathered over ``group_axis`` and
+    gathered once for all groups. The return trip has two spellings:
+
+    - ``via="scatter"`` (default): `psum_scatter` the ``[G, W, D]`` partial
+      rows over ``group_axis`` (each group receives only ITS slice, reduced
+      on the way — ring cost (G-1)/G of the payload), then psum the ``[W,
+      D]`` remainder over the other striping axes. DCN row-bytes: (G-1)*W*D.
+    - ``via="psum"``: full psum over every striping axis, slice own answer
+      (round-3 layout). DCN row-bytes: 2*(G-1)*W*D, and the non-group axes
+      carry the G-fold width too — G x the ICI payload of "scatter".
+
+    Both produce identical rows; "scatter" strictly dominates the byte
+    model and the hermetic 8-device measurement (SCALING.md round-4 table,
+    tests/test_parallel.py::test_grouped_gather_scatter_matches_psum), so
+    "psum" remains only as the reference spelling for that comparison.
     """
-    all_ids = lax.all_gather(ids, group_axis)  # identical across group_axis
-    rows = sharded_gather(table_block, all_ids, feat_axes)
-    return rows[lax.axis_index(group_axis)]
+    if via == "psum":
+        all_ids = lax.all_gather(ids, group_axis)  # identical across group_axis
+        rows = sharded_gather(table_block, all_ids, feat_axes)
+        return rows[lax.axis_index(group_axis)]
+    if via != "scatter":
+        raise ValueError(f"unknown via {via!r}")
+    if isinstance(feat_axes, str):
+        axes = (feat_axes,)
+    else:
+        axes = tuple(feat_axes)
+    if group_axis not in axes:
+        # table not striped over the group axis: every group participant
+        # holds identical partials, so a scatter-reduce would G-fold-count
+        # them; the psum+slice spelling is the correct (and equally cheap,
+        # no reduction rides group_axis at all) form there
+        all_ids = lax.all_gather(ids, group_axis)
+        rows = sharded_gather(table_block, all_ids, axes)
+        return rows[lax.axis_index(group_axis)]
+    all_ids = lax.all_gather(ids, group_axis)  # [G, ...]
+    rows = _partial_rows(table_block, all_ids, axes)  # [G, W, D]
+    own = lax.psum_scatter(rows, group_axis, scatter_dimension=0, tiled=False)
+    other = tuple(a for a in axes if a != group_axis)
+    if other:
+        own = lax.psum(own, other)
+    return own
 
 
 def sharded_gather_a2a(
@@ -87,15 +126,9 @@ def sharded_gather_a2a(
     then psum_scatter... here implemented as all_gather + masked gather +
     all_to_all return trip for bandwidth-balanced assembly.
     """
-    rows_per_shard = table_block.shape[0]
     # [P, B_local] all chips' requests (int64 preserved for >2^31-row tables)
-    id_dt = ids.dtype if ids.dtype == jnp.int64 else jnp.int32
-    all_ids = lax.all_gather(ids.astype(id_dt), axis_name)
-    idx = lax.axis_index(axis_name)
-    local = all_ids - idx.astype(id_dt) * rows_per_shard
-    in_range = (local >= 0) & (local < rows_per_shard)
-    rows = jnp.take(table_block, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
-    rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))  # [P, B, D]
+    all_ids = lax.all_gather(ids, axis_name)
+    rows = _partial_rows(table_block, all_ids, (axis_name,))  # [P, B, D]
     # return trip: chip p needs slice [p] summed over owners
     return lax.psum_scatter(rows, axis_name, scatter_dimension=0, tiled=False)
 
@@ -108,6 +141,7 @@ def sharded_gather_hot_cold(
     group_axis: str,
     hot_rows: int,
     cold_budget: int,
+    cold_via: str = "scatter",
 ):
     """Grouped gather with a per-host REPLICATED hot prefix — the in-jit
     analog of the reference's `PartitionInfo.replicate` hot set
@@ -166,7 +200,9 @@ def sharded_gather_hot_cold(
     sel = order[:cold_budget]
     lane_ok = jnp.arange(cold_budget, dtype=jnp.int32) < n_cold
     cold_local = jnp.where(lane_ok, jnp.take(ids, sel) - hot_rows, -1)
-    cold_rows = sharded_gather_grouped(cold_block, cold_local, feat_axes, group_axis)
+    cold_rows = sharded_gather_grouped(
+        cold_block, cold_local, feat_axes, group_axis, via=cold_via
+    )
     cold_rows = jnp.where(lane_ok[:, None], cold_rows, jnp.zeros_like(cold_rows))
     out = hot_part.at[sel].add(cold_rows, mode="drop")
     overflow = jnp.maximum(n_cold - cold_budget, 0)

@@ -88,7 +88,9 @@ _HLO_DTYPE_BYTES = {
 }
 
 
-def collective_payload_bytes(hlo_text: str) -> Dict[str, int]:
+def collective_payload_bytes(
+    hlo_text: str, expected: Optional[Sequence[str]] = None
+) -> Dict[str, int]:
     """Measured counterpart of the ring model: parse a COMPILED program's
     HLO and sum the payload bytes of every collective, per op kind.
 
@@ -99,6 +101,16 @@ def collective_payload_bytes(hlo_text: str) -> Dict[str, int]:
     measured bytes with `sampling_comm_bytes`' predictions turns the
     scaling table's traffic column from arithmetic into evidence (see
     tests/test_scaling_model.py::test_model_matches_compiled_step).
+
+    Matched spellings: sync (``all-gather(...)``) and async pairs
+    (``all-gather-start``/``-done`` — counted once, on the ``-done``).
+    Generic ``async-start``/``async-done`` wrappers print the wrapped
+    collective inside their called computation, whose body line matches the
+    sync form, so those are counted too. Because a future XLA spelling
+    could still slip through silently, pass ``expected`` (op-kind names)
+    and the parser raises if any expected kind shows ZERO bytes — callers
+    validating a program they *know* contains a psum should always use it
+    (round-3 ADVICE.md item 3).
     """
     out: Dict[str, int] = {}
     for line in hlo_text.splitlines():
@@ -113,6 +125,14 @@ def collective_payload_bytes(hlo_text: str) -> Dict[str, int]:
                     n *= int(d)
             total += n * _HLO_DTYPE_BYTES[dt]
         out[m.group(2)] = out.get(m.group(2), 0) + total
+    if expected:
+        missing = [k for k in expected if not out.get(k)]
+        if missing:
+            raise ValueError(
+                f"expected collective kinds {missing} not found in HLO — "
+                "either the program lost its collectives or XLA emits a "
+                "spelling this parser does not match"
+            )
     return out
 
 
@@ -187,14 +207,19 @@ def predict_layout(
     if kind == "dp_replicated":
         pass  # feature + topology local: gradient psum only
     elif kind == "dp_ici_features":
-        c = sampling_comm_bytes(
-            mesh, sizes, batch_per_group, feature_dim=feature_dim, caps=caps
-        )
-        # sampling itself is local in this layout: count only the feature
-        # psums by subtracting the id-only model
-        c_ids = sampling_comm_bytes(mesh, sizes, batch_per_group, caps=caps)
-        comm["ici_bytes"] += c["ici_bytes"] - c_ids["ici_bytes"]
-        comm["dcn_bytes"] += (c["dcn_bytes"] - c_ids["dcn_bytes"]) * cold_frac
+        # sampling is LOCAL in this layout; the only sharded traffic is the
+        # per-hop feature gathers, modeled directly by gather_comm_bytes
+        # (grouped id all-gather + row return — including the DCN legs on
+        # (host, ...) meshes, which round 3 modeled as free: ADVICE item 2)
+        from ..ops.sample import pad_widths
+        from .topology import gather_comm_bytes
+
+        widths = pad_widths(batch_per_group, sizes, caps)
+        gather_widths = [widths[0]] + [w * k for w, k in zip(widths, sizes)]
+        for gw in gather_widths:
+            g = gather_comm_bytes(mesh, gw, feature_dim)
+            comm["ici_bytes"] += g["ici_bytes"]
+            comm["dcn_bytes"] += g["dcn_bytes"]
     elif kind == "sharded_topology":
         c = sampling_comm_bytes(
             mesh, sizes, batch_per_group, feature_dim=feature_dim, caps=caps

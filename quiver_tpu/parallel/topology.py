@@ -182,6 +182,20 @@ def sharded_sample_layer(
     with global neighbor ids.
     """
     axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    nbrs, valid = _sample_layer_partial(
+        indptr_blk, indices_blk, row_start, cur, cur_valid, k, key, axes
+    )
+    nbrs = lax.psum(nbrs, axes)
+    valid = lax.psum(valid, axes) > 0
+    return nbrs, valid
+
+
+def _sample_layer_partial(
+    indptr_blk, indices_blk, row_start, cur, cur_valid, k, key, axes
+):
+    """This shard's un-reduced contribution to a one-hop sample: neighbors
+    for the frontier rows it owns, zeros elsewhere. Callers choose the
+    reduction (full psum, or scatter-over-group then psum)."""
     idx = _flat_axis_index(axes)
     start = jnp.take(row_start, idx)
     end = jnp.take(row_start, idx + 1)
@@ -197,9 +211,7 @@ def sharded_sample_layer(
     flat = jnp.clip(ptr[:, None] + pos.astype(ptr.dtype), 0, e_pad - 1)
     nbrs = jnp.take(indices_blk, flat)
     nbrs = jnp.where(valid, nbrs, 0)
-    nbrs = lax.psum(nbrs, axes)
-    valid = lax.psum(valid.astype(jnp.int32), axes) > 0
-    return nbrs, valid
+    return nbrs, valid.astype(jnp.int32)
 
 
 def sharded_sample_layer_grouped(
@@ -212,26 +224,49 @@ def sharded_sample_layer_grouped(
     key: jax.Array,
     axes,
     group_axis: str,
+    via: str = "scatter",
 ) -> Tuple[jax.Array, jax.Array]:
     """`sharded_sample_layer` for frontiers that DIFFER across ``group_axis``
     (one of the striping axes, typically "host" — data-parallel groups span
     it, so each host's frontier is distinct).
 
     The frontiers are all_gathered over ``group_axis`` (making them identical
-    across every psum participant), sampled once for all groups, and each
-    group slices its own answer — the same grouped pattern (and the same
-    ``axis_size(group_axis)``x width price) as
-    `collectives.sharded_gather_grouped`.
+    across every participant) and sampled once for all groups — the same
+    grouped pattern as `collectives.sharded_gather_grouped`, with the same
+    two return-trip spellings: ``via="scatter"`` (default) psum_scatters the
+    ``[G, W, k]`` partials over ``group_axis`` (each group receives only its
+    own slice, ring cost (G-1)/G) then psums the remainder over the other
+    striping axes at width W; ``via="psum"`` is the round-3 full-psum+slice
+    spelling (2x the group-axis bytes, G x the other axes' width — kept for
+    the SCALING.md comparison).
     """
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
     h = lax.axis_size(group_axis)
     w = cur.shape[0]
     all_cur = lax.all_gather(cur, group_axis).reshape(-1)
     all_valid = lax.all_gather(cur_valid, group_axis).reshape(-1)
-    nbrs, valid = sharded_sample_layer(
+    if via == "psum" or group_axis not in axes:
+        nbrs, valid = sharded_sample_layer(
+            indptr_blk, indices_blk, row_start, all_cur, all_valid, k, key, axes
+        )
+        me = lax.axis_index(group_axis)
+        return nbrs.reshape(h, w, k)[me], valid.reshape(h, w, k)[me]
+    if via != "scatter":
+        raise ValueError(f"unknown via {via!r}")
+    nbrs, valid = _sample_layer_partial(
         indptr_blk, indices_blk, row_start, all_cur, all_valid, k, key, axes
     )
-    me = lax.axis_index(group_axis)
-    return nbrs.reshape(h, w, k)[me], valid.reshape(h, w, k)[me]
+    nbrs = lax.psum_scatter(
+        nbrs.reshape(h, w, k), group_axis, scatter_dimension=0, tiled=False
+    )
+    valid = lax.psum_scatter(
+        valid.reshape(h, w, k), group_axis, scatter_dimension=0, tiled=False
+    )
+    other = tuple(a for a in axes if a != group_axis)
+    if other:
+        nbrs = lax.psum(nbrs, other)
+        valid = lax.psum(valid, other)
+    return nbrs, valid > 0
 
 
 def gather_comm_bytes(
@@ -241,13 +276,20 @@ def gather_comm_bytes(
     cold_budget: Optional[int] = None,
     feat_bytes: int = 4,
     id_bytes: int = 4,
+    via: str = "scatter",
 ) -> Dict[str, float]:
     """Per-gather collective-byte model (ring costs, same conventions as
     `sampling_comm_bytes`) for ONE feature gather of ``width`` ids on a
     multi-host mesh — the number that makes the replicated-hot win
     quantitative: with ``cold_budget`` set (the `sharded_gather_hot_cold`
-    layout) only the cold lanes ride the DCN psum, so DCN bytes scale by
-    ``cold_budget / width`` ≈ the hot-tier miss rate."""
+    layout) only the cold lanes ride the DCN leg, so DCN bytes scale by
+    ``cold_budget / width`` ≈ the hot-tier miss rate.
+
+    ``via`` mirrors `sharded_gather_grouped`: "scatter" (the default
+    implementation — psum_scatter the [H, W, D] partials over host, then an
+    ici psum at width W) or "psum" (round-3 full psum + slice: 2x the DCN
+    row bytes and H x the ici width; see the SCALING.md round-4 table).
+    """
     from .train import mesh_axes
 
     _, feat_axes, _ = mesh_axes(mesh)
@@ -263,19 +305,28 @@ def gather_comm_bytes(
             b = 2.0 * (sz - 1) / sz * n_elems * feat_bytes
             out["dcn_bytes" if a == "host" else "ici_bytes"] += b
 
+    def add_grouped_rows(w):
+        """Return-trip bytes for a grouped gather of w rows per group."""
+        if via == "scatter":
+            # psum_scatter [H, w, D] over host + psum [w, D] over ici
+            out["dcn_bytes"] += (hostsz - 1) / hostsz * hostsz * w * dim * feat_bytes
+            add_psum(w * dim, ici_axes)
+        else:
+            add_psum(w * hostsz * dim, feat_axes)
+
     ici_axes = tuple(a for a in feat_axes if a != "host")
     if not has_host:
         add_psum(width * dim, feat_axes)
     elif cold_budget is None:
-        # grouped: all_gather W ids over host, psum [H*W, D] over (host, ici)
+        # grouped: all_gather W ids over host, then the row return trip
         out["dcn_bytes"] += (hostsz - 1) / hostsz * width * hostsz * id_bytes
-        add_psum(width * hostsz * dim, feat_axes)
+        add_grouped_rows(width)
     else:
         # hot: ICI-only psum at full width (per host)
         add_psum(width * dim, ici_axes)
         # cold: grouped path at the budgeted width
         out["dcn_bytes"] += (hostsz - 1) / hostsz * cold_budget * hostsz * id_bytes
-        add_psum(cold_budget * hostsz * dim, feat_axes)
+        add_grouped_rows(cold_budget)
     out["total_bytes"] = out["ici_bytes"] + out["dcn_bytes"]
     return out
 
@@ -288,17 +339,21 @@ def sampling_comm_bytes(
     caps: Optional[Sequence[Optional[int]]] = None,
     id_bytes: int = 4,
     feat_bytes: int = 4,
+    via: str = "scatter",
 ) -> Dict[str, float]:
     """Static per-step collective-traffic model for the sharded-topology
     train step — the ICI/DCN byte accounting the multichip artifacts log.
 
     Counts, per training step and per chip, the bytes each collective moves
     over ICI (within a host) and DCN (the host axis), using the ring model
-    (psum ≈ 2(P-1)/P × payload, all_gather ≈ (P-1)/P × gathered payload; a
-    multi-axis psum decomposes into a per-axis ring each paying its own
-    (A-1)/A factor on the FULL payload, ICI legs first). Hop widths follow
-    `pad_widths`; ``feature_dim > 0`` adds the per-hop sharded feature-gather
-    psum of the fused pipeline. This is a *model* — on real hardware XLA may
+    (psum ≈ 2(P-1)/P × payload, all_gather ≈ (P-1)/P × gathered payload,
+    psum_scatter ≈ (P-1)/P × payload; a multi-axis psum decomposes into a
+    per-axis ring each paying its own (A-1)/A factor on the FULL payload,
+    ICI legs first). Hop widths follow `pad_widths`; ``feature_dim > 0``
+    adds the per-hop sharded feature-gather of the fused pipeline. ``via``
+    selects the grouped return-trip spelling the step uses ("scatter" =
+    the implementation default; "psum" = the round-3 spelling, kept for the
+    SCALING.md comparison). This is a *model* — on real hardware XLA may
     pick other algorithms — but it makes relative layout costs comparable
     without a pod.
     """
@@ -309,10 +364,11 @@ def sampling_comm_bytes(
     hostsz = mesh.shape["host"] if has_host else 1
     out: Dict[str, float] = {"ici_bytes": 0.0, "dcn_bytes": 0.0}
     widths = pad_widths(batch_per_group, sizes, caps)
+    ici_axes = tuple(a for a in feat_axes if a != "host")
 
-    def add_psum(n_elems: int, elem_bytes: int):
+    def add_psum(n_elems: int, elem_bytes: int, axes=None):
         # per-axis rings over the striping axes; payload does not shrink
-        for a in feat_axes:
+        for a in (feat_axes if axes is None else axes):
             sz = mesh.shape[a]
             if sz == 1:
                 continue
@@ -323,15 +379,24 @@ def sampling_comm_bytes(
         if hostsz > 1:
             out["dcn_bytes"] += (hostsz - 1) / hostsz * n_elems * hostsz * elem_bytes
 
-    group_mult = hostsz  # grouped formulations widen the payload by H
+    def add_grouped(per_group_elems: int, elem_bytes: int):
+        """Return trip of a grouped collective, per_group_elems per group."""
+        if not has_host or via == "psum":
+            add_psum(per_group_elems * hostsz, elem_bytes)
+        else:
+            # psum_scatter [H, w] over host + psum [w] over ici
+            out["dcn_bytes"] += (
+                (hostsz - 1) / hostsz * hostsz * per_group_elems * elem_bytes
+            )
+            add_psum(per_group_elems, elem_bytes, axes=ici_axes)
+
     for l, k in enumerate(sizes):
-        w = widths[l] * group_mult
         if has_host:
             add_all_gather_host(widths[l], id_bytes + 1)  # frontier ids + valid
-        add_psum(w * k, id_bytes + 4)  # nbrs psum + int32 valid psum
+        add_grouped(widths[l] * k, id_bytes + 4)  # nbrs + int32 valid return
         if feature_dim:
-            add_psum(w * k * feature_dim, feat_bytes)
+            add_grouped(widths[l] * k * feature_dim, feat_bytes)
     if feature_dim:
-        add_psum(widths[0] * group_mult * feature_dim, feat_bytes)  # seed rows
+        add_grouped(widths[0] * feature_dim, feat_bytes)  # seed rows
     out["total_bytes"] = out["ici_bytes"] + out["dcn_bytes"]
     return out

@@ -272,19 +272,23 @@ def show_tensor_info(x, name: str = "", file=None) -> str:
     return line
 
 
-def reindex_by_config(adj_csr: CSRTopo, graph_feature, gpu_portion: float):
+def reindex_by_config(adj_csr: CSRTopo, graph_feature, gpu_portion: float, seed: int = 0):
     """Degree-descending hot/cold reorder (reference ``utils.py:230-248``).
 
     Sort nodes by out-degree descending, randomly shuffle the hot prefix
     (top ``gpu_portion`` fraction) to load-balance striped placement, and
     return ``(permuted_feature, prev_order)`` where ``prev_order`` maps
     old node id -> position in the permuted feature ("feature_order").
+
+    The hot-prefix shuffle is seeded (default 0) so cache placement — and
+    any performance comparison across runs — is reproducible; pass a
+    different ``seed`` to resample the striping.
     """
     if not 0.0 <= gpu_portion <= 1.0:
         raise ValueError("gpu_portion must be in [0, 1]")
     node_count = adj_csr.node_count
     split = int(node_count * gpu_portion)
-    perm_range = np.random.permutation(split)
+    perm_range = np.random.default_rng(seed).permutation(split)
     degree = adj_csr.degree
     # descending degree order; stable for determinism on ties
     prev_order = np.argsort(-degree, kind="stable")
@@ -296,10 +300,10 @@ def reindex_by_config(adj_csr: CSRTopo, graph_feature, gpu_portion: float):
     return graph_feature, new_order
 
 
-def reindex_feature(graph: CSRTopo, feature, ratio: float):
+def reindex_feature(graph: CSRTopo, feature, ratio: float, seed: int = 0):
     """Reference ``utils.py:230`` companion used by Feature; returns
     (reordered_feature, feature_order)."""
-    feature, new_order = reindex_by_config(graph, feature, ratio)
+    feature, new_order = reindex_by_config(graph, feature, ratio, seed=seed)
     return feature, new_order
 
 

@@ -1,0 +1,67 @@
+"""Probe 3: separate true device gather rate from per-dispatch RPC overhead.
+
+Sweep ITERS; fit dt = overhead + iters * t_iter. Also time a trivial
+dispatch to measure the RPC floor directly.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+W = 262_144
+N0, D = 2_449_029, 100
+
+
+def main():
+    print("devices:", jax.devices())
+    tab = jax.random.normal(jax.random.key(1), (N0, D), jnp.float32)
+    idx = jax.random.randint(jax.random.key(9), (W,), 0, N0, dtype=jnp.int32)
+    jax.block_until_ready((tab, idx))
+
+    # RPC floor: trivial scalar program, 5 reps
+    @jax.jit
+    def triv(x):
+        return x + 1.0
+
+    float(triv(jnp.float32(0)))
+    for _ in range(2):
+        t0 = time.time()
+        float(triv(jnp.float32(1)))
+        print(f"  trivial dispatch+fetch: {time.time()-t0:.3f}s")
+
+    def make(iters):
+        @jax.jit
+        def run(tab, idx):
+            def body(acc, i):
+                ids = (idx + i * 977) % N0
+                return acc + jnp.take(tab, ids, axis=0).sum(dtype=jnp.float32), None
+
+            acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(iters, dtype=jnp.int32))
+            return acc
+
+        return run
+
+    pts = []
+    for iters in (10, 50, 100, 200, 400, 800):
+        run = make(iters)
+        float(run(tab, idx))
+        best = min(
+            (lambda t0: (float(run(tab, idx)), time.time() - t0)[1])(time.time())
+            for _ in range(3)
+        )
+        rate = iters * W / best / 1e6
+        pts.append((iters, best))
+        print(f"  iters={iters:4d}: dt {best:.3f}s  -> {rate:6.1f}M rows/s apparent")
+
+    # least-squares fit dt = a + b*iters
+    xs = np.array([p[0] for p in pts], dtype=np.float64)
+    ys = np.array([p[1] for p in pts], dtype=np.float64)
+    b, a = np.polyfit(xs, ys, 1)
+    print(f"  fit: overhead {a*1e3:.0f} ms + {b*1e3:.3f} ms/iter")
+    print(f"  TRUE device rate: {W/b/1e6:.1f}M rows/s = {W/b*D*4/1e9:.1f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -61,7 +61,10 @@ def main():
         "products_multichip.py",
         ["--epochs", "6", "--nodes", "20000", "--avg-deg", "10",
          "--steps-per-epoch", "20", "--batch-per-dp", "256", "--hidden", "64",
-         "--classes", "8"],
+         "--classes", "8",
+         # weaker class signal keeps the anchor off the 1.0 ceiling so a
+         # regression can actually move it (round-3 verdict item 8)
+         "--label-signal", "0.4"],
         env_extra={"QUIVER_VIRTUAL_DEVICES": "8"},
     )
     results["products_multichip_synthetic"] = parse_accs(out)

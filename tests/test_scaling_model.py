@@ -139,6 +139,15 @@ def test_collective_payload_bytes_async_pairs():
     }
 
 
+def test_collective_payload_bytes_expected_guard():
+    import pytest
+
+    txt = "  %ag = bf16[128]{0} all-gather(%c), dimensions={0}\n"
+    assert collective_payload_bytes(txt, expected=["all-gather"])
+    with pytest.raises(ValueError, match="all-to-all"):
+        collective_payload_bytes(txt, expected=["all-to-all"])
+
+
 def test_model_matches_compiled_step():
     """Validation of the byte model against the COMPILED sharded train
     step: the all-reduce payloads XLA actually emits must equal the
@@ -189,8 +198,10 @@ def test_model_matches_compiled_step():
     params = replicate(mesh, model.init(jax.random.key(1), x0, ds0.adjs))
     opt = jax.device_put(tx.init(params), NamedSharding(mesh, P()))
 
+    # `expected` makes a silent parser miss (e.g. a new XLA async spelling)
+    # raise instead of passing vacuously (round-3 ADVICE.md item 3)
     txt = step.lower(params, opt, jax.random.key(2), ip, ix, fd, ld, seeds).compile().as_text()
-    measured = collective_payload_bytes(txt)["all-reduce"]
+    measured = collective_payload_bytes(txt, expected=["all-reduce"])["all-reduce"]
 
     widths = pad_widths(B, sizes)
     feature_payload = (widths[0] + sum(w * k for w, k in zip(widths, sizes))) * D * 4
