@@ -272,6 +272,39 @@ def bench_feature(context, table_dev, iters=800, batch=262_144):
     context["feature_tiered20_gbps"] = round(tiered_gbps, 2)
 
 
+def bench_host_sampler(context, indptr_np, indices_np, seeds_np, iters=3):
+    """Host-engine SEPS on the products-shaped graph — the direct
+    comparison against the reference's CPU sampler baseline (1.84M SEPS,
+    BASELINE.md row 1; docs/Introduction_en.md:40). Measures the FULL
+    HostSampler path (native k-subset engine + host reindex), not just the
+    kernel; `make -C quiver_tpu/csrc bench` has the kernel-only number."""
+    from quiver_tpu.ops.cpu_kernels import HostSampler, native_available
+
+    if not native_available():
+        # the numpy fallback's per-row Python loop takes MINUTES at this
+        # graph size — skipping beats starving the e2e sections' budget
+        log("host sampler bench skipped: native engine not built")
+        return
+    hs = HostSampler(indptr_np.astype(np.int64), indices_np.astype(np.int64))
+    sizes = (15, 10, 5)
+    m = seeds_np.shape[0]
+    # warm one batch (page-in, allocator)
+    hs.sample_multilayer(seeds_np[0], sizes, seed=99)
+    t0 = time.time()
+    total = 0
+    for i in range(iters):
+        _, _, adjs = hs.sample_multilayer(seeds_np[i % m], sizes, seed=i)
+        total += sum(int(a["mask"].sum()) for a in adjs)
+    dt = time.time() - t0
+    host_seps = total / dt
+    log(
+        f"host sampler: {host_seps/1e6:.2f}M SEPS (native={native_available()}, "
+        f"{iters} batches in {dt:.2f}s; ref CPU baseline 1.84M)"
+    )
+    context["host_seps"] = round(host_seps, 1)
+    context["host_seps_vs_ref_cpu"] = round(host_seps / 1.84e6, 2)
+
+
 def calibrate_bench_caps(indptr, indices, seeds_all, batch, sizes=(15, 10, 5)):
     """THE cap policy for every dedup section of this bench (one definition
     so logged caps always match the caps the e2e step runs): probe over ALL
@@ -584,6 +617,16 @@ def main():
             log("budget exhausted before feature bench")
     except Exception as exc:
         log(f"feature bench failed: {exc}")
+    try:
+        if remaining() > 60:
+            bench_host_sampler(
+                context, indptr_np, indices_np,
+                np.asarray(seeds_all)[:4],
+            )
+        else:
+            log("budget exhausted before host sampler bench")
+    except Exception as exc:
+        log(f"host sampler bench failed: {exc}")
     caps = None
     try:
         caps = calibrate_bench_caps(indptr, indices, seeds_all, batch)

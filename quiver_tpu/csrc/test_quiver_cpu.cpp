@@ -1,0 +1,246 @@
+// Direct C-ABI tests for the native host engine — the counterpart of the
+// reference's gtest suite (/root/reference/tests/cpp/test_quiver_cpu.cpp:9-50)
+// without a gtest dependency (plain asserts; the image has no gtest).
+//
+// Also a kernel microbench (`./test_quiver_cpu bench`) matching the
+// reference's bench shape (benchmarks/cpp/bench_quiver_gpu.cu:57-97:
+// 1M nodes / 4M edges, batch 1024, k=5) plus a products-fanout SEPS row.
+//
+// Build + run: make -C quiver_tpu/csrc test
+// ASan build:  make -C quiver_tpu/csrc asan
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <chrono>
+#include <random>
+#include <set>
+#include <vector>
+
+extern "C" {
+void qt_sample_layer(const int64_t *indptr, const int64_t *indices,
+                     int64_t num_nodes, const int64_t *seeds, int64_t batch,
+                     int64_t k, uint64_t seed, int64_t *out_nbrs,
+                     uint8_t *out_valid);
+void qt_gather_rows(const float *src, int64_t n, int64_t d, const int64_t *ids,
+                    int64_t batch, float *out);
+void qt_reindex(const int64_t *head, int64_t seed_count, const int64_t *nbrs,
+                const uint8_t *mask, int64_t total, int64_t *out_n_id,
+                int64_t *out_count, int32_t *out_local);
+}
+
+namespace {
+
+// chain graph: node i -> i+1 (deg 1), last node deg 0 — the same oracle the
+// Python suites use (tests/test_sampler.py chain fixtures).
+void test_chain_copy_all() {
+  const int64_t n = 6;
+  std::vector<int64_t> indptr(n + 1), indices;
+  for (int64_t i = 0; i < n; ++i) {
+    indptr[i] = indices.size();
+    if (i + 1 < n) indices.push_back(i + 1);
+  }
+  indptr[n] = indices.size();
+
+  const int64_t k = 3;
+  std::vector<int64_t> seeds = {0, 2, n - 1, -1, n + 5};
+  const int64_t b = seeds.size();
+  std::vector<int64_t> nbrs(b * k, -7);
+  std::vector<uint8_t> valid(b * k, 9);
+  qt_sample_layer(indptr.data(), indices.data(), n, seeds.data(), b, k, 42,
+                  nbrs.data(), valid.data());
+  // deg-1 seeds: copy-all -> neighbor in lane 0, lanes 1.. invalid
+  assert(nbrs[0] == 1 && valid[0] == 1 && valid[1] == 0 && valid[2] == 0);
+  assert(nbrs[k] == 3 && valid[k] == 1);
+  // deg-0 (last node) and out-of-range seeds: all lanes invalid + zeroed
+  for (int64_t i = 2; i < b; ++i)
+    for (int64_t j = 0; j < k; ++j) {
+      assert(valid[i * k + j] == 0);
+      assert(nbrs[i * k + j] == 0);
+    }
+  std::printf("  chain copy-all ok\n");
+}
+
+// deg > k: k DISTINCT draws, all members of the CSR row.
+void test_distinct_subset() {
+  const int64_t n = 2, deg = 10, k = 4;
+  std::vector<int64_t> indptr = {0, deg, deg};
+  std::vector<int64_t> indices(deg);
+  for (int64_t j = 0; j < deg; ++j) indices[j] = 100 + j;  // node 0's nbrs
+  std::vector<int64_t> seeds(64, 0);
+  std::vector<int64_t> nbrs(seeds.size() * k);
+  std::vector<uint8_t> valid(seeds.size() * k);
+  qt_sample_layer(indptr.data(), indices.data(), n, seeds.data(),
+                  seeds.size(), k, 7, nbrs.data(), valid.data());
+  for (size_t i = 0; i < seeds.size(); ++i) {
+    std::set<int64_t> got;
+    for (int64_t j = 0; j < k; ++j) {
+      assert(valid[i * k + j] == 1);
+      int64_t v = nbrs[i * k + j];
+      assert(v >= 100 && v < 100 + deg);
+      got.insert(v);
+    }
+    assert((int64_t)got.size() == k);  // without replacement
+  }
+  std::printf("  distinct k-subset ok\n");
+}
+
+// uniformity: over many draws each neighbor appears ~ k/deg of the time.
+void test_uniformity() {
+  const int64_t n = 2, deg = 20, k = 5, reps = 20000;
+  std::vector<int64_t> indptr = {0, deg, deg};
+  std::vector<int64_t> indices(deg);
+  for (int64_t j = 0; j < deg; ++j) indices[j] = j;
+  std::vector<int64_t> seeds(reps, 0);
+  std::vector<int64_t> nbrs(reps * k);
+  std::vector<uint8_t> valid(reps * k);
+  qt_sample_layer(indptr.data(), indices.data(), n, seeds.data(), reps, k,
+                  1234, nbrs.data(), valid.data());
+  std::vector<int64_t> counts(deg, 0);
+  for (int64_t i = 0; i < reps * k; ++i) counts[nbrs[i]]++;
+  const double expect = double(reps) * k / deg;  // = 5000
+  for (int64_t j = 0; j < deg; ++j) {
+    double ratio = counts[j] / expect;
+    assert(ratio > 0.9 && ratio < 1.1);  // ~14 sigma slack at these counts
+  }
+  std::printf("  uniformity ok\n");
+}
+
+// the local_reindex contract: seed slots verbatim (first slot wins for
+// duplicates), new uniques ascending, masked-out lanes -> 0.
+void test_reindex_contract() {
+  // head has a duplicate (7 at slots 1 and 3); nbrs mix head hits, new
+  // values out of order, duplicates, and a masked lane
+  std::vector<int64_t> head = {5, 7, 2, 7};
+  std::vector<int64_t> nbrs = {9, 7, 3, /*masked*/ 123, 3, 2, 9, 11};
+  std::vector<uint8_t> mask = {1, 1, 1, 0, 1, 1, 1, 1};
+  std::vector<int64_t> n_id(head.size() + nbrs.size(), -1);
+  std::vector<int32_t> local(nbrs.size(), -1);
+  int64_t count = 0;
+  qt_reindex(head.data(), head.size(), nbrs.data(), mask.data(), nbrs.size(),
+             n_id.data(), &count, local.data());
+  // new uniques: {3, 9, 11} ascending -> slots 4, 5, 6
+  assert(count == 7);
+  const int64_t want_nid[7] = {5, 7, 2, 7, 3, 9, 11};
+  for (int64_t i = 0; i < count; ++i) assert(n_id[i] == want_nid[i]);
+  // 9->5, 7->first head slot 1, 3->4, masked->0, 3->4, 2->2, 9->5, 11->6
+  const int32_t want_local[8] = {5, 1, 4, 0, 4, 2, 5, 6};
+  for (size_t j = 0; j < nbrs.size(); ++j) assert(local[j] == want_local[j]);
+  std::printf("  reindex contract ok\n");
+}
+
+void test_gather_rows() {
+  const int64_t n = 8, d = 3;
+  std::vector<float> src(n * d);
+  for (int64_t i = 0; i < n * d; ++i) src[i] = float(i);
+  std::vector<int64_t> ids = {3, 0, 7, -1, n, 3};
+  const int64_t b = ids.size();
+  std::vector<float> out(b * d, -1.f);
+  qt_gather_rows(src.data(), n, d, ids.data(), b, out.data());
+  for (int64_t i = 0; i < b; ++i) {
+    int64_t id = ids[i];
+    for (int64_t j = 0; j < d; ++j) {
+      float want = (id < 0 || id >= n) ? 0.f : src[id * d + j];
+      assert(out[i * d + j] == want);
+    }
+  }
+  std::printf("  gather rows (incl. OOB zeroing) ok\n");
+}
+
+// power-law-ish CSR for the bench (fast to build; skew comparable to the
+// Python bench's generator at small scale).
+void build_graph(int64_t n, int64_t e, std::vector<int64_t> &indptr,
+                 std::vector<int64_t> &indices) {
+  std::mt19937_64 rng(0);
+  std::vector<double> w(n);
+  std::uniform_real_distribution<double> u(0.0, 1.0);
+  for (int64_t i = 0; i < n; ++i) w[i] = std::pow(u(rng) + 1e-9, -0.6);
+  double tot = 0;
+  for (double x : w) tot += x;
+  indptr.assign(n + 1, 0);
+  for (int64_t i = 0; i < n; ++i)
+    indptr[i + 1] = indptr[i] + std::max<int64_t>(1, int64_t(w[i] / tot * e));
+  indices.resize(indptr[n]);
+  std::uniform_int_distribution<int64_t> dst(0, n - 1);
+  for (size_t j = 0; j < indices.size(); ++j) indices[j] = dst(rng);
+}
+
+void bench() {
+  // reference kernel-bench shape: 1M nodes / ~4M edges, batch 1024, k=5
+  {
+    std::vector<int64_t> indptr, indices;
+    build_graph(1'000'000, 4'000'000, indptr, indices);
+    const int64_t b = 1024, k = 5, iters = 200;
+    std::vector<int64_t> seeds(b), nbrs(b * k);
+    std::vector<uint8_t> valid(b * k);
+    std::mt19937_64 rng(1);
+    std::uniform_int_distribution<int64_t> pick(0, 999'999);
+    int64_t edges = 0;
+    auto t0 = std::chrono::steady_clock::now();
+    for (int64_t it = 0; it < iters; ++it) {
+      for (auto &s : seeds) s = pick(rng);
+      qt_sample_layer(indptr.data(), indices.data(), 1'000'000, seeds.data(),
+                      b, k, it, nbrs.data(), valid.data());
+      for (auto v : valid) edges += v;
+    }
+    double dt = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+    std::printf("1-hop k=5 (ref bench shape): %.2fM SEPS (%lld edges, %.2fs)\n",
+                edges / dt / 1e6, (long long)edges, dt);
+  }
+  // products-fanout 3-hop row (the BASELINE.md CPU-sampler config)
+  {
+    std::vector<int64_t> indptr, indices;
+    build_graph(2'449'029, 123'718'280, indptr, indices);
+    const int64_t b = 1024, iters = 20;
+    const int64_t ks[3] = {15, 10, 5};
+    std::mt19937_64 rng(2);
+    std::uniform_int_distribution<int64_t> pick(0, 2'449'028);
+    int64_t edges = 0;
+    auto t0 = std::chrono::steady_clock::now();
+    std::vector<int64_t> frontier(b), nbrs;
+    std::vector<uint8_t> valid;
+    for (int64_t it = 0; it < iters; ++it) {
+      for (auto &s : frontier) s = pick(rng);
+      std::vector<int64_t> cur = frontier;
+      for (int64_t l = 0; l < 3; ++l) {
+        int64_t k = ks[l], w = cur.size();
+        nbrs.assign(w * k, 0);
+        valid.assign(w * k, 0);
+        qt_sample_layer(indptr.data(), indices.data(), 2'449'029, cur.data(),
+                        w, k, it * 10 + l, nbrs.data(), valid.data());
+        std::vector<int64_t> next;
+        next.reserve(w * k);
+        for (int64_t i = 0; i < w * k; ++i)
+          if (valid[i]) {
+            next.push_back(nbrs[i]);
+            ++edges;
+          }
+        cur.swap(next);
+      }
+    }
+    double dt = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0).count();
+    std::printf("3-hop [15,10,5] products-shape: %.2fM SEPS "
+                "(%lld edges, %.2fs)\n",
+                edges / dt / 1e6, (long long)edges, dt);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc > 1 && std::strcmp(argv[1], "bench") == 0) {
+    bench();
+    return 0;
+  }
+  test_chain_copy_all();
+  test_distinct_subset();
+  test_uniformity();
+  test_reindex_contract();
+  test_gather_rows();
+  std::printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}

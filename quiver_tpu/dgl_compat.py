@@ -1,0 +1,133 @@
+"""DGL-style consumption surface: blocks (message-flow graphs) over
+DenseSample.
+
+The reference advertises PyG *and* DGL front ends; its DGL example
+(/root/reference/examples/dgl/ogbn_products_sage_quiver.py:36-49) consumes
+sampling output as a list of ``blocks`` where each block is an MFG with a
+dst-prefix convention (``h_dst = h[:block.num_dst_nodes()]``) and layers are
+called as ``layer(block, (h_src, h_dst))``.
+
+`quiver_tpu.pyg.sage_sampler.DenseAdj` already IS that structure — targets
+are the prefix of each hop's source n_id (DenseAdj docstring) — so the DGL
+mapping is a thin adapter, not a port:
+
+==============================  =======================================
+DGL                             quiver_tpu
+==============================  =======================================
+``input_nodes``                 ``ds.n_id``
+``output_nodes``                ``ds.n_id[:ds.batch_size]``
+``blocks[l]``                   ``Block(ds.adjs[l], ...)`` (this module)
+``block.num_dst_nodes()``       static target width of the hop
+``block.num_src_nodes()``       static source width of the hop
+``dglnn.SAGEConv(..., 'mean')``  :class:`DGLSAGEConv` — same
+                                ``(block, (h_src, h_dst))`` call shape
+``NodeDataLoader``              seed batches -> ``sampler.sample_dense``
+==============================  =======================================
+
+Widths here are STATIC (padded) — the XLA contract; masked lanes carry
+zero weight in the aggregation, so semantics match DGL's ragged blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .pyg.sage_sampler import DenseAdj, DenseSample
+
+
+class Block:
+    """One message-flow graph (DGL ``dgl.to_block`` analog) wrapping a
+    :class:`DenseAdj`. Hashable/static metadata only — safe to close over
+    in jitted code (the arrays live in the adj, a pytree)."""
+
+    def __init__(self, adj: DenseAdj, num_src: int):
+        self.adj = adj
+        self._num_src = int(num_src)
+
+    def num_dst_nodes(self) -> int:
+        return self.adj.w_dst
+
+    def num_src_nodes(self) -> int:
+        return self._num_src
+
+
+def to_blocks(ds: DenseSample) -> Tuple[jax.Array, jax.Array, List[Block]]:
+    """DGL dataloader triple ``(input_nodes, output_nodes, blocks)`` from a
+    :class:`DenseSample` (reference DGL example consumes exactly this shape
+    from its loader, ogbn_products_sage_quiver.py:120-131).
+
+    Blocks are ordered outermost hop first — the order DGL feeds layers.
+    Hop l's source width: the full n_id for the first block, the previous
+    block's target width after that (each layer consumes the previous
+    layer's output array).
+    """
+    blocks: List[Block] = []
+    src_w = ds.n_id.shape[0]
+    for adj in ds.adjs:
+        blocks.append(Block(adj, src_w))
+        src_w = adj.w_dst
+    return ds.n_id, ds.n_id[: ds.batch_size], blocks
+
+
+class DGLSAGEConv(nn.Module):
+    """``dglnn.SAGEConv(..., aggregator_type='mean')`` call-compatible
+    layer: ``conv(block, (h_src, h_dst))`` -> ``[num_dst, out_dim]``.
+    Same math as `models.sage.SAGEConv` (fc_neigh(mean) + fc_self(h_dst));
+    only the calling convention differs."""
+
+    out_dim: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(
+        self, block: Block, feat: Tuple[jax.Array, jax.Array]
+    ) -> jax.Array:
+        from .models.sage import masked_mean_aggregate
+
+        h_src, h_dst = feat
+        if self.dtype is not None:
+            h_src = h_src.astype(self.dtype)
+            h_dst = h_dst.astype(self.dtype)
+        agg = masked_mean_aggregate(h_src, block.adj)
+        h = nn.Dense(self.out_dim, dtype=self.dtype, name="fc_neigh")(agg)
+        return h + nn.Dense(
+            self.out_dim, use_bias=False, dtype=self.dtype, name="fc_self"
+        )(h_dst)
+
+
+class DGLStyleSAGE(nn.Module):
+    """The reference DGL example's SAGE model, blocks-first
+    (ogbn_products_sage_quiver.py:16-49): per layer,
+    ``h_dst = h[:block.num_dst_nodes()]; h = layer(block, (h, h_dst))``
+    with relu + dropout between layers."""
+
+    hidden_dim: int
+    out_dim: int
+    num_layers: int = 3
+    dropout: float = 0.5
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        blocks: Sequence[Block],
+        x: jax.Array,
+        *,
+        train: bool = False,
+    ) -> jax.Array:
+        assert len(blocks) == self.num_layers, (len(blocks), self.num_layers)
+        h = x
+        for l, block in enumerate(blocks):
+            h_dst = h[: block.num_dst_nodes()]
+            dim = self.out_dim if l == self.num_layers - 1 else self.hidden_dim
+            h = DGLSAGEConv(dim, dtype=self.dtype, name=f"layers_{l}")(
+                block, (h, h_dst)
+            )
+            if l != self.num_layers - 1:
+                h = jax.nn.relu(h)
+                h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return h.astype(jnp.float32)

@@ -83,6 +83,22 @@ def _load_native():
                     ctypes.c_void_p,  # out float32* [B, D]
                 ]
                 lib.qt_gather_rows.restype = None
+                try:
+                    lib.qt_reindex.argtypes = [
+                        ctypes.c_void_p,  # head int64* [seed_count]
+                        ctypes.c_int64,   # seed_count
+                        ctypes.c_void_p,  # nbrs int64* [total]
+                        ctypes.c_void_p,  # mask uint8* [total]
+                        ctypes.c_int64,   # total
+                        ctypes.c_void_p,  # out n_id int64* [seed_count+total]
+                        ctypes.c_void_p,  # out count int64*
+                        ctypes.c_void_p,  # out local int32* [total]
+                    ]
+                    lib.qt_reindex.restype = None
+                except AttributeError:
+                    # stale .so from before qt_reindex existed: the numpy
+                    # reindex fallback still applies, sampling stays native
+                    pass
                 _LIB = lib
             except OSError:
                 _LIB = None
@@ -144,6 +160,22 @@ def host_reindex(
     S, k = nbrs.shape
     seeds = np.asarray(seeds, np.int64)
     head = seeds[:seed_count]
+    lib = _load_native()
+    if lib is not None and hasattr(lib, "qt_reindex"):
+        total = S * k
+        head_c = np.ascontiguousarray(head, np.int64)
+        nbrs_c = np.ascontiguousarray(nbrs, np.int64)
+        mask_c = np.ascontiguousarray(mask, np.uint8)
+        n_id_buf = np.empty(seed_count + total, np.int64)
+        count_buf = np.zeros(1, np.int64)
+        local = np.empty(total, np.int32)
+        lib.qt_reindex(
+            head_c.ctypes.data, seed_count, nbrs_c.ctypes.data,
+            mask_c.ctypes.data, total, n_id_buf.ctypes.data,
+            count_buf.ctypes.data, local.ctypes.data,
+        )
+        count = int(count_buf[0])
+        return n_id_buf[:count], count, local.reshape(S, k), mask
     nbr_vals = nbrs[mask]
     new = np.setdiff1d(nbr_vals, head)  # sorted unique, seed values excluded
     count = seed_count + new.shape[0]

@@ -211,12 +211,16 @@ def heat_reorder(
     features=None,
     labels=None,
     index_sets=(),
+    heat=None,
 ):
-    """Renumber the WHOLE id space degree-descending (in+out degree), so
-    the hot prefix convention of `shard_feature_hot_cold` /
-    `sharded_gather_hot_cold` ("rows < hot_rows are the replicated tier")
-    holds for graph, features, labels and index sets alike — the ONE
-    implementation of that convention.
+    """Renumber the WHOLE id space heat-descending, so the hot prefix
+    convention of `shard_feature_hot_cold` / `sharded_gather_hot_cold`
+    ("rows < hot_rows are the replicated tier") holds for graph, features,
+    labels and index sets alike — the ONE implementation of that convention.
+
+    ``heat``: per-node hotness scores; default is in+out degree. Pass
+    measured access probabilities (`GraphSageSampler.sample_prob`) for the
+    reference's prob-driven placement (mag240m preprocess.py:117-179).
 
     Returns ``(edge_index_r, features_r, labels_r, sets_r, order, inv)``
     with ``order[new_id] = old_id`` and ``inv[old_id] = new_id``; pass-
@@ -226,10 +230,15 @@ def heat_reorder(
     need — they test hotness by raw id.)"""
     edge_index = np.asarray(edge_index)
     n = int(num_nodes) if num_nodes is not None else int(edge_index.max()) + 1
-    deg = np.bincount(edge_index[0], minlength=n) + np.bincount(
-        edge_index[1], minlength=n
-    )
-    order = np.argsort(-deg, kind="stable").astype(np.int64)
+    if heat is None:
+        heat = np.bincount(edge_index[0], minlength=n) + np.bincount(
+            edge_index[1], minlength=n
+        )
+    else:
+        heat = np.asarray(heat)
+        if heat.shape[0] != n:
+            raise ValueError(f"heat has {heat.shape[0]} entries for {n} nodes")
+    order = np.argsort(-heat, kind="stable").astype(np.int64)
     inv = np.empty(n, np.int64)
     inv[order] = np.arange(n)
     edge_r = inv[edge_index]
