@@ -1,0 +1,137 @@
+"""Hermetic grouped-return-trip comparison: psum+slice vs psum_scatter.
+
+Round-3 VERDICT item 4 asked for EVIDENCE (compiled-HLO collective bytes +
+hermetic step time on the 8-device CPU mesh) deciding the grouped gather's
+return trip. This script produces the SCALING.md round-4 table:
+
+  - equality: both spellings produce identical rows;
+  - compiled-HLO payload bytes per collective kind, per spelling, for the
+    full sharded-topology train step on the (host=2, dp=2, ici=2) mesh;
+  - byte-model prediction for both spellings (gather_comm_bytes /
+    sampling_comm_bytes via=);
+  - hermetic wall-clock per step (CPU mesh — relative, not absolute).
+
+Run: QUIVER_VIRTUAL_DEVICES=8 python scripts/compare_grouped_return.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    from quiver_tpu.utils import force_virtual_cpu_devices
+
+    force_virtual_cpu_devices(int(os.environ.get("QUIVER_VIRTUAL_DEVICES", "8")))
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.datasets import synthetic_powerlaw
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.parallel import (
+        make_mesh,
+        make_sharded_topo_train_step,
+        mesh_axes,
+        replicate,
+        shard_feature_rows,
+        shard_topology_rows,
+    )
+    from quiver_tpu.parallel.scaling import collective_payload_bytes
+    from quiver_tpu.parallel.topology import sampling_comm_bytes
+    from quiver_tpu.pyg.sage_sampler import sample_dense_fused
+    import quiver_tpu.parallel.collectives as coll
+    import quiver_tpu.parallel.topology as topo_mod
+
+    n, deg, dim, classes = 20_000, 10, 32, 8
+    sizes, B = (8, 4), 64
+    ei, feat, labels, train_idx = synthetic_powerlaw(
+        n, n * deg, dim=dim, classes=classes, seed=0
+    )
+    topo = CSRTopo(edge_index=ei)
+    mesh = make_mesh(8, hosts=2)
+    data_axes, feat_axes, groups = mesh_axes(mesh)
+    model = GraphSAGE(hidden_dim=32, out_dim=classes, num_layers=2, dropout=0.0)
+    tx = optax.adam(1e-3)
+
+    stopo = shard_topology_rows(mesh, topo)
+    fd = shard_feature_rows(mesh, feat)
+    ld = replicate(mesh, labels.astype(np.int32))
+    seeds = jax.device_put(
+        jnp.arange(B * groups, dtype=jnp.int32),
+        NamedSharding(mesh, P(data_axes)),
+    )
+    ds0 = sample_dense_fused(
+        jnp.asarray(topo.indptr.astype(np.int32)),
+        jnp.asarray(topo.indices.astype(np.int32)),
+        jax.random.key(0), jnp.arange(B, dtype=jnp.int32), sizes,
+    )
+    x0 = jnp.zeros((ds0.n_id.shape[0], dim), jnp.float32)
+    params = replicate(mesh, model.init(jax.random.key(1), x0, ds0.adjs))
+    opt = jax.device_put(tx.init(params), NamedSharding(mesh, P()))
+
+    results = {}
+    # patch the default `via` of both grouped collectives per spelling: the
+    # step factories call them with the library default, so this flips the
+    # WHOLE step (feature gathers + neighbor exchanges) in one move
+    orig_g = coll.sharded_gather_grouped
+    orig_s = topo_mod.sharded_sample_layer_grouped
+    for via in ("psum", "scatter"):
+        coll.sharded_gather_grouped = (
+            lambda *a, _o=orig_g, _v=via, **k: _o(*a, **{**k, "via": _v})
+        )
+        topo_mod.sharded_sample_layer_grouped = (
+            lambda *a, _o=orig_s, _v=via, **k: _o(*a, **{**k, "via": _v})
+        )
+        # train.py imported the symbols at module load: patch there too
+        import quiver_tpu.parallel.train as train_mod
+
+        train_mod.sharded_gather_grouped = coll.sharded_gather_grouped
+        topo_mod_attr = getattr(train_mod, "sharded_sample_layer_grouped", None)
+        step = make_sharded_topo_train_step(
+            mesh, model, tx, sizes=sizes, pipeline="fused"
+        )
+        args = (params, opt, jax.random.key(2), stopo, fd, ld, seeds)
+        compiled = step.lower(*args).compile()
+        hlo = collective_payload_bytes(compiled.as_text())
+        p, o, loss = compiled(*args)
+        jax.block_until_ready(loss)
+        t0 = time.time()
+        for i in range(20):
+            p, o, loss = compiled(*args)
+        jax.block_until_ready(loss)
+        dt = (time.time() - t0) / 20
+        model_bytes = sampling_comm_bytes(
+            mesh, sizes, B, feature_dim=dim, via=via
+        )
+        results[via] = dict(
+            loss=float(loss), hlo=hlo, step_ms=dt * 1e3,
+            model_ici=model_bytes["ici_bytes"], model_dcn=model_bytes["dcn_bytes"],
+        )
+    coll.sharded_gather_grouped = orig_g
+    topo_mod.sharded_sample_layer_grouped = orig_s
+
+    print(f"mesh {dict(mesh.shape)}, sizes {sizes}, batch/group {B}, dim {dim}")
+    for via, r in results.items():
+        hlo_total = sum(r["hlo"].values())
+        print(
+            f"  {via:8s}: step {r['step_ms']:.1f} ms | HLO payloads "
+            f"{ {k: v for k, v in sorted(r['hlo'].items())} } (total {hlo_total}) "
+            f"| model ici {r['model_ici']:.0f}B dcn {r['model_dcn']:.0f}B "
+            f"| loss {r['loss']:.6f}"
+        )
+    same = abs(results["psum"]["loss"] - results["scatter"]["loss"]) < 1e-5
+    print(f"  losses match: {same}")
+    tot_p = sum(results["psum"]["hlo"].values())
+    tot_s = sum(results["scatter"]["hlo"].values())
+    print(f"  HLO collective bytes: scatter/psum = {tot_s/tot_p:.3f}")
+
+
+if __name__ == "__main__":
+    main()
