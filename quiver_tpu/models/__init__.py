@@ -1,6 +1,10 @@
-"""Reference-parity model zoo (GraphSAGE, GAT) in flax."""
+"""Reference-parity model zoo (GraphSAGE, GAT, GCN) in flax."""
 
 from .sage import SAGEConv, GraphSAGE, masked_mean_aggregate
 from .gat import GAT, GATConv
+from .gcn import GCN, GCNConv
 
-__all__ = ["GAT", "GATConv", "SAGEConv", "GraphSAGE", "masked_mean_aggregate"]
+__all__ = [
+    "GAT", "GATConv", "GCN", "GCNConv", "SAGEConv", "GraphSAGE",
+    "masked_mean_aggregate",
+]
