@@ -60,6 +60,10 @@ class CheckpointManager:
             return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
         return self._mgr.restore(step)
 
+    def flush(self) -> None:
+        """Block until async saves (``save(..., wait=False)``) are durable."""
+        self._mgr.wait_until_finished()
+
     def close(self) -> None:
         self._mgr.close()
 

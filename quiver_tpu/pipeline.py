@@ -223,6 +223,8 @@ class TrainPipeline:
         step_fn,
         depth: int = 2,
         tiered: "TieredFeaturePipeline" = None,
+        checkpoint=None,
+        checkpoint_every: int = 0,
     ):
         self.sampler = sampler
         # callers that already built a TieredFeaturePipeline (e.g. to hand
@@ -232,6 +234,23 @@ class TrainPipeline:
         self.step_fn = step_fn
         self.depth = max(depth, 1)
         self.stats = PipelineStats()
+        # periodic preemption-safe state saves (checkpoint.CheckpointManager;
+        # the reference has no library-level recovery story, SURVEY.md §5).
+        # Saves are ASYNC (orbax background thread) so the train loop never
+        # stalls on IO; _run flushes before returning.
+        self.checkpoint = checkpoint
+        self.checkpoint_every = int(checkpoint_every)
+        if checkpoint is not None and self.checkpoint_every <= 0:
+            raise ValueError("checkpoint given but checkpoint_every not set")
+        if checkpoint is None and self.checkpoint_every > 0:
+            raise ValueError("checkpoint_every set but no checkpoint manager")
+        # resume numbering where the store left off: a fresh pipeline after
+        # preemption must NOT re-save steps below the stored latest (orbax
+        # accepts them silently and latest_step() would keep returning the
+        # stale pre-crash state)
+        self.global_step = (
+            int(checkpoint.latest_step() or 0) if checkpoint is not None else 0
+        )
 
     # --- the three stage bodies (each runs on its own single worker thread)
 
@@ -367,10 +386,22 @@ class TrainPipeline:
                 key, sub = jax.random.split(key)
                 params, opt_state, loss = self.step_fn(params, opt_state, sub, batch)
                 losses.append(loss)
+                self.global_step += 1
+                if (
+                    self.checkpoint is not None
+                    and self.global_step % self.checkpoint_every == 0
+                ):
+                    self.checkpoint.save(
+                        self.global_step,
+                        {"params": params, "opt_state": opt_state},
+                        wait=False,
+                    )
         finally:
             spool.shutdown(wait=True)
             gpool.shutdown(wait=True)
             upool.shutdown(wait=True)
+            if self.checkpoint is not None:
+                self.checkpoint.flush()
         return params, opt_state, [float(l) for l in losses]
 
 

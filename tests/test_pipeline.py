@@ -138,6 +138,67 @@ def test_train_pipeline_learns_and_prefetches():
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
 
 
+def test_train_pipeline_checkpoint_and_resume(tmp_path):
+    """Preemption story: the pipeline saves (params, opt_state) every N
+    steps asynchronously; a fresh pipeline restores the latest state and
+    continues training from it (failure handling beyond the reference,
+    which has none — SURVEY.md section 5)."""
+    from quiver_tpu.checkpoint import CheckpointManager
+
+    edge_index, feat, labels, n = community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    f = Feature(rank=0, device_list=[0],
+                device_cache_size=(n // 2) * feat.shape[1] * 4,
+                cache_policy="device_replicate", csr_topo=topo)
+    f.from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=1)
+    model = GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
+    tx = optax.adam(5e-3)
+    pipe = TieredFeaturePipeline(f)
+    step_fn = make_tiered_train_step(model, tx, jnp.asarray(labels), pipe.hot_table)
+
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, n, 32).astype(np.int64) for _ in range(6)]
+    ds0 = sampler.sample_dense(batches[0])
+    x0 = jnp.zeros((ds0.n_id.shape[0], feat.shape[1]), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    opt_state = tx.init(params)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    tp = TrainPipeline(sampler, f, step_fn, tiered=pipe,
+                       checkpoint=mgr, checkpoint_every=2)
+    params, opt_state, losses = tp.run_epoch(
+        batches, params, opt_state, jax.random.key(1)
+    )
+    assert tp.global_step == 6 and mgr.latest_step() == 6
+
+    # "preemption": new pipeline restores latest state and keeps training;
+    # step numbering must CONTINUE from the stored latest (re-saving lower
+    # steps would leave latest_step() pointing at stale pre-crash state)
+    state = mgr.restore(template={"params": params, "opt_state": opt_state})
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(state["params"])[0]),
+        np.asarray(jax.tree_util.tree_leaves(params)[0]),
+    )
+    tp2 = TrainPipeline(sampler, f, step_fn, tiered=pipe,
+                        checkpoint=mgr, checkpoint_every=2)
+    assert tp2.global_step == 6  # seeded from the store
+    p2, o2, losses2 = tp2.run_epoch(
+        batches[:2], state["params"], state["opt_state"], jax.random.key(2)
+    )
+    assert all(np.isfinite(losses2))
+    assert tp2.global_step == 8 and mgr.latest_step() == 8
+    mgr.close()
+
+    # misconfigurations fail loudly, both directions
+    import pytest
+
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        TrainPipeline(sampler, f, step_fn, tiered=pipe, checkpoint=object())
+    with pytest.raises(ValueError, match="no checkpoint manager"):
+        TrainPipeline(sampler, f, step_fn, tiered=pipe, checkpoint_every=5)
+
+
 def test_train_pipeline_depth2_matches_depth1():
     """depth=2 stages two batches ahead (generator serialized by a lock);
     same sampler seed + same key must give the same loss sequence as
