@@ -18,10 +18,13 @@
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <random>
 #include <thread>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -114,6 +117,74 @@ void qt_sample_layer(const int64_t *indptr, const int64_t *indices,
             row[j] = indices[start + pos[j]];
             vrow[j] = 1;
           }
+        }
+      }
+    });
+  }
+  for (auto &th : threads) th.join();
+}
+
+// Weighted one-hop sample: k DISTINCT neighbors drawn with probability
+// proportional to per-edge weights (CSR order), via Efraimidis-Spirakis
+// exponential keys — the same weighted-k-subset distribution as the device
+// engine's Gumbel-top-k (ops/sample.py gumbel_topk_positions); the
+// reference's weight_sample is CUDA-only (cuda_random.cu.hpp:177-221), so
+// its CPU engine has no weighted story at all. Non-positive weights are
+// NEVER drawn: a row with fewer than k positive-weight edges returns that
+// many valid lanes and the rest invalid, matching the -inf-logit Gumbel
+// behavior on device.
+void qt_sample_layer_weighted(const int64_t *indptr, const int64_t *indices,
+                              const float *weights, int64_t num_nodes,
+                              const int64_t *seeds, int64_t batch, int64_t k,
+                              uint64_t seed, int64_t *out_nbrs,
+                              uint8_t *out_valid) {
+  if (batch <= 0 || k <= 0) return;
+  int64_t n_threads =
+      std::max<int64_t>(1, std::min<int64_t>(
+                               std::thread::hardware_concurrency(), batch));
+  int64_t chunk = (batch + n_threads - 1) / n_threads;
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int64_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(batch, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      std::mt19937_64 rng(splitmix64(seed ^ splitmix64(0xBEEFULL + t)));
+      std::uniform_real_distribution<double> uni(
+          std::numeric_limits<double>::min(), 1.0);
+      std::vector<std::pair<double, int64_t>> keys;
+      for (int64_t i = lo; i < hi; ++i) {
+        int64_t s = seeds[i];
+        int64_t *row = out_nbrs + i * k;
+        uint8_t *vrow = out_valid + i * k;
+        std::memset(vrow, 0, static_cast<size_t>(k));
+        std::memset(row, 0, static_cast<size_t>(k) * sizeof(int64_t));
+        if (s < 0 || s >= num_nodes) continue;
+        int64_t start = indptr[s];
+        int64_t deg = indptr[s + 1] - start;
+        // exponential key Exp(1)/w_j: the k smallest keys are a weighted
+        // k-subset without replacement; w <= 0 -> +inf key (drawn last)
+        keys.clear();
+        keys.reserve(static_cast<size_t>(deg));
+        int64_t positive = 0;
+        for (int64_t j = 0; j < deg; ++j) {
+          float w = weights[start + j];
+          double key;
+          if (w > 0.f) {
+            key = -std::log(uni(rng)) / static_cast<double>(w);
+            ++positive;
+          } else {
+            key = std::numeric_limits<double>::infinity();
+          }
+          keys.emplace_back(key, j);
+        }
+        int64_t take = std::min<int64_t>(k, positive);
+        if (take <= 0) continue;
+        if (take < deg)
+          std::nth_element(keys.begin(), keys.begin() + take, keys.end());
+        for (int64_t j = 0; j < take; ++j) {
+          row[j] = indices[start + keys[static_cast<size_t>(j)].second];
+          vrow[j] = 1;
         }
       }
     });

@@ -29,6 +29,11 @@ void qt_gather_rows(const float *src, int64_t n, int64_t d, const int64_t *ids,
 void qt_reindex(const int64_t *head, int64_t seed_count, const int64_t *nbrs,
                 const uint8_t *mask, int64_t total, int64_t *out_n_id,
                 int64_t *out_count, int32_t *out_local);
+void qt_sample_layer_weighted(const int64_t *indptr, const int64_t *indices,
+                              const float *weights, int64_t num_nodes,
+                              const int64_t *seeds, int64_t batch, int64_t k,
+                              uint64_t seed, int64_t *out_nbrs,
+                              uint8_t *out_valid);
 }
 
 namespace {
@@ -106,6 +111,43 @@ void test_uniformity() {
     assert(ratio > 0.9 && ratio < 1.1);  // ~14 sigma slack at these counts
   }
   std::printf("  uniformity ok\n");
+}
+
+// weighted draws: distinct, weight-biased, zero-weight edges excluded.
+void test_weighted_sample() {
+  const int64_t n = 2, deg = 4, k = 2, reps = 20000;
+  std::vector<int64_t> indptr = {0, deg, deg};
+  std::vector<int64_t> indices = {0, 1, 2, 3};
+  std::vector<float> w = {1.f, 2.f, 4.f, 8.f};
+  std::vector<int64_t> seeds(reps, 0);
+  std::vector<int64_t> nbrs(reps * k);
+  std::vector<uint8_t> valid(reps * k);
+  qt_sample_layer_weighted(indptr.data(), indices.data(), w.data(), n,
+                           seeds.data(), reps, k, 99, nbrs.data(),
+                           valid.data());
+  std::vector<int64_t> counts(deg, 0);
+  for (int64_t i = 0; i < reps; ++i) {
+    assert(valid[i * k] && valid[i * k + 1]);
+    assert(nbrs[i * k] != nbrs[i * k + 1]);  // without replacement
+    counts[nbrs[i * k]]++;
+    counts[nbrs[i * k + 1]]++;
+  }
+  // Plackett-Luce inclusion prob of the heaviest item, w=(1,2,4,8), k=2:
+  // P = 8/15 + sum_i (w_i/15)(8/(15-w_i)) = 0.847
+  assert(counts[0] < counts[1] && counts[1] < counts[2] && counts[2] < counts[3]);
+  double p3 = double(counts[3]) / reps;
+  assert(p3 > 0.82 && p3 < 0.88);
+  // zero-weight edge never drawn; only `positive` lanes valid
+  std::vector<float> w0 = {1.f, 0.f, 1.f, 0.f};
+  qt_sample_layer_weighted(indptr.data(), indices.data(), w0.data(), n,
+                           seeds.data(), 64, 3, 5, nbrs.data(), valid.data());
+  for (int64_t i = 0; i < 64; ++i)
+    for (int64_t j = 0; j < 3; ++j)
+      if (valid[i * 3 + j]) {
+        int64_t v = nbrs[i * 3 + j];
+        assert(v == 0 || v == 2);
+      }
+  std::printf("  weighted sample ok\n");
 }
 
 // the local_reindex contract: seed slots verbatim (first slot wins for
@@ -239,6 +281,7 @@ int main(int argc, char **argv) {
   test_chain_copy_all();
   test_distinct_subset();
   test_uniformity();
+  test_weighted_sample();
   test_reindex_contract();
   test_gather_rows();
   std::printf("ALL NATIVE TESTS PASSED\n");

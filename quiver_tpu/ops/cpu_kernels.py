@@ -84,6 +84,22 @@ def _load_native():
                 ]
                 lib.qt_gather_rows.restype = None
                 try:
+                    lib.qt_sample_layer_weighted.argtypes = [
+                        ctypes.c_void_p,  # indptr int64*
+                        ctypes.c_void_p,  # indices int64*
+                        ctypes.c_void_p,  # weights float32* (CSR edge order)
+                        ctypes.c_int64,   # num_nodes
+                        ctypes.c_void_p,  # seeds int64*
+                        ctypes.c_int64,   # batch
+                        ctypes.c_int64,   # k
+                        ctypes.c_uint64,  # rng seed
+                        ctypes.c_void_p,  # out neighbors int64* [B*k]
+                        ctypes.c_void_p,  # out valid uint8* [B*k]
+                    ]
+                    lib.qt_sample_layer_weighted.restype = None
+                except AttributeError:
+                    pass  # stale .so; uniform native path still works
+                try:
                     lib.qt_reindex.argtypes = [
                         ctypes.c_void_p,  # head int64* [seed_count]
                         ctypes.c_int64,   # seed_count
@@ -198,12 +214,37 @@ def host_reindex(
 
 class HostSampler:
     """Stateful host engine bound to one CSR graph (reference
-    ``CPUQuiver``, srcs/cpp/src/quiver/quiver.cpp:11-38)."""
+    ``CPUQuiver``, srcs/cpp/src/quiver/quiver.cpp:11-38).
 
-    def __init__(self, indptr: np.ndarray, indices: np.ndarray):
+    ``weights`` (optional, float32, CSR edge order — e.g.
+    ``CSRTopo.edge_weights``) switches every draw to the weighted k-subset
+    engine (`qt_sample_layer_weighted`, same Efraimidis-Spirakis/Gumbel
+    distribution as the device op). Weighted mode requires the native lib
+    (no numpy fallback — the per-row weighted loop would be minutes-slow
+    at scale, and silence would hide it)."""
+
+    def __init__(
+        self,
+        indptr: np.ndarray,
+        indices: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+    ):
         self.indptr = np.ascontiguousarray(indptr, np.int64)
         self.indices = np.ascontiguousarray(indices, np.int64)
         self._lib = _load_native()
+        self.weights = None
+        if weights is not None:
+            if self._lib is None or not hasattr(self._lib, "qt_sample_layer_weighted"):
+                raise RuntimeError(
+                    "weighted host sampling needs the native engine "
+                    "(make -C quiver_tpu/csrc); rebuild libquiver_cpu.so"
+                )
+            self.weights = np.ascontiguousarray(weights, np.float32)
+            if self.weights.shape[0] != self.indices.shape[0]:
+                raise ValueError(
+                    f"weights has {self.weights.shape[0]} entries for "
+                    f"{self.indices.shape[0]} edges"
+                )
 
     @property
     def node_count(self) -> int:
@@ -215,7 +256,9 @@ class HostSampler:
             B = seeds.shape[0]
             nbrs = np.empty((B, k), np.int64)
             valid_u8 = np.empty((B, k), np.uint8)
-            self._lib.qt_sample_layer(
+            # one arg list for both ABIs: the weighted entry point takes the
+            # identical signature with the weights pointer inserted third
+            args = [
                 self.indptr.ctypes.data,
                 self.indices.ctypes.data,
                 self.node_count,
@@ -225,7 +268,12 @@ class HostSampler:
                 ctypes.c_uint64(seed),
                 nbrs.ctypes.data,
                 valid_u8.ctypes.data,
-            )
+            ]
+            if self.weights is not None:
+                args.insert(2, self.weights.ctypes.data)
+                self._lib.qt_sample_layer_weighted(*args)
+            else:
+                self._lib.qt_sample_layer(*args)
             return nbrs, valid_u8.astype(bool)
         return _np_sample_layer(self.indptr, self.indices, seeds, k, seed)
 

@@ -514,12 +514,11 @@ class GraphSageSampler:
                     "weighted=True needs CSRTopo(edge_weights=...) "
                     "(per-edge weights aligned with the COO input)"
                 )
-            if mode != "TPU":
-                raise ValueError(
-                    "weighted sampling runs on the device engine only "
-                    "(mirrors the reference, where weight_sample is "
-                    "CUDA-only, cuda_random.cu.hpp:177-221); use mode='TPU'"
-                )
+            # TPU mode: Gumbel-top-k device op. HOST/CPU: the native
+            # engine's Efraimidis-Spirakis weighted k-subset (same
+            # distribution; qt_sample_layer_weighted) — the reference has
+            # no CPU weighted path at all (weight_sample is CUDA-only,
+            # cuda_random.cu.hpp:177-221).
         self._seed = seed
         self._call = 0
         self._dev_arrays = None
@@ -543,7 +542,9 @@ class GraphSageSampler:
             from ..ops import cpu_kernels
 
             self._host_engine = cpu_kernels.HostSampler(
-                self.csr_topo.indptr, self.csr_topo.indices
+                self.csr_topo.indptr,
+                self.csr_topo.indices,
+                weights=self.csr_topo.edge_weights if self.weighted else None,
             )
         return self._host_engine
 

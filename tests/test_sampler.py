@@ -382,8 +382,57 @@ def test_weighted_sampler_end_to_end(graph):
     assert even > odd * 1.5, (even, odd)
     with pytest.raises(ValueError, match="edge_weights"):
         GraphSageSampler(graph, sizes=[3], weighted=True)
-    with pytest.raises(ValueError, match="TPU"):
-        GraphSageSampler(topo, sizes=[3], mode="HOST", weighted=True)
+
+
+def test_weighted_host_engine_matches_pl_oracle():
+    """The native engine's weighted k-subset (Efraimidis-Spirakis keys,
+    qt_sample_layer_weighted) draws from the SAME Plackett-Luce
+    without-replacement distribution as the device Gumbel-top-k op — the
+    reference's CPU engine has no weighted path at all (weight_sample is
+    CUDA-only, cuda_random.cu.hpp:177-221)."""
+    from quiver_tpu.ops.cpu_kernels import HostSampler, native_available
+
+    if not native_available():
+        pytest.skip("native engine not built")
+    w = np.array([1.0, 2.0, 4.0, 8.0], np.float32)
+    indptr = np.array([0, 4], np.int64)
+    indices = np.arange(4, dtype=np.int64)
+    hs = HostSampler(indptr, indices, weights=w)
+    B, k = 6000, 2
+    nbrs, valid = hs.sample_layer(np.zeros(B, np.int64), k, seed=0)
+    assert valid.all()
+    assert (nbrs[:, 0] != nbrs[:, 1]).all()  # without replacement
+    freq = np.bincount(nbrs[valid].reshape(-1), minlength=4) / B
+    np.testing.assert_allclose(freq, _pl_inclusion_probs(w, k), atol=0.03)
+
+
+def test_weighted_host_mode_end_to_end(graph):
+    """weighted=True + mode=HOST runs the full multi-hop pipeline on the
+    native weighted engine; zero-weight edges are never drawn and heavy
+    edges dominate the frontier."""
+    from quiver_tpu.ops.cpu_kernels import native_available
+
+    if not native_available():
+        pytest.skip("native engine not built")
+    n = graph.node_count
+    rng = np.random.default_rng(0)
+    ew = np.where(np.asarray(graph.indices) % 2 == 0, 10.0, 1.0).astype(np.float32)
+    topo = CSRTopo(indptr=graph.indptr, indices=graph.indices, edge_weights=ew)
+    s = GraphSageSampler(topo, sizes=[3, 3], mode="HOST", seed=0, weighted=True)
+    even = odd = 0
+    for i in range(6):
+        ds = s.sample_dense(rng.integers(0, n, 32))
+        n_id = np.asarray(ds.n_id)[32 : int(ds.count)]
+        even += int((n_id % 2 == 0).sum())
+        odd += int((n_id % 2 == 1).sum())
+    assert even > odd * 1.5, (even, odd)
+    # zero-weight edges are excluded entirely
+    ew0 = np.where(np.asarray(graph.indices) % 2 == 0, 1.0, 0.0).astype(np.float32)
+    topo0 = CSRTopo(indptr=graph.indptr, indices=graph.indices, edge_weights=ew0)
+    s0 = GraphSageSampler(topo0, sizes=[4], mode="HOST", seed=0, weighted=True)
+    ds = s0.sample_dense(np.arange(32))
+    sampled = np.asarray(ds.n_id)[32 : int(ds.count)]
+    assert (sampled % 2 == 0).all(), sampled[:20]
 
 
 def test_cap_overflow_counter(graph):
