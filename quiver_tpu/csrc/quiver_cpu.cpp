@@ -245,10 +245,12 @@ void qt_reindex(const int64_t *head, int64_t seed_count, const int64_t *nbrs,
     out_local[j] = mask[j] ? static_cast<int32_t>(slots[probe(nbrs[j])]) : 0;
 }
 
-// Parallel row gather out[i, :] = src[ids[i], :] — the host cold-tier path.
-void qt_gather_rows(const float *src, int64_t n, int64_t d, const int64_t *ids,
-                    int64_t batch, float *out) {
-  if (batch <= 0) return;
+// Parallel row gather by raw row size — dtype-agnostic (f32, bf16, f64,
+// int rows all reduce to a strided memcpy; the reference's gather kernel is
+// float32-only, quiver_feature.cu:65-69). Out-of-range ids zero their row.
+void qt_gather_rows_bytes(const uint8_t *src, int64_t n, int64_t row_bytes,
+                          const int64_t *ids, int64_t batch, uint8_t *out) {
+  if (batch <= 0 || row_bytes <= 0) return;
   int64_t n_threads =
       std::max<int64_t>(1, std::min<int64_t>(
                                std::thread::hardware_concurrency(), batch));
@@ -258,18 +260,27 @@ void qt_gather_rows(const float *src, int64_t n, int64_t d, const int64_t *ids,
     int64_t lo = t * chunk, hi = std::min(batch, lo + chunk);
     if (lo >= hi) break;
     threads.emplace_back([=]() {
+      const size_t rb = static_cast<size_t>(row_bytes);
       for (int64_t i = lo; i < hi; ++i) {
         int64_t id = ids[i];
         if (id < 0 || id >= n) {
-          std::memset(out + i * d, 0, static_cast<size_t>(d) * sizeof(float));
+          std::memset(out + i * row_bytes, 0, rb);
         } else {
-          std::memcpy(out + i * d, src + id * d,
-                      static_cast<size_t>(d) * sizeof(float));
+          std::memcpy(out + i * row_bytes, src + id * row_bytes, rb);
         }
       }
     });
   }
   for (auto &th : threads) th.join();
+}
+
+// Parallel row gather out[i, :] = src[ids[i], :] — the host cold-tier path
+// (float32 spelling, kept for ABI compatibility with round-3 callers).
+void qt_gather_rows(const float *src, int64_t n, int64_t d, const int64_t *ids,
+                    int64_t batch, float *out) {
+  qt_gather_rows_bytes(reinterpret_cast<const uint8_t *>(src), n,
+                       d * static_cast<int64_t>(sizeof(float)), ids, batch,
+                       reinterpret_cast<uint8_t *>(out));
 }
 
 }  // extern "C"

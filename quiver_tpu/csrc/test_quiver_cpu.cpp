@@ -26,6 +26,8 @@ void qt_sample_layer(const int64_t *indptr, const int64_t *indices,
                      uint8_t *out_valid);
 void qt_gather_rows(const float *src, int64_t n, int64_t d, const int64_t *ids,
                     int64_t batch, float *out);
+void qt_gather_rows_bytes(const uint8_t *src, int64_t n, int64_t row_bytes,
+                          const int64_t *ids, int64_t batch, uint8_t *out);
 void qt_reindex(const int64_t *head, int64_t seed_count, const int64_t *nbrs,
                 const uint8_t *mask, int64_t total, int64_t *out_n_id,
                 int64_t *out_count, int32_t *out_local);
@@ -191,6 +193,24 @@ void test_gather_rows() {
   std::printf("  gather rows (incl. OOB zeroing) ok\n");
 }
 
+// byte-row gather: odd row sizes (e.g. bf16 dim 3 = 6 bytes) round-trip.
+void test_gather_rows_bytes() {
+  const int64_t n = 5, rb = 6;
+  std::vector<uint8_t> src(n * rb);
+  for (size_t i = 0; i < src.size(); ++i) src[i] = static_cast<uint8_t>(i * 7);
+  std::vector<int64_t> ids = {4, 0, -3, 5, 2};
+  std::vector<uint8_t> out(ids.size() * rb, 0xAB);
+  qt_gather_rows_bytes(src.data(), n, rb, ids.data(), ids.size(), out.data());
+  for (size_t i = 0; i < ids.size(); ++i) {
+    int64_t id = ids[i];
+    for (int64_t j = 0; j < rb; ++j) {
+      uint8_t want = (id < 0 || id >= n) ? 0 : src[id * rb + j];
+      assert(out[i * rb + j] == want);
+    }
+  }
+  std::printf("  gather rows bytes (odd row size) ok\n");
+}
+
 // power-law-ish CSR for the bench (fast to build; skew comparable to the
 // Python bench's generator at small scale).
 void build_graph(int64_t n, int64_t e, std::vector<int64_t> &indptr,
@@ -284,6 +304,7 @@ int main(int argc, char **argv) {
   test_weighted_sample();
   test_reindex_contract();
   test_gather_rows();
+  test_gather_rows_bytes();
   std::printf("ALL NATIVE TESTS PASSED\n");
   return 0;
 }

@@ -100,6 +100,18 @@ def _load_native():
                 except AttributeError:
                     pass  # stale .so; uniform native path still works
                 try:
+                    lib.qt_gather_rows_bytes.argtypes = [
+                        ctypes.c_void_p,  # src bytes*
+                        ctypes.c_int64,   # N rows
+                        ctypes.c_int64,   # row bytes
+                        ctypes.c_void_p,  # ids int64*
+                        ctypes.c_int64,   # batch
+                        ctypes.c_void_p,  # out bytes*
+                    ]
+                    lib.qt_gather_rows_bytes.restype = None
+                except AttributeError:
+                    pass  # stale .so; f32 gather + numpy fallback still work
+                try:
                     lib.qt_reindex.argtypes = [
                         ctypes.c_void_p,  # head int64* [seed_count]
                         ctypes.c_int64,   # seed_count
@@ -318,31 +330,45 @@ class HostSampler:
 
     def gather_rows(self, table: np.ndarray, ids: np.ndarray) -> np.ndarray:
         """Parallel host feature gather (cold-tier analog of
-        quiver_tensor_gather's host-pointer branch, shard_tensor.cu.hpp:44-55)."""
-        ids = np.ascontiguousarray(ids, np.int64)
-        if (
-            self._lib is not None
-            and table.dtype == np.float32
-            and table.flags.c_contiguous
-        ):
-            out = np.empty((ids.shape[0], table.shape[1]), np.float32)
-            self._lib.qt_gather_rows(
-                table.ctypes.data,
-                table.shape[0],
-                table.shape[1],
-                ids.ctypes.data,
-                ids.shape[0],
-                out.ctypes.data,
-            )
-            return out
-        return table[ids]
+        quiver_tensor_gather's host-pointer branch, shard_tensor.cu.hpp:44-55);
+        dtype-agnostic via the byte-row engine — see module-level
+        :func:`gather_rows`."""
+        return gather_rows(table, ids)
 
 
 def gather_rows(table: np.ndarray, ids: np.ndarray) -> np.ndarray:
-    """Module-level host gather using the native lib when possible."""
+    """Module-level host gather using the native lib when possible.
+
+    Dtype-agnostic: any C-contiguous 2-D table goes through the native
+    byte-row engine (`qt_gather_rows_bytes`) — bf16 cold tiers included
+    (the reference's gather kernel is float32-only,
+    quiver_feature.cu:65-69). Out-of-range ids return zero rows (same
+    contract as the f32 path). Non-contiguous or 1-D inputs fall back to
+    numpy fancy indexing (which does NOT zero out-of-range ids — callers
+    on that path pre-validate, as Feature does)."""
     lib = _load_native()
     ids = np.ascontiguousarray(ids, np.int64)
-    if lib is not None and table.dtype == np.float32 and table.flags.c_contiguous:
+    plain = (
+        table.ndim == 2
+        and table.flags.c_contiguous
+        and not table.dtype.hasobject  # object rows are PyObject* — memcpy
+        #                                would skip refcounting (crash at GC)
+    )
+    if lib is not None and plain and hasattr(lib, "qt_gather_rows_bytes"):
+        out = np.empty((ids.shape[0], table.shape[1]), table.dtype)
+        lib.qt_gather_rows_bytes(
+            table.ctypes.data,
+            table.shape[0],
+            table.shape[1] * table.itemsize,
+            ids.ctypes.data,
+            ids.shape[0],
+            out.ctypes.data,
+        )
+        return out
+    if lib is not None and plain and table.dtype == np.float32:
+        # stale .so predating qt_gather_rows_bytes: the f32 entry point is
+        # still there — keep the hot cold-tier path multi-threaded (and its
+        # zero-OOB contract) instead of silently dropping to numpy
         out = np.empty((ids.shape[0], table.shape[1]), np.float32)
         lib.qt_gather_rows(
             table.ctypes.data,

@@ -185,3 +185,31 @@ def test_feature_set_mmap_file(tmp_path, table):
     got = np.asarray(feat[ids])
     np.testing.assert_allclose(got[:4], table[ids[:4]], rtol=1e-6)
     np.testing.assert_allclose(got[4], np.zeros(16))
+
+
+def test_native_gather_rows_any_dtype():
+    """The byte-row native gather serves every C-contiguous dtype (the
+    reference kernel is float32-only, quiver_feature.cu:65-69); bf16 cold
+    tiers ride the native path instead of numpy fancy indexing. OOB ids
+    return zero rows in all dtypes."""
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.cpu_kernels import gather_rows, native_available
+
+    rng = np.random.default_rng(0)
+    # OOB ids only exercise the native contract (the numpy fallback raises
+    # on them, and its callers pre-validate — see gather_rows docstring)
+    ids = (
+        np.array([3, 0, 7, -1, 12, 5], np.int64)
+        if native_available()
+        else np.array([3, 0, 7, 5], np.int64)
+    )
+    for dtype in (np.float32, np.float64, np.int32, jnp.bfloat16):
+        table = rng.standard_normal((10, 5)).astype(dtype)
+        got = gather_rows(table, ids)
+        assert got.dtype == table.dtype
+        for i, idx in enumerate(ids):
+            if 0 <= idx < 10:
+                np.testing.assert_array_equal(got[i], table[idx])
+            else:
+                assert (np.asarray(got[i], np.float64) == 0).all()
