@@ -63,8 +63,9 @@ def main():
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 compute (MXU-native; params/logits stay f32)")
-    ap.add_argument("--model", default="sage", choices=["sage", "gat"],
-                    help="gat mirrors the reference's reddit GAT example "
+    ap.add_argument("--model", default="sage", choices=["sage", "gat", "gcn"],
+                    help="gcn = DGL GraphConv-style mini-batch GCN; "
+                         "gat mirrors the reference's reddit GAT example "
                          "(dist_sampling_reddit_gat.py)")
     args = ap.parse_args()
 
@@ -108,6 +109,13 @@ def main():
             hidden_dim=args.hidden, out_dim=ncls, heads=4,
             num_layers=len(sizes), dropout=0.5,
             dtype=jnp.bfloat16 if args.bf16 else None,
+        )
+    elif args.model == "gcn":
+        from quiver_tpu.models import GCN
+
+        model = GCN(
+            hidden_dim=args.hidden, out_dim=ncls, num_layers=len(sizes),
+            dropout=0.5, dtype=jnp.bfloat16 if args.bf16 else None,
         )
     else:
         model = GraphSAGE(

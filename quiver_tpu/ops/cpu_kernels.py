@@ -344,8 +344,9 @@ def gather_rows(table: np.ndarray, ids: np.ndarray) -> np.ndarray:
     (the reference's gather kernel is float32-only,
     quiver_feature.cu:65-69). Out-of-range ids return zero rows (same
     contract as the f32 path). Non-contiguous or 1-D inputs fall back to
-    numpy fancy indexing (which does NOT zero out-of-range ids — callers
-    on that path pre-validate, as Feature does)."""
+    numpy fancy indexing, whose contract DIFFERS on bad ids (ids >= N
+    raise IndexError; ids in [-N, -1) silently WRAP to end-relative rows)
+    — callers on that path must pre-validate, as Feature does."""
     lib = _load_native()
     ids = np.ascontiguousarray(ids, np.int64)
     plain = (

@@ -24,7 +24,7 @@ def _run(argv, env_extra, timeout=280):
     )
 
 
-@pytest.mark.parametrize("model,hidden", [("sage", "32"), ("gat", "16")])
+@pytest.mark.parametrize("model,hidden", [("sage", "32"), ("gat", "16"), ("gcn", "32")])
 def test_reddit_example_runs_and_learns(model, hidden):
     # sage mirrors the reference's reddit_quiver.py; gat its
     # dist_sampling_reddit_gat.py (GAT gets a smaller hidden dim to keep

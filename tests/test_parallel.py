@@ -140,6 +140,56 @@ def test_sharded_train_step_learns(pipeline):
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+@pytest.mark.parametrize("model_name", ["gat", "gcn"])
+def test_sharded_train_step_model_agnostic(model_name):
+    """The sharded step factory takes ANY zoo model (it only calls
+    model.apply(p, x, adjs)): GAT and GCN must train over the mesh too,
+    not just GraphSAGE."""
+    from quiver_tpu.models import GAT, GCN
+    from quiver_tpu.pyg.sage_sampler import sample_dense_pure
+
+    edge_index, feat_np, labels, n = make_community_graph(per_comm=40)
+    topo = CSRTopo(edge_index=edge_index)
+    mesh = make_mesh(8)
+    if model_name == "gat":
+        model = GAT(hidden_dim=8, out_dim=4, heads=2, num_layers=2, dropout=0.0)
+    else:
+        model = GCN(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
+    tx = optax.adam(1e-2)
+    step = make_sharded_train_step(mesh, model, tx, sizes=[4, 4], pipeline="dedup")
+
+    indptr = replicate(mesh, topo.indptr.astype(np.int32))
+    indices = replicate(mesh, topo.indices.astype(np.int32))
+    feat = shard_feature_rows(mesh, feat_np)
+    labels_d = replicate(mesh, labels.astype(np.int32))
+    dp = mesh.shape["dp"]
+    batch_global = 8 * dp
+    ip = jnp.asarray(topo.indptr.astype(np.int32))
+    ix = jnp.asarray(topo.indices.astype(np.int32))
+    ds0 = sample_dense_pure(
+        ip, ix, jax.random.key(0),
+        jnp.arange(batch_global // dp, dtype=jnp.int32), (4, 4),
+    )
+    x0 = jnp.zeros((ds0.n_id.shape[0], feat_np.shape[1]), jnp.float32)
+    params = replicate(mesh, model.init(jax.random.key(1), x0, ds0.adjs))
+    opt_state = jax.device_put(
+        tx.init(params), jax.sharding.NamedSharding(mesh, P())
+    )
+    rng = np.random.default_rng(3)
+    losses = []
+    for i in range(25):
+        seeds = jax.device_put(
+            replicate(mesh, rng.choice(n, batch_global, replace=False).astype(np.int32)),
+            jax.sharding.NamedSharding(mesh, P("dp")),
+        )
+        params, opt_state, loss = step(
+            params, opt_state, jax.random.key(i), indptr, indices, feat,
+            labels_d, seeds,
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.75, losses
+
+
 @pytest.mark.parametrize("pipeline", ["dedup", "fused"])
 def test_multihost_mesh_train_step(pipeline):
     """(host, dp, ici) mesh: feature table striped over (host, ici) — the
