@@ -61,12 +61,15 @@ class TrainSampleJob(SampleJob):
         return self.train_idx[lo : lo + self.batch_size]
 
 
-def _cpu_worker_loop(shm_names, shapes, sizes, caps, seed, task_q, result_q):
+def _cpu_worker_loop(shm_names, shapes, sizes, caps, seed, task_q, result_q,
+                     weights_shm=None):
     """Reference cpu_sampler_worker_loop (sage_sampler.py:198-205).
 
     Workers are spawned (fork deadlocks under the JAX runtime's threads) and
     attach the CSR arrays through POSIX shared memory — the analog of the
-    reference sharing CSRTopo via torch shm (utils.py:216-226)."""
+    reference sharing CSRTopo via torch shm (utils.py:216-226).
+    ``weights_shm``: optional (name, shape) of a float32 per-edge weight
+    array — workers then draw through the native weighted engine."""
     from multiprocessing import shared_memory
 
     from ..ops.cpu_kernels import HostSampler
@@ -74,7 +77,11 @@ def _cpu_worker_loop(shm_names, shapes, sizes, caps, seed, task_q, result_q):
     shms = [shared_memory.SharedMemory(name=n) for n in shm_names]
     indptr = np.ndarray(shapes[0], dtype=np.int64, buffer=shms[0].buf)
     indices = np.ndarray(shapes[1], dtype=np.int64, buffer=shms[1].buf)
-    eng = HostSampler(indptr, indices)
+    weights = None
+    if weights_shm is not None:
+        shms.append(shared_memory.SharedMemory(name=weights_shm[0]))
+        weights = np.ndarray(weights_shm[1], np.float32, buffer=shms[-1].buf)
+    eng = HostSampler(indptr, indices, weights=weights)
     try:
         while True:
             item = task_q.get()
@@ -88,7 +95,7 @@ def _cpu_worker_loop(shm_names, shapes, sizes, caps, seed, task_q, result_q):
             dt = time.perf_counter() - t0
             result_q.put((epoch, task_idx, n_id, count, adjs, dt))
     finally:
-        del eng, indptr, indices
+        del eng, indptr, indices, weights
         for shm in shms:
             shm.close()
 
@@ -123,12 +130,30 @@ class MixedGraphSageSampler:
         seed: int = 0,
         auto_tune_workers: bool = False,
         device_share_target: float = 0.5,
+        weighted: bool = False,
     ):
         mode = self.MODE_ALIASES.get(mode, mode)
         if mode not in ("TPU_CPU_MIXED", "HOST_CPU_MIXED", "TPU_ONLY", "CPU_ONLY"):
             raise ValueError(f"unsupported mode: {mode}")
         if mode == "CPU_ONLY" and num_workers < 1:
             raise ValueError("CPU_ONLY mode needs num_workers >= 1")
+        if weighted and csr_topo.edge_weights is None:
+            raise ValueError(
+                "weighted=True needs CSRTopo(edge_weights=...) "
+                "(per-edge weights aligned with the COO input)"
+            )
+        if weighted and num_workers > 0 and ("MIXED" in mode or mode == "CPU_ONLY"):
+            # fail HERE with the real reason: otherwise every spawned worker
+            # dies on HostSampler's RuntimeError in a detached process and
+            # the parent only sees a 120 s "workers stalled" timeout
+            from ..ops.cpu_kernels import native_available
+
+            if not native_available():
+                raise RuntimeError(
+                    "weighted CPU workers need the native engine "
+                    "(make -C quiver_tpu/csrc); rebuild libquiver_cpu.so "
+                    "or use num_workers=0 / mode='TPU_ONLY'"
+                )
         self.job = job
         self.csr_topo = csr_topo
         self.sizes = tuple(int(s) for s in sizes)
@@ -136,12 +161,14 @@ class MixedGraphSageSampler:
         self.num_workers = num_workers if "MIXED" in mode or mode == "CPU_ONLY" else 0
         self.mode = mode
         self.seed = seed
+        self.weighted = bool(weighted)
         dev_mode = "HOST" if mode.startswith("HOST") else "TPU"
         self.device_sampler = (
             None
             if mode == "CPU_ONLY"
             else GraphSageSampler(
-                csr_topo, sizes, device=device, mode=dev_mode, caps=caps, seed=seed
+                csr_topo, sizes, device=device, mode=dev_mode, caps=caps,
+                seed=seed, weighted=weighted,
             )
         )
         self._workers = []
@@ -166,13 +193,21 @@ class MixedGraphSageSampler:
         self._result_q = ctx.Queue()
         self._shms = []
         shm_names, shapes = [], []
-        for arr in (self.csr_topo.indptr, self.csr_topo.indices):
-            arr = np.ascontiguousarray(arr, np.int64)
+        arrays = [
+            (self.csr_topo.indptr, np.int64),
+            (self.csr_topo.indices, np.int64),
+        ]
+        if self.weighted:
+            arrays.append((self.csr_topo.edge_weights, np.float32))
+        for arr, dt in arrays:
+            arr = np.ascontiguousarray(arr, dt)
             shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
-            np.ndarray(arr.shape, np.int64, buffer=shm.buf)[:] = arr
+            np.ndarray(arr.shape, dt, buffer=shm.buf)[:] = arr
             self._shms.append(shm)
             shm_names.append(shm.name)
             shapes.append(arr.shape)
+        weights_shm = (shm_names[2], shapes[2]) if self.weighted else None
+        shm_names, shapes = shm_names[:2], shapes[:2]
         for w in range(self.num_workers):
             p = ctx.Process(
                 target=_cpu_worker_loop,
@@ -184,6 +219,7 @@ class MixedGraphSageSampler:
                     self.seed + 7919 * (w + 1),
                     self._task_q,
                     self._result_q,
+                    weights_shm,
                 ),
                 daemon=True,
             )

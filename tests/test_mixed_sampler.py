@@ -192,3 +192,39 @@ def test_pipeline_stats_carry_mixed_measurements(graph):
     assert tp.stats.device_share is not None
     assert tp.stats.avg_device_sample_s > 0
     assert tp.stats.avg_cpu_sample_s > 0
+
+
+def test_weighted_mixed_epoch(graph):
+    """weighted=True flows to BOTH engines: the device sampler and the
+    spawned CPU workers (per-edge weights shared via shm, native weighted
+    k-subset). Zero-weight edges never appear from either side."""
+    from quiver_tpu.ops.cpu_kernels import native_available
+
+    if not native_available():
+        pytest.skip("native engine not built")
+    n = graph.node_count
+    # only even-id destinations carry weight
+    ew = np.where(np.asarray(graph.indices) % 2 == 0, 1.0, 0.0).astype(np.float32)
+    topo = CSRTopo(indptr=graph.indptr, indices=graph.indices, edge_weights=ew)
+    job = TrainSampleJob(np.arange(n), batch_size=25, seed=0)
+    # CPU_ONLY forces every task through the spawned weighted workers —
+    # a mixed split could route them all to the device sampler and leave
+    # the worker path untested
+    s = MixedGraphSageSampler(
+        job, topo, sizes=[4], num_workers=1, mode="CPU_ONLY",
+        weighted=True,
+    )
+    try:
+        seen_tasks = set()
+        for task_idx, ds in s:
+            seen_tasks.add(task_idx)
+            b = ds.batch_size
+            sampled = np.asarray(ds.n_id)[b : int(ds.count)]
+            assert (sampled % 2 == 0).all(), sampled[:10]
+    finally:
+        s.shutdown()
+    assert seen_tasks == set(range(len(job)))
+    assert s.avg_cpu_time > 0  # the workers really did the drawing
+    # misconfiguration fails loudly
+    with pytest.raises(ValueError, match="edge_weights"):
+        MixedGraphSageSampler(job, graph, sizes=[4], weighted=True)
