@@ -10,9 +10,14 @@ and CPU is re-decided from measured average sample times
 TPU mapping: "device" sampling is the XLA pipeline on the chip (which is
 also busy training, so shifting sampling work to host CPUs is exactly as
 valuable as it was on GPU); "CPU" sampling is the native host engine
-(`quiver_tpu.csrc`). Workers are forked processes — the CSR arrays are
-inherited copy-on-write, replacing the reference's torch shared memory
-(CSRTopo.share_memory_, utils.py:216-226).
+(`quiver_tpu.csrc`). Workers are SPAWNED processes (fork deadlocks under
+the JAX runtime's threads) attaching the CSR arrays — and per-edge weights,
+when weighted — through POSIX shared memory, replacing the reference's
+torch shared memory (CSRTopo.share_memory_, utils.py:216-226). Queues are
+strictly per-worker with daemon drainer threads feeding one in-process
+inbox, so a worker death can never wedge the train loop; dead workers'
+pending tasks are resubmitted to survivors and the pool re-heals at the
+next epoch.
 """
 
 from __future__ import annotations
@@ -26,6 +31,10 @@ import numpy as np
 
 from ..utils import CSRTopo
 from .sage_sampler import DenseSample, GraphSageSampler
+
+# sentinel a worker (or shutdown) posts on its result queue so the parent's
+# drainer thread retires instead of blocking on get() forever
+_DRAIN_DONE = ("__qt_drain_done__",)
 
 
 class SampleJob:
@@ -95,6 +104,10 @@ def _cpu_worker_loop(shm_names, shapes, sizes, caps, seed, task_q, result_q,
             dt = time.perf_counter() - t0
             result_q.put((epoch, task_idx, n_id, count, adjs, dt))
     finally:
+        try:
+            result_q.put(_DRAIN_DONE)  # retire the parent's drainer thread
+        except Exception:
+            pass
         del eng, indptr, indices, weights
         for shm in shms:
             shm.close()
@@ -148,11 +161,17 @@ class MixedGraphSageSampler:
             # the parent only sees a 120 s "workers stalled" timeout
             from ..ops.cpu_kernels import native_available
 
-            if not native_available():
+            from ..ops.cpu_kernels import _load_native
+
+            lib = _load_native()
+            if lib is None or not hasattr(lib, "qt_sample_layer_weighted"):
+                # mirror the exact worker-side requirement (a stale .so can
+                # be native_available() yet lack the weighted entry point)
                 raise RuntimeError(
-                    "weighted CPU workers need the native engine "
-                    "(make -C quiver_tpu/csrc); rebuild libquiver_cpu.so "
-                    "or use num_workers=0 / mode='TPU_ONLY'"
+                    "weighted CPU workers need the native engine's "
+                    "qt_sample_layer_weighted (make -C quiver_tpu/csrc); "
+                    "rebuild libquiver_cpu.so or use num_workers=0 / "
+                    "mode='TPU_ONLY'"
                 )
         self.job = job
         self.csr_topo = csr_topo
@@ -172,8 +191,9 @@ class MixedGraphSageSampler:
             )
         )
         self._workers = []
-        self._task_q = None
-        self._result_q = None
+        self._task_qs = None
+        self._result_qs = None
+        self._inbox = None
         # measured averages drive the adaptive split (reference
         # avg_device_time/avg_cpu_time, sage_sampler.py:262-270)
         self.avg_device_time = 0.0
@@ -183,14 +203,79 @@ class MixedGraphSageSampler:
         self.last_device_share = None  # measured split of the last epoch
 
     # -- worker lifecycle (reference lazy_init, sage_sampler.py:298-313) ----
+    def _spawn_worker(self, slot: int) -> None:
+        """Start (or REPLACE, with fresh queues — the dead one's may be
+        poisoned) the worker in ``slot``, plus its DRAINER thread.
+
+        The parent never reads a worker pipe directly: a producer killed
+        mid-put leaves a PARTIAL message on which even ``get_nowait`` blocks
+        forever (poll() sees data, ``_recv_bytes`` never completes —
+        measured, see tests/test_mixed_sampler.py worker-death tests). Each
+        worker's results are pumped by a daemon thread into one thread-safe
+        in-process inbox; if a drainer wedges on a torn message it strands
+        only that daemon thread, never the train loop."""
+        import threading
+
+        ctx = mp.get_context("spawn")
+        self._task_qs[slot] = ctx.Queue()
+        result_q = ctx.Queue()
+        self._result_qs[slot] = result_q
+        self._spawn_count = getattr(self, "_spawn_count", 0) + 1
+        shm_names, shapes, weights_shm = self._worker_shm_args
+        p = ctx.Process(
+            target=_cpu_worker_loop,
+            args=(
+                shm_names,
+                shapes,
+                self.sizes,
+                self.caps,
+                self.seed + 7919 * self._spawn_count,
+                self._task_qs[slot],
+                result_q,
+                weights_shm,
+            ),
+            daemon=True,
+        )
+        p.start()
+        self._workers[slot] = p
+
+        inbox = self._inbox
+
+        def drain():
+            try:
+                while True:
+                    item = result_q.get()
+                    if item == _DRAIN_DONE:
+                        return  # worker exited (or shutdown retired us)
+                    inbox.put(item)
+            except Exception:
+                return  # queue closed/poisoned: this drainer retires
+
+        threading.Thread(target=drain, daemon=True).start()
+
     def lazy_init(self) -> None:
-        if self._workers or self.num_workers == 0:
+        if self.num_workers == 0:
+            return
+        if self._workers:
+            # heal the pool: respawn any worker that died (OOM-kill etc.)
+            # so one bad epoch does not degrade every later one
+            for slot, p in enumerate(self._workers):
+                if not p.is_alive():
+                    self._spawn_worker(slot)
             return
         from multiprocessing import shared_memory
 
-        ctx = mp.get_context("spawn")
-        self._task_q = ctx.Queue()
-        self._result_q = ctx.Queue()
+        # ONE task queue AND one result queue per worker (the reference
+        # round-robins per-worker queues, sage_sampler.py:306-311) — and the
+        # failure-isolation property this build adds: a process killed while
+        # using an mp.Queue can corrupt that queue (documented
+        # multiprocessing hazard), so nothing may be SHARED between workers
+        # — a death then poisons only the dead worker's own queues, and
+        # worker-death recovery can reroute pending tasks to survivors
+        self._task_qs = [None] * self.num_workers
+        self._result_qs = [None] * self.num_workers
+        self._workers = [None] * self.num_workers
+        self._inbox = queue_mod.Queue()  # thread queue: uncorruptible
         self._shms = []
         shm_names, shapes = [], []
         arrays = [
@@ -207,36 +292,40 @@ class MixedGraphSageSampler:
             shm_names.append(shm.name)
             shapes.append(arr.shape)
         weights_shm = (shm_names[2], shapes[2]) if self.weighted else None
-        shm_names, shapes = shm_names[:2], shapes[:2]
+        self._worker_shm_args = (shm_names[:2], shapes[:2], weights_shm)
         for w in range(self.num_workers):
-            p = ctx.Process(
-                target=_cpu_worker_loop,
-                args=(
-                    shm_names,
-                    shapes,
-                    self.sizes,
-                    self.caps,
-                    self.seed + 7919 * (w + 1),
-                    self._task_q,
-                    self._result_q,
-                    weights_shm,
-                ),
-                daemon=True,
-            )
-            p.start()
-            self._workers.append(p)
+            self._spawn_worker(w)
 
     def shutdown(self) -> None:
-        if self._task_q is not None:
-            for _ in self._workers:
-                self._task_q.put(None)
+        if self._task_qs is not None:
+            for q, p in zip(self._task_qs, self._workers):
+                if p.is_alive():
+                    q.put(None)
         for p in self._workers:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+        # retire drainer threads of TERMINATED workers (a clean worker exit
+        # already posted the sentinel itself); a drainer wedged on a torn
+        # message from a killed worker stays parked — daemon, harmless
+        for q in self._result_qs or []:
+            try:
+                q.put(_DRAIN_DONE)
+            except Exception:
+                pass
+        # never let interpreter exit JOIN these queues' feeder threads: a
+        # dead worker's task queue can hold unread buffered items (pipe
+        # full, no reader), wedging multiprocessing's atexit finalizer
+        # forever (reproduced: 12-passed suite hanging at _exit_function)
+        for q in (self._task_qs or []) + (self._result_qs or []):
+            try:
+                q.cancel_join_thread()
+            except Exception:
+                pass
         self._workers = []
-        self._task_q = None
-        self._result_q = None
+        self._task_qs = None
+        self._result_qs = None
+        self._inbox = None
         for shm in getattr(self, "_shms", []):
             try:
                 shm.close()
@@ -334,28 +423,97 @@ class MixedGraphSageSampler:
         device_num = self.decide_task_num(total)
         self.last_device_share = device_num / max(total, 1)
 
+        # per-task completion tracking enables WORKER-FAILURE RECOVERY (the
+        # reference has none — a dead worker's in-flight task hung its
+        # epoch): duplicates from resubmission are dropped on receipt
+        pending: set = set(range(device_num, total))
+        # EPOCH-scoped recovery state (inside recv_blocking it would reset
+        # per call and re-trigger resubmission storms): the alive watermark,
+        # the last PROGRESS stamp (refreshed on every received result — a
+        # healthy-but-slow pool is not idle), and a 10 s floor between
+        # steals bounding duplicated work
+        recover = {
+            "last_alive": len(self._workers),
+            "last_progress": time.monotonic(),
+            "last_resubmit": time.monotonic(),
+        }
+
         def recv(block: bool):
-            """Next CPU result of THIS epoch, or None."""
+            """Next NEW CPU result of THIS epoch from the drainer inbox, or
+            None when nothing arrives (after ~2 s when blocking). The inbox
+            is an in-process thread queue — worker death cannot corrupt it
+            (the per-worker pipes are only ever read by disposable daemon
+            drainer threads, see _spawn_worker)."""
+            deadline = time.monotonic() + (2.0 if block else 0.0)
             while True:
                 try:
-                    if block:
-                        item = self._result_q.get(timeout=120)
-                    else:
-                        item = self._result_q.get_nowait()
+                    timeout = max(deadline - time.monotonic(), 0.0)
+                    item = self._inbox.get(timeout=timeout) if timeout else (
+                        self._inbox.get_nowait()
+                    )
                 except queue_mod.Empty:
                     return None
                 r_epoch, task_idx, n_id, count, adjs, dt = item
-                if r_epoch != epoch:
-                    continue  # stale result from an interrupted epoch
+                if r_epoch != epoch or task_idx not in pending:
+                    continue  # stale epoch, or duplicate after resubmit
+                pending.discard(task_idx)
+                recover["last_progress"] = time.monotonic()
                 self._update_avg("avg_cpu_time", dt)
                 return task_idx, self._to_dense(n_id, count, adjs)
 
-        # CPU tasks go to the shared queue up front (round-robin in the
-        # reference, one shared queue here — workers self-balance)
-        for t in range(device_num, total):
-            self._task_q.put((epoch, t, np.asarray(self.job[t], np.int64)))
-        outstanding = total - device_num
+        def submit(tasks):
+            """Round-robin tasks over ALIVE workers' queues (the reference's
+            per-worker dispatch, sage_sampler.py:306-311; per-worker queues
+            also mean a killed worker cannot poison a sibling's queue)."""
+            targets = [
+                q for q, p in zip(self._task_qs, self._workers) if p.is_alive()
+            ]
+            if not targets:
+                raise RuntimeError(
+                    "all CPU sampler workers died (see worker stderr); "
+                    f"{len(pending)} task(s) unfinished"
+                )
+            for i, t in enumerate(tasks):
+                targets[i % len(targets)].put(
+                    (epoch, t, np.asarray(self.job[t], np.int64))
+                )
+
+        def recv_blocking():
+            """recv with failure recovery: if a worker DIED while tasks are
+            pending — or the tail has been idle for a while (one slow
+            worker hoarding its round-robin share) — every pending task is
+            resubmitted round-robin to the live workers; duplicate answers
+            are filtered in recv. If the whole pool is dead, fail
+            immediately with the real reason instead of a long stall."""
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                res = recv(block=True)
+                if res is not None:
+                    return res
+                alive = sum(p.is_alive() for p in self._workers)
+                if alive == 0:
+                    raise RuntimeError(
+                        "all CPU sampler workers died (see worker stderr); "
+                        f"{len(pending)} task(s) unfinished"
+                    )
+                now = time.monotonic()
+                died = alive < recover["last_alive"]
+                # steal only when NOTHING has arrived for 10 s (slow-but-
+                # healthy pools keep refreshing last_progress in recv) and
+                # not more often than every 10 s
+                idle_steal = (
+                    now - recover["last_progress"] > 10
+                    and now - recover["last_resubmit"] > 10
+                )
+                if died or idle_steal:
+                    submit(sorted(pending))
+                    recover["last_alive"] = alive
+                    recover["last_resubmit"] = now
+            raise TimeoutError("CPU sampler workers stalled")
+
         try:
+            if pending:
+                submit(range(device_num, total))
             for t in range(device_num):
                 t0 = time.perf_counter()
                 ds = self.device_sampler.sample_dense(self.job[t])
@@ -365,18 +523,13 @@ class MixedGraphSageSampler:
                 self._update_avg("avg_device_time", time.perf_counter() - t0)
                 yield t, ds
                 # drain any finished CPU results between device tasks
-                while outstanding:
+                while pending:
                     res = recv(block=False)
                     if res is None:
                         break
-                    outstanding -= 1
                     yield res
-            while outstanding:
-                res = recv(block=True)
-                if res is None:
-                    raise TimeoutError("CPU sampler workers stalled")
-                outstanding -= 1
-                yield res
+            while pending:
+                yield recv_blocking()
         except Exception:
             # drain workers so the queue doesn't wedge (the reference's only
             # recovery logic, sage_sampler.py:361-368)

@@ -228,3 +228,64 @@ def test_weighted_mixed_epoch(graph):
     # misconfiguration fails loudly
     with pytest.raises(ValueError, match="edge_weights"):
         MixedGraphSageSampler(job, graph, sizes=[4], weighted=True)
+
+
+def test_worker_death_recovery(graph):
+    """Failure recovery beyond the reference (which hangs its epoch if a
+    worker dies with a task in flight): killing one of two workers
+    mid-epoch resubmits pending tasks to the survivor and the epoch still
+    yields every task exactly once."""
+    n = graph.node_count
+    job = TrainSampleJob(np.arange(n), batch_size=10, seed=0)  # many tasks
+    s = MixedGraphSageSampler(
+        job, graph, sizes=[4], num_workers=2, mode="CPU_ONLY"
+    )
+    try:
+        seen = []
+        it = iter(s)
+        seen.append(next(it)[0])
+        # one worker dies with the queue still loaded
+        s._workers[0].terminate()
+        s._workers[0].join(timeout=10)
+        for task_idx, ds in it:
+            seen.append(task_idx)
+    finally:
+        s.shutdown()
+    assert sorted(seen) == list(range(len(job))), seen
+
+
+def test_all_workers_dead_fails_fast_and_heals_next_epoch(graph):
+    """Whole pool dead MID-epoch -> RuntimeError naming the cause within
+    seconds, not a 120 s stall. The NEXT epoch heals: lazy_init respawns
+    dead workers, so a bad epoch doesn't poison the sampler forever."""
+    import time as time_mod
+
+    job = TrainSampleJob(np.arange(40), batch_size=10, seed=0)
+    s = MixedGraphSageSampler(job, graph, sizes=[4], num_workers=1, mode="CPU_ONLY")
+    try:
+        s.lazy_init()
+        first = s._workers[0]
+        first.terminate()
+        first.join(timeout=10)
+        # lazy_init at __iter__ heals the pool; kill again right after the
+        # submit happened by patching lazy_init to kill post-heal
+        orig_lazy = s.lazy_init
+
+        def killing_lazy():
+            orig_lazy()
+            for p in s._workers:
+                p.terminate()
+                p.join(timeout=10)
+
+        s.lazy_init = killing_lazy
+        t0 = time_mod.monotonic()
+        with pytest.raises(RuntimeError, match="workers died"):
+            for _ in s:
+                pass
+        assert time_mod.monotonic() - t0 < 30  # fast, not the 120 s stall
+        # healing: restore lazy_init, next epoch respawns and completes
+        s.lazy_init = orig_lazy
+        seen = sorted(t for t, _ in s)
+        assert seen == list(range(len(job)))
+    finally:
+        s.shutdown()
