@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.sample import fisher_yates_positions, pad_widths
+from ..ops.sample import fisher_yates_positions, pad_widths, row_windows
 
 
 class ShardedTopology(NamedTuple):
@@ -204,8 +204,7 @@ def _sample_layer_partial(
     local = (cur - start).astype(jnp.int32)
     mine = cur_valid & (cur >= start) & (cur < end)
     s = jnp.clip(local, 0, r_max - 1)
-    ptr = jnp.take(indptr_blk, s)
-    deg = (jnp.take(indptr_blk, s + 1) - ptr).astype(jnp.int32)
+    ptr, deg = row_windows(indptr_blk, s)
     deg = jnp.where(mine, deg, 0)
     pos, valid = fisher_yates_positions(key, deg, k)
     flat = jnp.clip(ptr[:, None] + pos.astype(ptr.dtype), 0, e_pad - 1)

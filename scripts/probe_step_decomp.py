@@ -5,9 +5,16 @@ With the true gather rate (~94M rows/s, PERF_NOTES round-4 correction) the
 gather should be ~9 ms of the 44 ms dedup step — this probe finds where the
 rest goes. Same measurement discipline as bench.py.
 """
+import os
+import sys
 import time
 
 import numpy as np
+
+# self-path instead of PYTHONPATH: overriding PYTHONPATH clobbers the
+# axon sitecustomize dir (/root/.axon_site) and silently unregisters the
+# TPU backend — append, never replace
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # bench.py: graph cache + compile cache helpers
 
@@ -30,11 +37,11 @@ CAPS = (16384, 135168, 499712)  # the bench's calibrated caps
 
 
 def timed(fn, *args):
-    jax.block_until_ready(fn(*args))
+    float(fn(*args))  # block_until_ready can return EARLY via the tunnel
     best = None
     for _ in range(2):
         t0 = time.time()
-        jax.block_until_ready(fn(*args))
+        float(fn(*args))
         dt = time.time() - t0
         best = dt if best is None else min(best, dt)
     return best

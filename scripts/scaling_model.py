@@ -38,8 +38,8 @@ def main():
             step_s = epoch / args.steps_per_epoch
             source = f"{args.bench} e2e_fused_epoch_s={epoch}"
     if not step_s:
-        step_s = 0.0436  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
-        source = "PERF_NOTES.md round-4 default 43.6 ms"
+        step_s = 0.0415  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
+        source = "PERF_NOTES.md round-4 default 41.5 ms"
 
     from quiver_tpu.parallel.scaling import format_markdown, products_scaling_table
 
