@@ -41,8 +41,11 @@ from .pyg.sage_sampler import DenseAdj, DenseSample
 
 class Block:
     """One message-flow graph (DGL ``dgl.to_block`` analog) wrapping a
-    :class:`DenseAdj`. Hashable/static metadata only — safe to close over
-    in jitted code (the arrays live in the adj, a pytree)."""
+    :class:`DenseAdj`. Registered as a pytree (the adj's arrays are
+    children, ``num_src`` is static aux), so Blocks can be passed as jit
+    ARGUMENTS — as ``examples/dgl_style_sage.py`` does with the adjs
+    pytree. Do NOT close over a Block in jitted code: a closed-over Block
+    embeds its arrays as compile-time constants and retraces per batch."""
 
     def __init__(self, adj: DenseAdj, num_src: int):
         self.adj = adj
@@ -53,6 +56,13 @@ class Block:
 
     def num_src_nodes(self) -> int:
         return self._num_src
+
+
+jax.tree_util.register_pytree_node(
+    Block,
+    lambda b: ((b.adj,), b._num_src),
+    lambda num_src, children: Block(children[0], num_src),
+)
 
 
 def to_blocks(ds: DenseSample) -> Tuple[jax.Array, jax.Array, List[Block]]:

@@ -342,11 +342,10 @@ def gather_rows(table: np.ndarray, ids: np.ndarray) -> np.ndarray:
     Dtype-agnostic: any C-contiguous 2-D table goes through the native
     byte-row engine (`qt_gather_rows_bytes`) — bf16 cold tiers included
     (the reference's gather kernel is float32-only,
-    quiver_feature.cu:65-69). Out-of-range ids return zero rows (same
-    contract as the f32 path). Non-contiguous or 1-D inputs fall back to
-    numpy fancy indexing, whose contract DIFFERS on bad ids (ids >= N
-    raise IndexError; ids in [-N, -1) silently WRAP to end-relative rows)
-    — callers on that path must pre-validate, as Feature does."""
+    quiver_feature.cu:65-69). Out-of-range ids (negative or >= N) return
+    zero rows — one contract on EVERY path: the native byte/f32 engines
+    zero-fill in C, and the numpy fallback masks invalid ids and zeroes
+    their rows so behavior does not depend on which .so is loaded."""
     lib = _load_native()
     ids = np.ascontiguousarray(ids, np.int64)
     plain = (
@@ -380,4 +379,12 @@ def gather_rows(table: np.ndarray, ids: np.ndarray) -> np.ndarray:
             out.ctypes.data,
         )
         return out
-    return table[ids]
+    # numpy fallback: enforce the same zero-row contract as the native
+    # paths (fancy indexing would instead raise on ids >= N and silently
+    # wrap negative ids to end-relative rows)
+    ok = (ids >= 0) & (ids < table.shape[0])
+    if ok.all():
+        return np.ascontiguousarray(table[ids])
+    out = table[np.where(ok, ids, 0)]
+    out[~ok] = 0
+    return out

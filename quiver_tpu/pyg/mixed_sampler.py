@@ -144,6 +144,7 @@ class MixedGraphSageSampler:
         auto_tune_workers: bool = False,
         device_share_target: float = 0.5,
         weighted: bool = False,
+        max_deg: int = 512,
     ):
         mode = self.MODE_ALIASES.get(mode, mode)
         if mode not in ("TPU_CPU_MIXED", "HOST_CPU_MIXED", "TPU_ONLY", "CPU_ONLY"):
@@ -155,12 +156,26 @@ class MixedGraphSageSampler:
                 "weighted=True needs CSRTopo(edge_weights=...) "
                 "(per-edge weights aligned with the COO input)"
             )
+        if weighted and "MIXED" in mode and num_workers > 0:
+            # the device engine weights only each row's first max_deg edges
+            # (its static window), the CPU engine weights ALL edges — on a
+            # graph whose max degree exceeds max_deg, device-assigned and
+            # CPU-assigned tasks would draw from different distributions
+            graph_max_deg = int(np.max(np.diff(csr_topo.indptr))) if len(
+                csr_topo.indptr) > 1 else 0
+            if graph_max_deg > max_deg:
+                raise ValueError(
+                    f"weighted MIXED sampling needs max_deg >= the graph's "
+                    f"max degree ({graph_max_deg}; got max_deg={max_deg}): "
+                    f"the device engine weights only the first max_deg edges "
+                    f"per row while CPU workers weight all edges, so the two "
+                    f"halves of one epoch would sample different "
+                    f"distributions. Raise max_deg, or use CPU_ONLY/TPU_ONLY."
+                )
         if weighted and num_workers > 0 and ("MIXED" in mode or mode == "CPU_ONLY"):
             # fail HERE with the real reason: otherwise every spawned worker
             # dies on HostSampler's RuntimeError in a detached process and
             # the parent only sees a 120 s "workers stalled" timeout
-            from ..ops.cpu_kernels import native_available
-
             from ..ops.cpu_kernels import _load_native
 
             lib = _load_native()
@@ -187,7 +202,7 @@ class MixedGraphSageSampler:
             if mode == "CPU_ONLY"
             else GraphSageSampler(
                 csr_topo, sizes, device=device, mode=dev_mode, caps=caps,
-                seed=seed, weighted=weighted,
+                seed=seed, weighted=weighted, max_deg=max_deg,
             )
         )
         self._workers = []
@@ -485,8 +500,8 @@ class MixedGraphSageSampler:
             resubmitted round-robin to the live workers; duplicate answers
             are filtered in recv. If the whole pool is dead, fail
             immediately with the real reason instead of a long stall."""
-            deadline = time.monotonic() + 120
-            while time.monotonic() < deadline:
+            start = time.monotonic()
+            while True:
                 res = recv(block=True)
                 if res is not None:
                     return res
@@ -498,18 +513,24 @@ class MixedGraphSageSampler:
                     )
                 now = time.monotonic()
                 died = alive < recover["last_alive"]
-                # steal only when NOTHING has arrived for 10 s (slow-but-
-                # healthy pools keep refreshing last_progress in recv) and
-                # not more often than every 10 s
+                # steal only when NOTHING has arrived for an idle window
+                # (slow-but-healthy pools keep refreshing last_progress in
+                # recv), rate-limited to the same window; the window scales
+                # with the measured per-task time — capped at 90 s — so
+                # legitimately slow tasks (huge fanouts, loaded host) don't
+                # trigger resubmit storms, and the stall deadline scales
+                # with the window so the steal always gets to fire first
+                idle_s = min(max(10.0, 3.0 * self.avg_cpu_time), 90.0)
                 idle_steal = (
-                    now - recover["last_progress"] > 10
-                    and now - recover["last_resubmit"] > 10
+                    now - recover["last_progress"] > idle_s
+                    and now - recover["last_resubmit"] > idle_s
                 )
                 if died or idle_steal:
                     submit(sorted(pending))
                     recover["last_alive"] = alive
                     recover["last_resubmit"] = now
-            raise TimeoutError("CPU sampler workers stalled")
+                if now - start > max(120.0, 4.0 * idle_s):
+                    raise TimeoutError("CPU sampler workers stalled")
 
         try:
             if pending:

@@ -199,15 +199,7 @@ def test_native_gather_rows_any_dtype():
     from quiver_tpu.ops.cpu_kernels import _load_native
 
     rng = np.random.default_rng(0)
-    # OOB ids only exercise the BYTES engine's zero-row contract; both the
-    # numpy fallback and the stale-.so f32 legacy path require in-range ids
-    lib = _load_native()
-    has_bytes = lib is not None and hasattr(lib, "qt_gather_rows_bytes")
-    ids = (
-        np.array([3, 0, 7, -1, 12, 5], np.int64)
-        if has_bytes
-        else np.array([3, 0, 7, 5], np.int64)
-    )
+    ids = np.array([3, 0, 7, -1, 12, 5], np.int64)
     for dtype in (np.float32, np.float64, np.int32, jnp.bfloat16):
         table = rng.standard_normal((10, 5)).astype(dtype)
         got = gather_rows(table, ids)
@@ -217,3 +209,24 @@ def test_native_gather_rows_any_dtype():
                 np.testing.assert_array_equal(got[i], table[idx])
             else:
                 assert (np.asarray(got[i], np.float64) == 0).all()
+
+
+def test_gather_rows_fallback_same_contract():
+    """The numpy fallback (non-contiguous table, so the native engine is
+    skipped) shares the native paths' contract: OOB ids — negative or
+    >= N — yield zero rows, never IndexError, never end-relative wrap."""
+    from quiver_tpu.ops.cpu_kernels import gather_rows
+
+    rng = np.random.default_rng(1)
+    base = rng.standard_normal((10, 8)).astype(np.float32)
+    table = base[:, ::2]  # non-contiguous view: forces the numpy fallback
+    assert not table.flags.c_contiguous
+    ids = np.array([2, -1, 9, 10, -3, 0], np.int64)
+    got = gather_rows(table, ids)
+    assert got.shape == (6, 4)
+    for i, idx in enumerate(ids):
+        if 0 <= idx < 10:
+            np.testing.assert_array_equal(got[i], table[idx])
+        else:
+            # -1/-3 must be ZERO rows (not wrap to table[9]/table[7])
+            assert (got[i] == 0).all()

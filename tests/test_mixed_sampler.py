@@ -230,6 +230,36 @@ def test_weighted_mixed_epoch(graph):
         MixedGraphSageSampler(job, graph, sizes=[4], weighted=True)
 
 
+def test_weighted_mixed_max_deg_guard(graph):
+    """In weighted MIXED mode the device engine weights only each row's
+    first ``max_deg`` edges while CPU workers weight all of them — a graph
+    whose max degree exceeds max_deg would mix two distributions in one
+    epoch, so construction must refuse. max_deg is also forwarded to the
+    device sampler (it was previously stuck at the 512 default)."""
+    ew = np.ones(len(graph.indices), np.float32)
+    topo = CSRTopo(indptr=graph.indptr, indices=graph.indices, edge_weights=ew)
+    job = TrainSampleJob(np.arange(32), 8)
+    max_deg_graph = int(np.max(np.diff(np.asarray(topo.indptr))))
+    with pytest.raises(ValueError, match="max_deg"):
+        MixedGraphSageSampler(
+            job, topo, sizes=[4], num_workers=1, mode="TPU_CPU_MIXED",
+            weighted=True, max_deg=max_deg_graph - 1,
+        )
+    # with no CPU half there is no second distribution: num_workers=0
+    # stays device-only and must NOT be rejected
+    s = MixedGraphSageSampler(
+        job, topo, sizes=[4], num_workers=0, mode="TPU_CPU_MIXED",
+        weighted=True, max_deg=max_deg_graph - 1,
+    )
+    assert s.device_sampler.max_deg == max_deg_graph - 1
+    # a sufficient max_deg constructs and reaches the device sampler
+    s2 = MixedGraphSageSampler(
+        job, topo, sizes=[4], num_workers=0, mode="TPU_CPU_MIXED",
+        weighted=True, max_deg=max_deg_graph,
+    )
+    assert s2.device_sampler.max_deg == max_deg_graph
+
+
 def test_worker_death_recovery(graph):
     """Failure recovery beyond the reference (which hangs its epoch if a
     worker dies with a task in flight): killing one of two workers
