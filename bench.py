@@ -131,7 +131,7 @@ def build_graph(n_nodes=2_449_029, n_edges=2 * 61_859_140, seed=0):
     return indptr, indices
 
 
-def make_scanned_sampler(sample_fn, sizes, iters):
+def make_scanned_sampler(sample_fn, sizes, iters, caps=None):
     """One jitted program running `iters` sample iterations in a lax.scan —
     a single dispatch + a single dependent fetch, so tunnel RPC latency is
     amortized across the whole run instead of multiplying it.
@@ -142,19 +142,35 @@ def make_scanned_sampler(sample_fn, sizes, iters):
     scripts/probe_seps_dce.py), which would bench a program that never
     materializes the sample the reference's SEPS metric counts (round-3/
     early-round-4 numbers had this flaw; PERF_NOTES.md "SEPS correction").
+
+    The graph rides the TILED layout (bd, tiles — the library's TPU-mode
+    default); `caps` (dedup leg) are the calibrated static caps, with the
+    summed cap_overflow returned as output [2] so the harness can assert
+    the capped run dropped NOTHING (same edges as uncapped = exact
+    reference semantics, just less padding).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    from quiver_tpu.ops.sample import tiled_sample_layer
+
     @jax.jit
-    def run_many(ip, ix, key0, seeds_all):
+    def run_many(bd, tiles, key0, seeds_all):
         m = seeds_all.shape[0]
 
+        def hop(cur, cur_valid, k, key):
+            return tiled_sample_layer(bd, tiles, cur, cur_valid, k, key)
+
         def body(carry, i):
-            acc, tacc = carry
+            acc, tacc, oacc = carry
             key = jax.random.fold_in(key0, i)
-            ds = sample_fn(ip, ix, key, seeds_all[i % m], sizes)
+            if caps is None:
+                ds = sample_fn(None, None, key, seeds_all[i % m], sizes, sample_fn=hop)
+            else:
+                ds = sample_fn(
+                    None, None, key, seeds_all[i % m], sizes, caps, sample_fn=hop
+                )
             edges = sum(adj.mask.sum(dtype=jnp.int32) for adj in ds.adjs)
             # checksum over every other output, returned as a PROGRAM
             # OUTPUT — an accumulator that algebraically cancels (x+0) or
@@ -163,47 +179,58 @@ def make_scanned_sampler(sample_fn, sizes, iters):
             for adj in ds.adjs:
                 if adj.cols is not None:
                     touch = touch + adj.cols.sum(dtype=jnp.int32)
-            return (acc + edges, tacc + touch), None
+            ov = jnp.int32(0) if ds.cap_overflow is None else ds.cap_overflow
+            return (acc + edges, tacc + touch, oacc + ov), None
 
-        (acc, touch), _ = lax.scan(
-            body, (jnp.int32(0), jnp.int32(0)), jnp.arange(iters, dtype=jnp.int32)
+        (acc, touch, oacc), _ = lax.scan(
+            body,
+            (jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+            jnp.arange(iters, dtype=jnp.int32),
         )
         # ONE fetchable output (a second int() would be a second ~0.11 s
         # D2H round trip inside the timed window)
-        return jnp.stack([acc, touch])
+        return jnp.stack([acc, touch, oacc])
 
     return run_many
 
 
-def bench_sampling(context, indptr, indices, seeds_all, iters=200):
+def bench_sampling(context, bd, tiles, seeds_all, caps, iters=200):
     import jax
 
     from quiver_tpu.pyg.sage_sampler import sample_dense_fused, sample_dense_pure
 
     sizes = (15, 10, 5)
     results = {}
-    for name, fn in (("fused", sample_dense_fused), ("dedup", sample_dense_pure)):
+    for name, fn, leg_caps in (
+        ("fused", sample_dense_fused, None),
+        ("dedup", sample_dense_pure, caps),
+    ):
         if remaining() < 60:
             log(f"budget exhausted before {name} sampling bench")
             break
         try:
-            run = make_scanned_sampler(fn, sizes, iters)
+            run = make_scanned_sampler(fn, sizes, iters, caps=leg_caps)
             log(f"compiling {name} pipeline...")
             t0 = time.time()
-            total = int(np.asarray(run(indptr, indices, jax.random.key(0), seeds_all))[0])
+            out = np.asarray(run(bd, tiles, jax.random.key(0), seeds_all))
             compile_s = time.time() - t0
             t0 = time.time()
-            total = int(np.asarray(run(indptr, indices, jax.random.key(1), seeds_all))[0])
+            out = np.asarray(run(bd, tiles, jax.random.key(1), seeds_all))
             dt = max(time.time() - t0 - _RPC_FLOOR_S, 1e-9)
+            total, overflow = int(out[0]), int(out[2])
             seps = total / dt
             log(
                 f"{name:5s}: {seps/1e6:.2f}M SEPS ({total} edges, {iters} iters in "
-                f"{dt:.2f}s net of floor; compile+first {compile_s:.1f}s)"
+                f"{dt:.2f}s net of floor; compile+first {compile_s:.1f}s"
+                + (f", cap_overflow {overflow}" if leg_caps is not None else "")
+                + ")"
             )
             results[name] = seps
             context[f"{name}_compile_s"] = round(compile_s, 1)
             context[f"{name}_seps"] = round(seps, 1)
             context[f"{name}_vs_uva_baseline"] = round(seps / BASELINE_SEPS, 4)
+            if leg_caps is not None:
+                context["dedup_sampling_cap_overflow"] = overflow
         except Exception as exc:  # one leg failing must not lose the JSON
             log(f"{name} sampling bench failed: {exc}")
     return results
@@ -342,7 +369,7 @@ def calibrate_bench_caps(indptr, indices, seeds_all, batch, sizes=(15, 10, 5)):
     return caps
 
 
-def bench_e2e(context, indptr, indices, seeds_all, table, iters=None, classes=47, caps=None):
+def bench_e2e(context, bd, tiles, seeds_all, table, iters=None, classes=47, caps=None):
     """True e2e epoch: ONE jitted program scans a full epoch's worth of train
     steps (sample -> feature gather -> 3-layer GraphSAGE fwd/bwd -> adam),
     ceil(196615/1024) = 193 steps, timed as one dispatch + one dependent
@@ -355,6 +382,7 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=None, classes=47
     from jax import lax
 
     from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops.sample import tiled_sample_layer
     from quiver_tpu.pyg.sage_sampler import (
         sample_and_gather_dedup,
         sample_and_gather_fused,
@@ -372,22 +400,26 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=None, classes=47
     model = GraphSAGE(hidden_dim=256, out_dim=classes, num_layers=3, dropout=0.0)
     tx = optax.adam(1e-3)
 
-    if caps is None:
-        caps = calibrate_bench_caps(indptr, indices, seeds_all, batch, sizes)
-
     def make_epoch(sample_fn, sample_caps):
-        def one_step(params, opt_state, ip, ix, tab, lab, key, seeds):
+        def one_step(params, opt_state, g_bd, g_tiles, tab, lab, key, seeds):
             key, sub = jax.random.split(key)
+
+            def hop(cur, cur_valid, k, hkey):
+                return tiled_sample_layer(g_bd, g_tiles, cur, cur_valid, k, hkey)
+
             if sample_fn is sample_and_gather_fused:
                 # per-hop interleaved gather: XLA overlaps each hop's
                 # (row-rate-bound) feature fetch with the next hop's sampling
-                ds, x = sample_and_gather_fused(ip, ix, tab, sub, seeds, sizes)
+                ds, x = sample_and_gather_fused(
+                    None, None, tab, sub, seeds, sizes, sample_fn=hop
+                )
             else:
                 # reference-parity dedup DAG with the structural last hop:
                 # leaf features ride one constant-table gather (no cols
                 # gather from activations, no backward scatter)
                 ds, x = sample_and_gather_dedup(
-                    ip, ix, tab, sub, seeds, sizes, sample_caps
+                    None, None, tab, sub, seeds, sizes, sample_caps,
+                    sample_fn=hop,
                 )
             y = jnp.take(lab, jnp.clip(ds.n_id[:batch], 0, lab.shape[0] - 1))
 
@@ -403,14 +435,14 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=None, classes=47
             return params, opt_state, loss, ov
 
         @jax.jit
-        def epoch(params, opt_state, ip, ix, tab, lab, key0, seeds_all):
+        def epoch(params, opt_state, g_bd, g_tiles, tab, lab, key0, seeds_all):
             m = seeds_all.shape[0]
 
             def body(carry, i):
                 params, opt_state = carry
                 key = jax.random.fold_in(key0, i)
                 params, opt_state, loss, ov = one_step(
-                    params, opt_state, ip, ix, tab, lab, key, seeds_all[i % m]
+                    params, opt_state, g_bd, g_tiles, tab, lab, key, seeds_all[i % m]
                 )
                 return (params, opt_state), (loss, ov)
 
@@ -430,14 +462,18 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=None, classes=47
         if remaining() < 150:
             log(f"budget exhausted before e2e {name}")
             break
+        def hop0(cur, cur_valid, k, hkey):
+            return tiled_sample_layer(bd, tiles, cur, cur_valid, k, hkey)
+
         if sample_fn is sample_and_gather_fused:
             ds_real, x0 = sample_and_gather_fused(
-                indptr, indices, table, jax.random.key(0), jnp.asarray(seeds_all[0]), sizes
+                None, None, table, jax.random.key(0), jnp.asarray(seeds_all[0]),
+                sizes, sample_fn=hop0,
             )
         else:
             ds_real, x0 = sample_and_gather_dedup(
-                indptr, indices, table, jax.random.key(0), jnp.asarray(seeds_all[0]),
-                sizes, sample_caps,
+                None, None, table, jax.random.key(0), jnp.asarray(seeds_all[0]),
+                sizes, sample_caps, sample_fn=hop0,
             )
         params = model.init(jax.random.key(1), x0, ds_real.adjs)
         opt_state = tx.init(params)
@@ -445,13 +481,13 @@ def bench_e2e(context, indptr, indices, seeds_all, table, iters=None, classes=47
         log(f"compiling e2e {name} step...")
         t0 = time.time()
         params, opt_state, losses, ov = epoch_fn(
-            params, opt_state, indptr, indices, table, labels, jax.random.key(2), seeds_all
+            params, opt_state, bd, tiles, table, labels, jax.random.key(2), seeds_all
         )
         float(losses[-1])
         compile_s = time.time() - t0
         t0 = time.time()
         params, opt_state, losses, ov = epoch_fn(
-            params, opt_state, indptr, indices, table, labels, jax.random.key(3), seeds_all
+            params, opt_state, bd, tiles, table, labels, jax.random.key(3), seeds_all
         )
         float(losses[-1])  # dependent fetch == all steps executed
         dt = time.time() - t0
@@ -557,6 +593,9 @@ def bench_tiered_pipeline(
 
     pipe_s = {}
     for depth in (1, 2):
+        # timed epochs run UNINSTRUMENTED: measure_overlap syncs each
+        # step's loss (one ~0.1 s D2H per step on this tunnel) inside the
+        # window — the async pipeline being benchmarked pays no such cost
         tp_d = TrainPipeline(sampler, feat, step_fn, depth=depth, tiered=pipe)
         t0 = time.time()
         params, opt_state, losses = tp_d.run_epoch(
@@ -564,6 +603,21 @@ def bench_tiered_pipeline(
         )
         pipe_s[depth] = time.time() - t0
     best = min(pipe_s.values())
+    best_depth = min(pipe_s, key=pipe_s.get)
+    # separate instrumented epoch for the MEASURED overlap evidence (its
+    # per-step syncs stay outside every timed window above)
+    ov = {}
+    if remaining() > 60:
+        tp_m = TrainPipeline(
+            sampler, feat, step_fn, depth=best_depth, tiered=pipe,
+            measure_overlap=True,
+        )
+        params, opt_state, _ = tp_m.run_epoch(
+            seed_batches, params, opt_state, jax.random.key(5)
+        )
+        ov = tp_m.stats.overlap_summary()
+    else:
+        log("budget exhausted before instrumented overlap epoch")
     w = int(b0.mapped.shape[0])
     gbps_pipe = batches * w * dim * 4 / best / 1e9
     # the floor the LINK imposes: the cold bytes must cross the tunnel no
@@ -593,6 +647,22 @@ def bench_tiered_pipeline(
     context["tiered_link_efficiency"] = round(link_eff, 3)
     context["feature_tiered20_pipe_gbps"] = round(gbps_pipe, 3)
     context["tiered_link_bound_gbps"] = round(bound_gbps, 3)
+    # MEASURED overlap (one monotonic clock over the pipelined run itself;
+    # round-4 verdict item 3 — the seq-minus-pipe subtraction above leans
+    # on a separately-timed link probe and drifts with tunnel state):
+    # overlap_frac = fraction of the covered wall with >= 2 stages active;
+    # hidden_frac_measured = share of total stage busy-time hidden under
+    # another stage (0 = serial; 0.75 = four stages perfectly stacked)
+    if ov:
+        log(
+            f"tiered pipeline measured overlap (depth {best_depth}): "
+            f">=2 stages active {ov['overlap_frac']:.0%} of wall; "
+            f"{ov['hidden_frac_measured']:.0%} of stage busy-time hidden; "
+            f"busy {ov['busy_s']}"
+        )
+        context["tiered_overlap_measured"] = ov["overlap_frac"]
+        context["tiered_hidden_frac_measured"] = ov["hidden_frac_measured"]
+        context["tiered_stage_busy_s"] = ov["busy_s"]
 
 
 def wait_for_backend(max_wait_s=None):
@@ -671,9 +741,36 @@ def main():
         jnp.asarray(rng.integers(0, n_nodes, (24, batch), dtype=np.int64).astype(np.int32))
     )
 
+    # 128-lane tile layout (the library's TPU default): row map host-built
+    # (cheap numpy work, ~20 MB upload), the 1.45 GB tile table built ON
+    # DEVICE by one [M, 128] gather — shipping it through the tunnel would
+    # cost ~25-45 s
+    from quiver_tpu.ops.sample import (
+        build_tiled_device,
+        tiled_base_host,
+        tiled_rowmap_host,
+    )
+
+    t0 = time.time()
+    bd_np, m_rows = tiled_base_host(indptr_np)
+    row_start, row_width = tiled_rowmap_host(indptr_np)
+    bd = jax.device_put(jnp.asarray(bd_np))
+    tiles = build_tiled_device(
+        indices,
+        jax.device_put(jnp.asarray(row_start.astype(np.int32))),
+        jax.device_put(jnp.asarray(row_width)),
+    )
+    int(tiles[-1, -1])
+    log(f"tiled layout: {m_rows} x 128 rows built on device in {time.time()-t0:.1f}s")
+
     context = {}
     context["rpc_floor_s"] = round(measure_rpc_floor(), 3)
-    results = bench_sampling(context, indptr, indices, seeds_all)
+    caps = None
+    try:
+        caps = calibrate_bench_caps(indptr, indices, seeds_all, batch)
+    except Exception as exc:
+        log(f"cap calibration failed: {exc}")
+    results = bench_sampling(context, bd, tiles, seeds_all, caps)
     # products-like feature table, generated ON DEVICE (a host-side table
     # would cost minutes of tunnel transfer); shared by both sections
     dim = 100
@@ -697,14 +794,9 @@ def main():
             log("budget exhausted before host sampler bench")
     except Exception as exc:
         log(f"host sampler bench failed: {exc}")
-    caps = None
-    try:
-        caps = calibrate_bench_caps(indptr, indices, seeds_all, batch)
-    except Exception as exc:
-        log(f"cap calibration failed: {exc}")
     try:
         if remaining() > 120:
-            bench_e2e(context, indptr, indices, seeds_all, table, caps=caps)
+            bench_e2e(context, bd, tiles, seeds_all, table, caps=caps)
         else:
             log("budget exhausted before e2e bench")
     except Exception as exc:

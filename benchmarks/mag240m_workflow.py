@@ -186,7 +186,9 @@ def train_multihost(args, edge_index, feat, labels, train_idx, art_path):
     indices = replicate(mesh, topo.indices.astype(np.int32))
     labels_d = replicate(mesh, labels_r.astype(np.int32))
 
-    ip0, ix0 = sampler.lazy_init_quiver()
+    # flat device pair for the init-shape probe (lazy_init_quiver
+    # returns the TILED binding under the default layout)
+    ip0, ix0 = sampler.csr_topo.to_device()
     ds0 = sample_dense_pure(
         ip0, ix0, jax.random.key(0),
         jnp.arange(args.batch_per_dp, dtype=ix0.dtype), sizes, caps,

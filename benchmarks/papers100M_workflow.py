@@ -127,7 +127,9 @@ def run_sharded(args, edge_index, feat, labels, train_idx, val_idx):
     # init-shape probe through the sampler's own device arrays: CSRTopo
     # picks the id dtype (and refuses int64 when x64 is off) instead of a
     # hand-rolled int32 cast that would wrap >2^31-edge graphs
-    ip0, ix0 = sampler.lazy_init_quiver()
+    # flat device pair for the init-shape probe (lazy_init_quiver
+    # returns the TILED binding under the default layout)
+    ip0, ix0 = sampler.csr_topo.to_device()
     ds0 = sample_dense_pure(
         ip0, ix0, jax.random.key(0),
         jnp.arange(args.batch_per_dp, dtype=ix0.dtype), sizes, caps,

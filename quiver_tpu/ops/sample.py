@@ -92,7 +92,11 @@ def fisher_yates_positions(key: jax.Array, deg: jax.Array, k: int) -> Tuple[jax.
         j = i + (u * span.astype(u.dtype)).astype(jnp.int32)
         j = jnp.minimum(j, jnp.maximum(deg - 1, 0))
         in_head = j < k
-        head_val = jnp.take_along_axis(head, jnp.clip(j, 0, k - 1)[:, None], axis=1)[:, 0]
+        # one-hot select, NOT take_along_axis: a per-row dynamic lane read
+        # lowers to a B-descriptor gather per scan step (~5 ms/hop at
+        # products hop-3 shape — measured, scripts/probe_fetch_final.py);
+        # the one-hot compare+sum is pure VPU work
+        head_val = jnp.where(ar_k[None, :] == j[:, None], head, 0).sum(axis=1)
         match = tail_j == j[:, None]  # [B, k]
         has_match = match.any(axis=1)
         tail_val = jnp.where(has_match, jnp.where(match, tail_v, 0).sum(axis=1), j)
@@ -229,6 +233,145 @@ def sample_layer(
     flat = ptr[:, None] + pos.astype(ptr.dtype)
     flat = jnp.clip(flat, 0, jnp.asarray(indices.shape[0] - 1, ptr.dtype))
     nbrs = jnp.take(indices, flat)
+    return nbrs, valid
+
+
+LANE = 128  # native int32 lane width — the tile row size
+
+
+def build_tiled_host(
+    indptr: "np.ndarray", indices: "np.ndarray", id_dtype=None
+) -> Tuple["np.ndarray", "np.ndarray"]:
+    """Host-side build of the LANE-aligned edge-tile layout.
+
+    Each node's edge list is copied to start at a 128-lane row boundary
+    of a ``[M, 128]`` tile table; a ``[N, 2]`` (tile_base, degree) int32
+    table replaces indptr for sampling. Sampled position ``p`` of node
+    ``i`` then lives at tile row ``base[i] + p // 128``, lane ``p % 128``
+    — so the neighbor fetch becomes 2-D ROW gathers (measured ~115-145M
+    rows/s on v5e) + an in-register one-hot lane select, instead of
+    one-element gathers (~45-90M/s): scripts/probe_rowgather_width.py,
+    probe_tiled_variants.py, probe_fetch_final.py. Exact for every
+    degree — no copy-all/hub split. Memory: ceil-padding to 128 costs
+    ~(E + 64*N)/E x the flat CSR (products: 1.45 GB vs 0.49 GB).
+
+    Replaces the flat-CSR read path of the reference's sample_kernel
+    (srcs/cpp/src/quiver/cuda/quiver_sample.cu:134-200) — GPU warps read
+    ragged rows through UVA/HBM fine, TPU DMA wants tiled rows.
+
+    Returns ``(bd, tiles)``: bd ``[N, 2]`` int32, tiles ``[M, 128]`` of
+    ``id_dtype`` (int32 when node ids fit).
+    """
+    import numpy as np
+
+    if id_dtype is None:
+        from ..utils import _best_id_dtype
+
+        id_dtype = _best_id_dtype(indptr.shape[0])  # node ids, not edge ids
+    bd, M = tiled_base_host(indptr)
+    base = bd[:, 0].astype(np.int64)
+    deg = bd[:, 1].astype(np.int64)
+    tiles = np.zeros((M, LANE), np.dtype(id_dtype))
+    out_pos = (
+        np.repeat(base * LANE, deg)
+        + np.arange(len(indices), dtype=np.int64)
+        - np.repeat(indptr[:-1].astype(np.int64), deg)
+    )
+    tiles.reshape(-1)[out_pos] = indices.astype(id_dtype, copy=False)
+    return bd, tiles
+
+
+@jax.jit
+def build_tiled_device(
+    indices: jax.Array, row_start: jax.Array, row_width: jax.Array
+) -> jax.Array:
+    """Build the ``[M, 128]`` tile table ON DEVICE from a flat indices
+    array already in HBM (the host build + H2D of `build_tiled_host`
+    costs ~25-45 s of tile-table transfer through a thin link; this is
+    one [M, 128] gather on-chip, ~seconds).
+
+    ``row_start``/``row_width``: per-TILE-ROW flat edge offset and valid
+    lane count, host-computed by `tiled_rowmap_host` (cheap [M] numpy
+    work, ~20 MB upload). Deliberately gather-only: the scatter/scan
+    formulation of this build compiled pathologically on TPU (>25 min —
+    big 1-D scatters, the same wall ops/reindex.py documents for 1-D
+    million-element ops).
+    """
+    e = indices.shape[0]
+    lanes = jnp.arange(LANE, dtype=row_start.dtype)
+    g = row_start[:, None] + lanes[None, :]
+    vals = jnp.take(indices, jnp.clip(g, 0, e - 1))
+    return jnp.where(lanes[None, :] < row_width[:, None], vals, 0)
+
+
+def tiled_base_host(indptr) -> Tuple["np.ndarray", int]:
+    """Host half of the tile build: ``(bd [N,2] int32, m_rows)``."""
+    import numpy as np
+
+    deg = np.diff(indptr).astype(np.int64)
+    rows_per = -(-deg // LANE)
+    base = np.zeros(len(deg) + 1, np.int64)
+    np.cumsum(rows_per, out=base[1:])
+    if base[-1] > np.iinfo(np.int32).max:
+        raise ValueError(f"tile row count {base[-1]} exceeds int32")
+    bd = np.stack([base[:-1].astype(np.int32), deg.astype(np.int32)], axis=1)
+    return bd, max(int(base[-1]), 1)
+
+
+def tiled_rowmap_host(indptr):
+    """Per-tile-row (flat_edge_start, valid_lane_count) for
+    `build_tiled_device`: row r of the tile table holds edges
+    ``[start[r], start[r] + width[r])`` of its owner node. Row
+    accounting comes from `tiled_base_host` — one definition of the
+    base/degree math."""
+    import numpy as np
+
+    bd, M = tiled_base_host(indptr)
+    base = bd[:, 0].astype(np.int64)
+    deg = bd[:, 1].astype(np.int64)
+    rows_per = -(-deg // LANE)
+    owner = np.repeat(np.arange(len(deg), dtype=np.int64), rows_per)
+    if owner.shape[0] == 0:  # empty graph: one all-padding row
+        return np.zeros(1, np.int64), np.zeros(1, np.int32)
+    t = np.arange(M, dtype=np.int64) - base[owner]
+    start = indptr[:-1][owner] + t * LANE
+    width = np.minimum(indptr[1:][owner] - start, LANE).astype(np.int32)
+    return start, width
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def tiled_sample_layer(
+    bd: jax.Array,
+    tiles: jax.Array,
+    seeds: jax.Array,
+    seed_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-hop sample over the LANE-aligned tile layout (`build_tiled_host`).
+
+    Draw-identical to :func:`sample_layer` on the same key (same
+    Fisher-Yates positions; only the fetch path differs): positions are
+    resolved via k 2-D row gathers + one-hot lane selects. Measured at
+    products hop-3 shape: fetch 6.5 vs 9.0 ms (scripts/probe_fetch_final.py).
+    """
+    n = bd.shape[0]
+    s = jnp.clip(seeds, 0, n - 1).astype(jnp.int32)
+    both = jnp.take(bd, s, axis=0)
+    base, deg = both[:, 0], both[:, 1]
+    deg = jnp.where(seed_valid, deg, 0)
+    pos, valid = fisher_yates_positions(key, deg, k)
+    rows = base[:, None] + lax.shift_right_logical(pos, LANE.bit_length() - 1)
+    rows = jnp.clip(rows, 0, tiles.shape[0] - 1)
+    lane = jnp.bitwise_and(pos, LANE - 1)
+    ar = jnp.arange(LANE, dtype=jnp.int32)
+    cols = []
+    for j in range(k):  # k-split: k [B]-row gathers measured faster than
+        #                 one [B*k] (probe_tiled_variants: 6.2 vs 7.1 ms)
+        win = jnp.take(tiles, rows[:, j], axis=0)
+        oh = lane[:, j][:, None] == ar[None, :]
+        cols.append(jnp.where(oh, win, 0).sum(axis=1))
+    nbrs = jnp.stack(cols, axis=1).astype(tiles.dtype)
     return nbrs, valid
 
 

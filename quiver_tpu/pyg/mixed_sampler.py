@@ -156,11 +156,13 @@ class MixedGraphSageSampler:
                 "weighted=True needs CSRTopo(edge_weights=...) "
                 "(per-edge weights aligned with the COO input)"
             )
-        if weighted and "MIXED" in mode and num_workers > 0:
-            # the device engine weights only each row's first max_deg edges
-            # (its static window), the CPU engine weights ALL edges — on a
-            # graph whose max degree exceeds max_deg, device-assigned and
-            # CPU-assigned tasks would draw from different distributions
+        if weighted and mode == "TPU_CPU_MIXED" and num_workers > 0:
+            # the TPU engine weights only each row's first max_deg edges
+            # (its static lane window), the CPU engine weights ALL edges —
+            # on a graph whose max degree exceeds max_deg, device-assigned
+            # and CPU-assigned tasks would draw from different
+            # distributions. HOST_CPU_MIXED is exempt: its "device" half
+            # is the host native engine, which also weights all edges.
             graph_max_deg = int(np.max(np.diff(csr_topo.indptr))) if len(
                 csr_topo.indptr) > 1 else 0
             if graph_max_deg > max_deg:

@@ -1,0 +1,160 @@
+"""Probe 3: where does the standalone dedup (sample_dense_pure) iter go?
+
+BENCH_r04: dedup 18.5M SEPS = 0.54x the 34.29M UVA baseline — the one
+below-baseline row. Decompose an iter into sampling vs per-hop reindex,
+uncapped vs capped, to size the levers (caps in the bench harness,
+payload-slimmed sorts, fetch redesign) before building any.
+
+Run: python -u scripts/probe_dedup_decomp.py   (TPU, nothing concurrent)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def measure_rpc_floor(dev_x, n=6):
+    ts = []
+    for _ in range(n):
+        t0 = time.time()
+        float(jnp.sum(dev_x[:8]))
+        ts.append(time.time() - t0)
+    return float(np.median(ts))
+
+
+SIZES = (15, 10, 5)
+CAPS = (16384, 135168, 499712)  # BENCH_r04 calibrated caps
+B = 1024
+ITERS = 60
+
+
+def main():
+    from bench import build_graph
+    from quiver_tpu.pyg.sage_sampler import sample_dense_fused, sample_dense_pure
+    from quiver_tpu.ops.reindex import local_reindex
+
+    indptr_np, indices_np = build_graph()
+    indptr = jnp.asarray(indptr_np)
+    indices = jnp.asarray(indices_np.astype(np.int32))
+    indices.block_until_ready()
+    floor = measure_rpc_floor(indices)
+    print(f"rpc floor {floor:.3f}s", flush=True)
+
+    rng = np.random.default_rng(0)
+    seeds_all = jnp.asarray(
+        rng.integers(0, len(indptr_np) - 1, (24, B)).astype(np.int32)
+    )
+
+    def timed(fn, label, args):
+        t0 = time.time()
+        out = np.asarray(fn(*args, jax.random.key(5)))
+        compile_s = time.time() - t0
+        t0 = time.time()
+        out = np.asarray(fn(*args, jax.random.key(6)))
+        dt = max(time.time() - t0 - floor, 1e-9)
+        edges = int(out[0]) if out.shape else 0
+        per = dt * 1e3 / ITERS
+        seps = edges / dt if edges > 0 else 0
+        print(
+            f"{label:34s}: {per:7.2f} ms/iter"
+            + (f"  {seps/1e6:7.2f}M SEPS ({edges} edges)" if edges else "")
+            + f"  (compile+first {compile_s:.1f}s)",
+            flush=True,
+        )
+        return per
+
+    def scanned(sample_fn, caps):
+        @jax.jit
+        def run(ip, ix, seeds, key0):
+            def body(carry, i):
+                acc, tacc = carry
+                key = jax.random.fold_in(key0, i)
+                if isinstance(caps, str):  # "NOCAPS" static sentinel
+                    ds = sample_fn(ip, ix, key, seeds[i % 24], SIZES)
+                else:
+                    ds = sample_fn(ip, ix, key, seeds[i % 24], SIZES, caps)
+                edges = sum(a.mask.sum(dtype=jnp.int32) for a in ds.adjs)
+                touch = ds.n_id.sum(dtype=jnp.int32) + ds.count
+                for a in ds.adjs:
+                    if a.cols is not None:
+                        touch = touch + a.cols.sum(dtype=jnp.int32)
+                return (acc + edges, tacc + touch), None
+
+            (acc, touch), _ = lax.scan(
+                body, (jnp.int32(0), jnp.int32(0)),
+                jnp.arange(ITERS, dtype=jnp.int32),
+            )
+            return jnp.stack([acc, touch])
+
+        return run
+
+    timed(scanned(sample_dense_fused, "NOCAPS"), "fused (ref point)", (indptr, indices, seeds_all))
+    timed(scanned(sample_dense_pure, "NOCAPS"), "dedup uncapped (bench as-is)", (indptr, indices, seeds_all))
+    timed(scanned(sample_dense_pure, CAPS), "dedup capped", (indptr, indices, seeds_all))
+
+    # isolated hop-3-shaped reindex: W = 135168*6 = 811008
+    S3 = CAPS[1]
+    k3 = SIZES[2]
+
+    @jax.jit
+    def reindex_only(ip, ix, key0):
+        seeds = jnp.arange(S3, dtype=jnp.int32) % (ip.shape[0] - 1)
+        sv = jnp.ones((S3,), bool)
+
+        def body(acc, i):
+            key = jax.random.fold_in(key0, i)
+            nbrs = jax.random.randint(key, (S3, k3), 0, ip.shape[0] - 1, jnp.int32)
+            res = local_reindex(seeds, sv, nbrs, jnp.ones((S3, k3), bool))
+            return acc + res.count + res.n_id.sum(dtype=jnp.int32) + res.local_nbrs.sum(dtype=jnp.int32), None
+
+        acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(ITERS, dtype=jnp.int32))
+        return jnp.stack([acc * 0])
+
+    timed(reindex_only, "hop3-shape reindex only (811k)", (indptr, indices))
+
+    # isolated hop-3-shaped SAMPLING only (capped frontier width)
+    @jax.jit
+    def sample3_only(ip, ix, key0):
+        from quiver_tpu.ops.sample import sample_layer
+
+        def body(acc, i):
+            key = jax.random.fold_in(key0, i)
+            cur = jax.random.randint(key, (S3,), 0, ip.shape[0] - 1, jnp.int32)
+            nbrs, valid = sample_layer(ip, ix, cur, jnp.ones((S3,), bool), k3, key)
+            return acc + nbrs.sum(dtype=jnp.int32) + valid.sum(dtype=jnp.int32), None
+
+        acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(ITERS, dtype=jnp.int32))
+        return jnp.stack([acc * 0])
+
+    timed(sample3_only, "hop3-shape sampling only (135k,5)", (indptr, indices))
+
+    # random-nbr variant of full reindex cost at hop-2 shape
+    S2 = CAPS[0]
+    k2 = SIZES[1]
+
+    @jax.jit
+    def reindex2_only(ip, ix, key0):
+        seeds = jnp.arange(S2, dtype=jnp.int32) % (ip.shape[0] - 1)
+        sv = jnp.ones((S2,), bool)
+
+        def body(acc, i):
+            key = jax.random.fold_in(key0, i)
+            nbrs = jax.random.randint(key, (S2, k2), 0, ip.shape[0] - 1, jnp.int32)
+            res = local_reindex(seeds, sv, nbrs, jnp.ones((S2, k2), bool))
+            return acc + res.count + res.n_id.sum(dtype=jnp.int32), None
+
+        acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(ITERS, dtype=jnp.int32))
+        return jnp.stack([acc * 0])
+
+    timed(reindex2_only, "hop2-shape reindex only (180k)", (indptr, indices))
+
+
+if __name__ == "__main__":
+    main()

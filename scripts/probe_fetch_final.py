@@ -1,0 +1,164 @@
+"""Probe 6: final fetch formulation + honest isolated reindex/FY costs.
+
+probe_tiled_variants: k-split tiled 6.16 ms vs flat-elem-2D 9.40 ms at
+(135168, 5) — but rows-1didx (1-D index) hit 7.08, suggesting much of
+the win is INDEX SHAPE (1-D vs 2-D), not the tile table. If a 1-D-index
+element gather from the FLAT CSR matches, the sampler keeps its layout
+and just flattens its index — zero memory cost.
+
+Also re-measures the isolated reindex/FY costs with consumed outputs
+(probe_dedup_decomp zeroed its accumulator — DCE'd, the round-4 lesson,
+again).
+
+Run: python -u scripts/probe_fetch_final.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LANE = 128
+B = 135_168
+K = 5
+ITERS = 100
+
+
+def measure_rpc_floor(dev_x, n=6):
+    ts = []
+    for _ in range(n):
+        t0 = time.time()
+        float(jnp.sum(dev_x[:8]))
+        ts.append(time.time() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    from bench import build_graph
+    from quiver_tpu.ops.reindex import local_reindex
+    from quiver_tpu.ops.sample import fisher_yates_positions, row_windows
+
+    indptr_np, indices_np = build_graph()
+    E = len(indices_np)
+    M = E // LANE
+    indptr = jnp.asarray(indptr_np)
+    indices = jnp.asarray(indices_np.astype(np.int32))
+    tiles = indices[: M * LANE].reshape(M, LANE)
+    tiles.block_until_ready()
+    floor = measure_rpc_floor(tiles)
+    print(f"rpc floor {floor:.3f}s", flush=True)
+
+    def timed(run, args, label, iters=ITERS):
+        t0 = time.time()
+        out = int(np.asarray(run(*args, jax.random.key(5)))[0])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        out = int(np.asarray(run(*args, jax.random.key(6)))[0])
+        dt = max(time.time() - t0 - floor, 1e-9)
+        print(
+            f"{label:30s}: {dt*1e3/iters:7.2f} ms/iter  "
+            f"(compile+first {compile_s:.1f}s, chk {out & 0xffff})",
+            flush=True,
+        )
+
+    def scanned(body_fn, iters=ITERS):
+        @jax.jit
+        def run(ip, flat_tab, tab, key0):
+            def body(acc, i):
+                kk = jax.random.fold_in(key0, i)
+                return acc + body_fn(ip, flat_tab, tab, kk), None
+
+            acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(iters, dtype=jnp.int32))
+            return jnp.stack([acc])
+
+        return run
+
+    A = (indptr, indices, tiles)
+
+    def elem_2d(ip, flat_tab, tab, kk):
+        flat = jax.random.randint(kk, (B, K), 0, E, jnp.int32)
+        return jnp.take(flat_tab, flat).sum(dtype=jnp.int32)
+
+    def elem_1d(ip, flat_tab, tab, kk):
+        flat = jax.random.randint(kk, (B, K), 0, E, jnp.int32)
+        got = jnp.take(flat_tab, flat.reshape(-1)).reshape(B, K)
+        return got.sum(dtype=jnp.int32)
+
+    def elem_1dT(ip, flat_tab, tab, kk):
+        flat = jax.random.randint(kk, (B, K), 0, E, jnp.int32)
+        got = jnp.take(flat_tab, flat.T.reshape(-1)).reshape(K, B)
+        return got.sum(dtype=jnp.int32)
+
+    def ksplit_tiled(ip, flat_tab, tab, kk):
+        k1, k2 = jax.random.split(kk)
+        rows = jax.random.randint(k1, (B, K), 0, M, jnp.int32)
+        lanes = jax.random.randint(k2, (B, K), 0, LANE, jnp.int32)
+        acc = jnp.int32(0)
+        for j in range(K):
+            win = jnp.take(tab, rows[:, j], axis=0)
+            oh = lanes[:, j][:, None] == jnp.arange(LANE, dtype=jnp.int32)[None, :]
+            acc = acc + jnp.where(oh, win, 0).sum(dtype=jnp.int32)
+        return acc
+
+    timed(scanned(elem_2d), A, "elem 2D-idx (current)")
+    timed(scanned(elem_1d), A, "elem 1D-idx flat CSR")
+    timed(scanned(elem_1dT), A, "elem 1D-idx transposed")
+    timed(scanned(ksplit_tiled), A, "k-split tiled")
+
+    # deg-lookup + FY positions only (no neighbor fetch)
+    def fy_only(ip, flat_tab, tab, kk):
+        cur = jax.random.randint(kk, (B,), 0, ip.shape[0] - 1, jnp.int32)
+        ptr, deg = row_windows(ip, cur)
+        pos, valid = fisher_yates_positions(kk, deg, K)
+        return (
+            pos.sum(dtype=jnp.int32)
+            + valid.sum(dtype=jnp.int32)
+            + ptr.sum().astype(jnp.int32)
+        )
+
+    timed(scanned(fy_only), A, "deg-lookup + FY only")
+
+    # isolated reindex at hop-3 shape, outputs CONSUMED
+    S3, k3 = 135_168, 5
+    RITERS = 40
+
+    def reindex3(ip, flat_tab, tab, kk):
+        seeds = jax.random.randint(kk, (S3,), 0, ip.shape[0] - 1, jnp.int32)
+        nbrs = jax.random.randint(
+            jax.random.fold_in(kk, 1), (S3, k3), 0, ip.shape[0] - 1, jnp.int32
+        )
+        res = local_reindex(seeds, jnp.ones((S3,), bool), nbrs, jnp.ones((S3, k3), bool))
+        return (
+            res.count
+            + res.n_id.sum(dtype=jnp.int32)
+            + res.local_nbrs.sum(dtype=jnp.int32)
+            + res.local_seeds.sum(dtype=jnp.int32)
+        )
+
+    timed(scanned(reindex3, RITERS), A, "reindex hop3 (811k) consumed", iters=RITERS)
+
+    S2, k2 = 16_384, 10
+
+    def reindex2(ip, flat_tab, tab, kk):
+        seeds = jax.random.randint(kk, (S2,), 0, ip.shape[0] - 1, jnp.int32)
+        nbrs = jax.random.randint(
+            jax.random.fold_in(kk, 1), (S2, k2), 0, ip.shape[0] - 1, jnp.int32
+        )
+        res = local_reindex(seeds, jnp.ones((S2,), bool), nbrs, jnp.ones((S2, k2), bool))
+        return (
+            res.count
+            + res.n_id.sum(dtype=jnp.int32)
+            + res.local_nbrs.sum(dtype=jnp.int32)
+        )
+
+    timed(scanned(reindex2, RITERS), A, "reindex hop2 (180k) consumed", iters=RITERS)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""Probe 2: 2-D row-gather rate vs row width, + frontier degree profile.
+
+probe_window_gather showed vmap(dynamic_slice) windows lower to a
+catastrophic path at w>=8 (0.9M desc/s). But `row_windows`' [N, 2]
+pairing measurably halved degree-lookup cost, i.e. ROW gathers
+(jnp.take(table2d, ids, axis=0)) issue near the element-descriptor rate
+at small widths. If that holds to w=8..32, an L-aligned edge-block
+layout (each node's edges padded to L-lane rows) turns every deg<=L
+neighbor fetch into ONE row gather instead of k element gathers.
+
+Measures:
+  - row gather [B] rows from [M, L] int32 tables, L in {2,4,8,16,32,64,128}
+  - take_along_axis select [B, L] -> [B, K] cost at those widths
+  - degree profile of the bench graph: P(deg <= t) unweighted and
+    frontier-weighted (size-biased by deg — the fused pipeline's hop
+    frontier composition)
+
+Run: python -u scripts/probe_rowgather_width.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def measure_rpc_floor(dev_x, n=6):
+    ts = []
+    for _ in range(n):
+        t0 = time.time()
+        float(jnp.sum(dev_x[:8]))
+        ts.append(time.time() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    from bench import build_graph
+
+    indptr_np, indices_np = build_graph()
+    deg = np.diff(indptr_np)
+    E = len(indices_np)
+
+    print("== degree profile ==", flush=True)
+    w_deg = deg.astype(np.float64) / deg.sum()  # size-biased (frontier) weight
+    for t in (2, 4, 5, 8, 10, 15, 16, 32, 64, 128, 256):
+        p_plain = float((deg <= t).mean())
+        p_front = float(w_deg[deg <= t].sum())
+        print(f"deg<={t:4d}: plain {p_plain:6.3f}  frontier-weighted {p_front:6.3f}", flush=True)
+    print(f"max deg {deg.max()}, mean {deg.mean():.1f}, median {np.median(deg):.0f}", flush=True)
+
+    B = 180_224
+    K = 5
+    M = E // 128  # enough rows for any width below
+
+    table_full = jnp.asarray(indices_np[: M * 128].astype(np.int32)).reshape(M, 128)
+    table_full.block_until_ready()
+    floor = measure_rpc_floor(table_full)
+    print(f"rpc floor {floor:.3f}s", flush=True)
+
+    def timed(run, args, iters, label, desc_per_iter, elem_per_iter):
+        t0 = time.time()
+        out = int(np.asarray(run(*args, jax.random.key(3)))[0])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        out = int(np.asarray(run(*args, jax.random.key(4)))[0])
+        dt = max(time.time() - t0 - floor, 1e-9)
+        print(
+            f"{label:28s}: {dt*1e3/iters:8.2f} ms/iter  "
+            f"{desc_per_iter*iters/dt/1e6:8.1f}M rows/s  "
+            f"{elem_per_iter*iters/dt/1e6:8.1f}M elem/s  "
+            f"(compile+first {compile_s:.1f}s, chk {out & 0xffff})",
+            flush=True,
+        )
+
+    for L in (2, 4, 8, 16, 32, 64, 128):
+        iters = 200 if L <= 32 else 80
+        table = table_full[:, :L]
+
+        def make_row(L=L, iters=iters):
+            @jax.jit
+            def run(tab, key0):
+                def body(acc, i):
+                    key = jax.random.fold_in(key0, i)
+                    rows = jax.random.randint(key, (B,), 0, M, jnp.int32)
+                    got = jnp.take(tab, rows, axis=0)
+                    return acc + got.sum(dtype=jnp.int32), None
+
+                acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(iters, dtype=jnp.int32))
+                return jnp.stack([acc])
+
+            return run
+
+        timed(make_row(), (table,), iters, f"rowgather [B] from [M,{L}]", B, B * L)
+
+    # row gather + in-register lane select to [B, K]
+    for L in (8, 16, 32):
+        iters = 200
+        table = table_full[:, :L]
+
+        def make_rowsel(L=L, iters=iters):
+            @jax.jit
+            def run(tab, key0):
+                def body(acc, i):
+                    key = jax.random.fold_in(key0, i)
+                    k1, k2 = jax.random.split(key)
+                    rows = jax.random.randint(k1, (B,), 0, M, jnp.int32)
+                    pos = jax.random.randint(k2, (B, K), 0, L, jnp.int32)
+                    got = jnp.take(tab, rows, axis=0)
+                    sel = jnp.take_along_axis(got, pos, axis=1)
+                    return acc + sel.sum(dtype=jnp.int32), None
+
+                acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(iters, dtype=jnp.int32))
+                return jnp.stack([acc])
+
+            return run
+
+        timed(make_rowsel(), (table,), iters, f"rowgather+sel [M,{L}]->{K}", B, B * K)
+
+    # one-hot select alternative (matmul-ish, MXU-friendly) at L=16
+    L, iters = 16, 200
+    table = table_full[:, :L]
+
+    @jax.jit
+    def run_onehot(tab, key0):
+        def body(acc, i):
+            key = jax.random.fold_in(key0, i)
+            k1, k2 = jax.random.split(key)
+            rows = jax.random.randint(k1, (B,), 0, M, jnp.int32)
+            pos = jax.random.randint(k2, (B, K), 0, L, jnp.int32)
+            got = jnp.take(tab, rows, axis=0)  # [B, L]
+            oh = (pos[:, :, None] == jnp.arange(L, dtype=jnp.int32)[None, None, :])
+            sel = jnp.where(oh, got[:, None, :], 0).sum(axis=2)
+            return acc + sel.sum(dtype=jnp.int32), None
+
+        acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(iters, dtype=jnp.int32))
+        return jnp.stack([acc])
+
+    timed(run_onehot, (table,), iters, f"rowgather+onehot [M,16]->{K}", B, B * K)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Probe 5: why the tiled fetch ties at hop-3 shape — formulation variants.
+
+probe_tiled_sample: tiled ~= flat at (135168, 5) / (180224, 5) but wins
+at smaller shapes, with 77-266 s compile times — the [B, k] -> [B,k,128]
+3-D gather is not hitting the 145M rows/s path the 2-D [B] -> [B,128]
+gather measured. Variants at B=135168, k=5, real FY positions excluded
+(uniform random rows/lanes — fetch cost only):
+
+  flat-elem   : element gather [B,k] from flat indices  (current wall)
+  rows-3d     : take(tiles, rows[B,k], axis=0) -> [B,k,128], one-hot
+  rows-2d     : take(tiles, rows.reshape(B*k), axis=0) -> [Bk,128], one-hot
+  rows-2dT    : same but rows flattened TRANSPOSED (k-major), one-hot
+  k-split     : k separate [B] -> [B,128] gathers (the measured-fast shape)
+  fetch-only  : rows-2d without the select (isolate fetch vs select)
+  sel-dot     : rows-2d + one-hot select via bf16 dot_general hmm int32 —
+                via two 16-bit halves f32 dots
+
+Run: python -u scripts/probe_tiled_variants.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LANE = 128
+B = 135_168
+K = 5
+ITERS = 100
+
+
+def measure_rpc_floor(dev_x, n=6):
+    ts = []
+    for _ in range(n):
+        t0 = time.time()
+        float(jnp.sum(dev_x[:8]))
+        ts.append(time.time() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    from bench import build_graph
+
+    indptr_np, indices_np = build_graph()
+    E = len(indices_np)
+    M = E // LANE
+    indices = jnp.asarray(indices_np.astype(np.int32))
+    tiles = indices[: M * LANE].reshape(M, LANE)
+    tiles.block_until_ready()
+    floor = measure_rpc_floor(tiles)
+    print(f"rpc floor {floor:.3f}s", flush=True)
+
+    def timed(run, args, label):
+        t0 = time.time()
+        out = int(np.asarray(run(*args, jax.random.key(5)))[0])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        out = int(np.asarray(run(*args, jax.random.key(6)))[0])
+        dt = max(time.time() - t0 - floor, 1e-9)
+        print(
+            f"{label:22s}: {dt*1e3/ITERS:7.2f} ms/iter  "
+            f"(compile+first {compile_s:.1f}s, chk {out & 0xffff})",
+            flush=True,
+        )
+
+    def scanned(body_fn):
+        @jax.jit
+        def run(flat_tab, tab, key0):
+            def body(acc, i):
+                kk = jax.random.fold_in(key0, i)
+                return acc + body_fn(flat_tab, tab, kk), None
+
+            acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(ITERS, dtype=jnp.int32))
+            return jnp.stack([acc])
+
+        return run
+
+    def rand_rows(key):
+        return jax.random.randint(key, (B, K), 0, M, jnp.int32)
+
+    def rand_lanes(key):
+        return jax.random.randint(key, (B, K), 0, LANE, jnp.int32)
+
+    def onehot_sel(win_bkL, lane_bk):
+        oh = lane_bk[..., None] == jnp.arange(LANE, dtype=jnp.int32)
+        return jnp.where(oh, win_bkL, 0).sum(axis=-1)
+
+    # flat-elem baseline
+    def flat_elem(flat_tab, tab, kk):
+        flat = jax.random.randint(kk, (B, K), 0, E, jnp.int32)
+        got = jnp.take(flat_tab, flat)
+        return got.sum(dtype=jnp.int32)
+
+    timed(scanned(flat_elem), (indices, tiles), "flat-elem")
+
+    def rows3d(flat_tab, tab, kk):
+        k1, k2 = jax.random.split(kk)
+        win = jnp.take(tab, rand_rows(k1), axis=0)  # [B,K,L]
+        return onehot_sel(win, rand_lanes(k2)).sum(dtype=jnp.int32)
+
+    timed(scanned(rows3d), (indices, tiles), "rows-3d+onehot")
+
+    def rows2d(flat_tab, tab, kk):
+        k1, k2 = jax.random.split(kk)
+        win = jnp.take(tab, rand_rows(k1).reshape(-1), axis=0)  # [BK,L]
+        sel = onehot_sel(win, rand_lanes(k2).reshape(-1))
+        return sel.sum(dtype=jnp.int32)
+
+    timed(scanned(rows2d), (indices, tiles), "rows-2d+onehot")
+
+    def rows2dT(flat_tab, tab, kk):
+        k1, k2 = jax.random.split(kk)
+        win = jnp.take(tab, rand_rows(k1).T.reshape(-1), axis=0)
+        sel = onehot_sel(win, rand_lanes(k2).T.reshape(-1))
+        return sel.sum(dtype=jnp.int32)
+
+    timed(scanned(rows2dT), (indices, tiles), "rows-2dT+onehot")
+
+    def ksplit(flat_tab, tab, kk):
+        k1, k2 = jax.random.split(kk)
+        rows = rand_rows(k1)
+        lanes = rand_lanes(k2)
+        acc = jnp.int32(0)
+        for j in range(K):
+            win = jnp.take(tab, rows[:, j], axis=0)  # [B,L]
+            oh = lanes[:, j][:, None] == jnp.arange(LANE, dtype=jnp.int32)[None, :]
+            acc = acc + jnp.where(oh, win, 0).sum(dtype=jnp.int32)
+        return acc
+
+    timed(scanned(ksplit), (indices, tiles), "k-split+onehot")
+
+    def fetch_only(flat_tab, tab, kk):
+        k1, _ = jax.random.split(kk)
+        win = jnp.take(tab, rand_rows(k1).reshape(-1), axis=0)
+        return win.sum(dtype=jnp.int32)
+
+    timed(scanned(fetch_only), (indices, tiles), "rows-2d fetch-only")
+
+    def fetch_only_1d(flat_tab, tab, kk):
+        k1, _ = jax.random.split(kk)
+        rows = jax.random.randint(k1, (B * K,), 0, M, jnp.int32)
+        win = jnp.take(tab, rows, axis=0)
+        return win.sum(dtype=jnp.int32)
+
+    timed(scanned(fetch_only_1d), (indices, tiles), "rows-1didx fetch-only")
+
+
+if __name__ == "__main__":
+    main()

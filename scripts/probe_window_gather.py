@@ -1,0 +1,147 @@
+"""Probe: contiguous slice-window gathers from the flat CSR indices array.
+
+Question (NEXT.md round-4 idea 2a): the per-hop neighbor fetch is a
+[B, k] ELEMENT gather — ~1 descriptor per element at the measured
+~75-94M desc/s wall. A row's sampled positions all live in its edge
+window [ptr, ptr+deg); if a contiguous slice gather of width w issues at
+~1 descriptor per ROW (and stays descriptor-bound up to some width),
+fetching each row's first-w edges as ONE slice and selecting sampled
+lanes in-register would amplify the fetch rate by ~min(deg, k)x for all
+rows with deg <= w.
+
+Measures, with honest in-jit scan windows and floor correction:
+  - element-gather baseline: [B*k] one-element takes from indices
+  - slice-window gather:     [B, w] via vmap(dynamic_slice), w in
+                             {2, 4, 8, 16, 32, 64, 128}
+Reports descriptors/s and effective elems/s for each.
+
+Run: python -u scripts/probe_window_gather.py  (TPU, nothing concurrent)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def measure_rpc_floor(dev_x, n=6):
+    ts = []
+    for _ in range(n):
+        t0 = time.time()
+        float(jnp.sum(dev_x[:8]))
+        ts.append(time.time() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    sys.path.insert(0, "/root/repo")
+    from bench import build_graph
+
+    indptr_np, indices_np = build_graph()
+    E = len(indices_np)
+    print(f"graph: E={E}", flush=True)
+    indices = jnp.asarray(indices_np.astype(np.int32))
+    indices.block_until_ready()
+    floor = measure_rpc_floor(indices)
+    print(f"rpc floor {floor:.3f}s", flush=True)
+
+    B = 180_224  # hop-3 frontier width in the e2e shapes
+    K = 5
+
+    def timed(run, key, iters, label, desc_per_iter, elem_per_iter):
+        t0 = time.time()
+        out = int(np.asarray(run(indices, key, jnp.int32(iters)))[0])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        out = int(np.asarray(run(indices, jax.random.fold_in(key, 7), jnp.int32(iters)))[0])
+        dt = max(time.time() - t0 - floor, 1e-9)
+        desc_rate = desc_per_iter * iters / dt
+        elem_rate = elem_per_iter * iters / dt
+        print(
+            f"{label:24s}: {dt*1e3/iters:8.2f} ms/iter  "
+            f"{desc_rate/1e6:8.1f}M desc/s  {elem_rate/1e6:8.1f}M elem/s  "
+            f"(compile+first {compile_s:.1f}s, chk {out & 0xffff})",
+            flush=True,
+        )
+        return dt / iters
+
+    # --- element-gather baseline: B*K one-element takes -------------------
+    def make_elem(iters_static_n=None):
+        @jax.jit
+        def run(ix, key0, iters):
+            def body(acc, i):
+                key = jax.random.fold_in(key0, i)
+                flat = jax.random.randint(key, (B, K), 0, E, jnp.int32)
+                got = jnp.take(ix, flat)
+                return acc + got.sum(dtype=jnp.int32), None
+
+            acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(200, dtype=jnp.int32))
+            return jnp.stack([acc])
+
+        return run
+
+    timed(make_elem(), jax.random.key(0), 200, f"element [B,{K}]", B * K, B * K)
+
+    # --- slice-window gathers --------------------------------------------
+    for w in (2, 4, 8, 16, 32, 64, 128):
+        iters = 200 if w <= 32 else 60
+
+        def make_win(w=w, iters=iters):
+            @jax.jit
+            def run(ix, key0, _):
+                def body(acc, i):
+                    key = jax.random.fold_in(key0, i)
+                    starts = jax.random.randint(key, (B,), 0, E - w, jnp.int32)
+                    win = jax.vmap(
+                        lambda p: lax.dynamic_slice(ix, (p,), (w,))
+                    )(starts)
+                    return acc + win.sum(dtype=jnp.int32), None
+
+                acc, _ = lax.scan(
+                    body, jnp.int32(0), jnp.arange(iters, dtype=jnp.int32)
+                )
+                return jnp.stack([acc])
+
+            return run
+
+        timed(make_win(), jax.random.key(1), iters, f"window [B,{w}]", B, B * w)
+
+    # --- window + in-register lane select (the real candidate op) --------
+    for w in (16, 32, 64):
+        iters = 150
+
+        def make_winsel(w=w, iters=iters):
+            @jax.jit
+            def run(ix, key0, _):
+                def body(acc, i):
+                    key = jax.random.fold_in(key0, i)
+                    k1, k2 = jax.random.split(key)
+                    starts = jax.random.randint(k1, (B,), 0, E - w, jnp.int32)
+                    pos = jax.random.randint(k2, (B, K), 0, w, jnp.int32)
+                    win = jax.vmap(
+                        lambda p: lax.dynamic_slice(ix, (p,), (w,))
+                    )(starts)
+                    got = jnp.take_along_axis(win, pos, axis=1)
+                    return acc + got.sum(dtype=jnp.int32), None
+
+                acc, _ = lax.scan(
+                    body, jnp.int32(0), jnp.arange(iters, dtype=jnp.int32)
+                )
+                return jnp.stack([acc])
+
+            return run
+
+        timed(
+            make_winsel(), jax.random.key(2), iters,
+            f"window+select [B,{w}]->{K}", B, B * K,
+        )
+
+
+if __name__ == "__main__":
+    main()

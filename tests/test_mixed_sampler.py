@@ -245,6 +245,16 @@ def test_weighted_mixed_max_deg_guard(graph):
             job, topo, sizes=[4], num_workers=1, mode="TPU_CPU_MIXED",
             weighted=True, max_deg=max_deg_graph - 1,
         )
+    # HOST_CPU_MIXED is exempt: its "device" half is the host native
+    # engine, which (like the CPU workers) weights ALL edges — no window
+    from quiver_tpu.ops.cpu_kernels import native_available
+
+    if native_available():
+        sh = MixedGraphSageSampler(
+            job, topo, sizes=[4], num_workers=1, mode="HOST_CPU_MIXED",
+            weighted=True, max_deg=max_deg_graph - 1,
+        )
+        sh.shutdown()
     # with no CPU half there is no second distribution: num_workers=0
     # stays device-only and must NOT be rejected
     s = MixedGraphSageSampler(

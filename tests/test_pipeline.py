@@ -136,6 +136,43 @@ def test_train_pipeline_learns_and_prefetches():
     assert tp.stats.cold_rows > 0
     # the community task is easy: loss should drop across the epoch
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
+    # span instrumentation: every stage recorded once per batch (async
+    # mode records the step dispatch, not execution)
+    stages = {s for s, _, _ in tp.stats.spans}
+    assert stages == {"sample", "gather", "upload", "step_dispatch"}
+    summary = tp.stats.overlap_summary()
+    assert 0.0 <= summary["overlap_frac"] <= 1.0
+    assert 0.0 <= summary["hidden_frac_measured"] <= 0.75  # <= (S-1)/S
+
+
+def test_overlap_summary_math():
+    """overlap_summary on hand-built spans: two fully-stacked stages ->
+    overlap 1.0, hidden 0.5; fully serial -> overlap 0, hidden 0."""
+    from quiver_tpu.pipeline import PipelineStats
+
+    st = PipelineStats()
+    st.record("a", 0.0, 1.0)
+    st.record("b", 0.0, 1.0)
+    s = st.overlap_summary()
+    assert s["overlap_frac"] == 1.0 and s["hidden_frac_measured"] == 0.5
+    assert s["busy_s"] == {"a": 1.0, "b": 1.0}
+
+    st2 = PipelineStats()
+    st2.record("a", 0.0, 1.0)
+    st2.record("b", 1.0, 2.0)
+    s2 = st2.overlap_summary()
+    assert s2["overlap_frac"] == 0.0 and s2["hidden_frac_measured"] == 0.0
+
+    # partial: a=[0,2), b=[1,3): covered 3, multi 1, busy 4 -> hidden 1/4
+    st3 = PipelineStats()
+    st3.record("a", 0.0, 2.0)
+    st3.record("b", 1.0, 3.0)
+    s3 = st3.overlap_summary()
+    assert abs(s3["overlap_frac"] - 1 / 3) < 1e-3
+    assert abs(s3["hidden_frac_measured"] - 0.25) < 1e-3
+
+    # measure_overlap=True spans would carry "step"; empty stats -> {}
+    assert PipelineStats().overlap_summary() == {}
 
 
 def test_train_pipeline_checkpoint_and_resume(tmp_path):
