@@ -115,22 +115,34 @@ def sharded_gather_grouped(
 def sharded_gather_a2a(
     table_block: jax.Array, ids: jax.Array, axis_name: str, axis_size: int
 ) -> jax.Array:
-    """All-to-all variant: each chip requests only its own ``ids`` (sharded
-    over the axis) instead of replicating requests.
+    """Per-chip-request gather: each chip requests only its own ``ids``
+    (sharded over the axis) and receives only its own rows.
 
     ids: [B_local] this chip's request list (global ids).
     Returns [B_local, D]: rows for this chip's ids.
 
-    Pattern = the reference's id/feature exchange (comm.py:127-182) collapsed
-    into two XLA collectives: all_gather the request lists, local gather,
-    then psum_scatter... here implemented as all_gather + masked gather +
-    all_to_all return trip for bandwidth-balanced assembly.
+    This is exactly `sharded_gather_grouped(via="scatter")` specialized to
+    one axis that is both the striping and the group axis, so it DELEGATES
+    there (one return-trip implementation; the reference's id/feature
+    exchange pattern, comm.py:127-182, collapsed into two XLA collectives).
+
+    When to use which (measured compiled-HLO payloads at W=512, D=32,
+    P=8 — scripts/compare_grouped_return.py a2a section + SCALING.md
+    round-5 table): with a SHARDED consumer, a2a moves 10240 B/chip
+    (2048 request all-gather + 8192 reduce-scatter) vs the
+    replicated-request `sharded_gather`'s 65536 B all-reduce — 6.4x
+    cheaper. But if the consumer needs the FULL row set (every train step
+    in this library does: the model eats all of x), the re-assembly
+    all_gather brings it to 75776 B — WORSE than the all-reduce — so the
+    train steps stay on `sharded_gather`/`sharded_gather_grouped`. a2a is
+    the right spelling only when downstream consumption is sharded over
+    the same axis (e.g. an embedding-table exchange feeding per-chip
+    partitions).
     """
-    # [P, B_local] all chips' requests (int64 preserved for >2^31-row tables)
-    all_ids = lax.all_gather(ids, axis_name)
-    rows = _partial_rows(table_block, all_ids, (axis_name,))  # [P, B, D]
-    # return trip: chip p needs slice [p] summed over owners
-    return lax.psum_scatter(rows, axis_name, scatter_dimension=0, tiled=False)
+    return sharded_gather_grouped(
+        table_block, ids, feat_axes=axis_name, group_axis=axis_name,
+        via="scatter",
+    )
 
 
 def sharded_gather_hot_cold(

@@ -6,16 +6,74 @@ collective exchange. Proves the multi-process path (per-process shards via
 jax.make_array_from_process_local_data) without a real pod — the reference
 could only test its NcclComm against live LAN IPs (test_comm.py:9-11).
 
-usage: python dist_worker.py <process_id> <coordinator_port>
+usage: python dist_worker.py <process_id> <coordinator_port> [mode]
+
+mode "exchange" (default): TpuComm exchange + DistFeature lookups.
+mode "train": ONE `make_sharded_train_step` step on the process-spanning
+(dp=1, ici=2) mesh — the loss is printed so the parent test can assert it
+matches a single-controller run of the identical step (same keys, same
+mesh shape, same arithmetic; only the process layout differs).
 """
 
 import os
 import sys
 
 
+def train_main(pid: int, port: str) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2 and jax.device_count() == 2
+
+    import numpy as np
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))  # repo root (quiver_tpu, entry)
+    sys.path.insert(0, here)  # tests dir (sharded_train_case)
+    from sharded_train_case import CASE_SEEDS, build_case
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    case = build_case()
+    mesh = case["make_mesh"]()
+
+    def gput(x, spec):
+        """Global array from identical per-process host data — the
+        multi-controller placement primitive (device_put with a
+        process-spanning sharding is version-sensitive; the callback form
+        is not)."""
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    step = case["make_step"](mesh)
+    params = jax.tree_util.tree_map(lambda a: gput(a, P()), case["params_np"])
+    opt_state = jax.tree_util.tree_map(lambda a: gput(a, P()), case["opt_np"])
+    args = (
+        params, opt_state, jax.random.key(2),
+        gput(case["indptr"], P()), gput(case["indices"], P()),
+        gput(case["feat_padded"], P(("ici",), None)),
+        gput(case["labels"], P()),
+        gput(CASE_SEEDS, P("dp")),
+    )
+    _, _, loss = step(*args)
+    print(f"worker {pid} loss {float(loss):.8f}", flush=True)
+    print(f"worker {pid} OK", flush=True)
+
+
 def main() -> None:
     pid = int(sys.argv[1])
     port = sys.argv[2]
+    if len(sys.argv) > 3 and sys.argv[3] == "train":
+        train_main(pid, port)
+        return
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.setdefault("XLA_FLAGS", "")
 

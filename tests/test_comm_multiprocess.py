@@ -22,7 +22,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_exchange():
+def _run_workers(mode=None):
     port = _free_port()
     env = dict(os.environ)
     # each worker must boot its own jax: drop the parent suite's virtual
@@ -30,9 +30,10 @@ def test_two_process_exchange():
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("JAX_NUM_CPU_DEVICES", "1")
+    argv_tail = [mode] if mode else []
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(pid), str(port)],
+            [sys.executable, WORKER, str(pid), str(port), *argv_tail],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -52,3 +53,46 @@ def test_two_process_exchange():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"worker {pid} OK" in out, out
+    return outs
+
+
+def test_two_process_exchange():
+    _run_workers()
+
+
+def test_two_process_sharded_train_step_matches_single_controller():
+    """One `make_sharded_train_step` step on a PROCESS-SPANNING (dp=1,
+    ici=2) mesh (two OS processes, one device each, jax.distributed) must
+    produce the same loss as the identical step on a single-controller
+    2-device mesh — same case, params, and keys (tests/sharded_train_case
+    is the single source of both)."""
+    from sharded_train_case import CASE_SEEDS, build_case
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    case = build_case()
+    mesh = case["make_mesh"]()  # first 2 of the suite's virtual devices
+    step = case["make_step"](mesh)
+
+    def put(x, spec=P()):
+        return jax.device_put(jax.numpy.asarray(x), NamedSharding(mesh, spec))
+
+    params = jax.tree_util.tree_map(put, case["params_np"])
+    opt_state = jax.tree_util.tree_map(put, case["opt_np"])
+    _, _, loss = step(
+        params, opt_state, jax.random.key(2),
+        put(case["indptr"]), put(case["indices"]),
+        put(case["feat_padded"], P(("ici",), None)),
+        put(case["labels"]), put(CASE_SEEDS, P("dp")),
+    )
+    expect = float(loss)
+    assert np.isfinite(expect)
+
+    outs = _run_workers(mode="train")
+    for pid, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith(f"worker {pid} loss")]
+        assert line, out
+        got = float(line[0].split()[-1])
+        assert abs(got - expect) < 1e-5, (got, expect, out)
