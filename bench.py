@@ -453,6 +453,7 @@ def bench_e2e(context, bd, tiles, seeds_all, table, iters=None, classes=47, caps
 
         return epoch
 
+    fused_probe = None
     for name, sample_fn, sample_caps in (
         ("fused", sample_and_gather_fused, None),
         ("dedup", sample_and_gather_dedup, caps),
@@ -509,7 +510,14 @@ def bench_e2e(context, bd, tiles, seeds_all, table, iters=None, classes=47, caps
             # unique nodes dropped by the static caps across the timed run:
             # 0 means the tight margin cost nothing semantically
             context["e2e_dedup_cap_overflow"] = overflow
-        if name == "fused" and remaining() > 90:
+        if name == "fused":
+            # keep the fused leg's pieces for the compute-share probe,
+            # which runs AFTER both legs (the dedup headline outranks it
+            # when the budget is tight)
+            fused_probe = (params, opt_state, x0, ds_real.adjs, step_s)
+    if fused_probe is not None and remaining() > 90:
+        params, opt_state, x0, adjs0, step_s = fused_probe
+        if True:
             # compute share: a model-only epoch (fwd/bwd + adam on fixed
             # sampled inputs, same scan length) against the full step.
             # x is perturbed per iteration so XLA cannot hoist the
@@ -541,7 +549,7 @@ def bench_e2e(context, bd, tiles, seeds_all, table, iters=None, classes=47, caps
                 return losses
 
             margs = (
-                params, opt_state, x0, ds_real.adjs, labels,
+                params, opt_state, x0, adjs0, labels,
                 jnp.asarray(seeds_all[0]),
             )
             t0 = time.time()
@@ -641,6 +649,7 @@ def bench_tiered_pipeline(
     seq_s = stage_s + step_s
 
     pipe_s = {}
+    stats_by_depth = {}
     for depth in (1, 2):
         # timed epochs run UNINSTRUMENTED: measure_overlap syncs each
         # step's loss (one ~0.1 s D2H per step on this tunnel) inside the
@@ -651,11 +660,16 @@ def bench_tiered_pipeline(
             seed_batches, params, opt_state, jax.random.key(4)
         )
         pipe_s[depth] = time.time() - t0
+        stats_by_depth[depth] = tp_d.stats
     best = min(pipe_s.values())
     best_depth = min(pipe_s, key=pipe_s.get)
-    # separate instrumented epoch for the MEASURED overlap evidence (its
-    # per-step syncs stay outside every timed window above)
-    ov = {}
+    # MEASURED overlap evidence. Preferred: a separate instrumented epoch
+    # whose "step" spans cover device execution (its per-step syncs stay
+    # outside every timed window above). Fallback when the budget is
+    # gone: the uninstrumented runs' spans — the three HOST stages are
+    # fully measured there, only the step span is dispatch-only.
+    step_spans = "dispatch-only"
+    ov = stats_by_depth[best_depth].overlap_summary()
     if remaining() > 60:
         tp_m = TrainPipeline(
             sampler, feat, step_fn, depth=best_depth, tiered=pipe,
@@ -665,8 +679,10 @@ def bench_tiered_pipeline(
             seed_batches, params, opt_state, jax.random.key(5)
         )
         ov = tp_m.stats.overlap_summary()
+        step_spans = "execution"
     else:
-        log("budget exhausted before instrumented overlap epoch")
+        log("budget exhausted before instrumented overlap epoch; "
+            "reporting host-stage spans from the timed runs")
     w = int(b0.mapped.shape[0])
     gbps_pipe = batches * w * dim * 4 / best / 1e9
     # the floor the LINK imposes: the cold bytes must cross the tunnel no
@@ -704,14 +720,16 @@ def bench_tiered_pipeline(
     # another stage (0 = serial; 0.75 = four stages perfectly stacked)
     if ov:
         log(
-            f"tiered pipeline measured overlap (depth {best_depth}): "
-            f">=2 stages active {ov['overlap_frac']:.0%} of wall; "
+            f"tiered pipeline measured overlap (depth {best_depth}, step "
+            f"spans {step_spans}): >=2 stages active "
+            f"{ov['overlap_frac']:.0%} of wall; "
             f"{ov['hidden_frac_measured']:.0%} of stage busy-time hidden; "
             f"busy {ov['busy_s']}"
         )
         context["tiered_overlap_measured"] = ov["overlap_frac"]
         context["tiered_hidden_frac_measured"] = ov["hidden_frac_measured"]
         context["tiered_stage_busy_s"] = ov["busy_s"]
+        context["tiered_overlap_step_spans"] = step_spans
 
 
 def wait_for_backend(max_wait_s=None):
@@ -843,6 +861,20 @@ def main():
     table = jax.jit(
         lambda k: jax.random.normal(k, (n_nodes, dim), jnp.float32)
     )(jax.random.key(7))
+    # e2e runs FIRST after the SEPS legs: its two epoch numbers are
+    # headline metrics, and a slow-tunnel day (graph H2D alone has hit
+    # 100 s) must starve the auxiliary sections, not these
+    try:
+        if remaining() > 120:
+            context["e2e_epoch_distinct_seeds"] = int(PRODUCTS_TRAIN_NODES)
+            context["e2e_epoch_pad_seeds"] = int(
+                steps_per_epoch * batch - PRODUCTS_TRAIN_NODES
+            )
+            bench_e2e(context, bd, tiles, seeds_epoch, table, caps=caps)
+        else:
+            log("budget exhausted before e2e bench")
+    except Exception as exc:
+        log(f"e2e bench failed: {exc}")
     try:
         if remaining() > 60:
             bench_feature(context, table)
@@ -860,17 +892,6 @@ def main():
             log("budget exhausted before host sampler bench")
     except Exception as exc:
         log(f"host sampler bench failed: {exc}")
-    try:
-        if remaining() > 120:
-            context["e2e_epoch_distinct_seeds"] = int(PRODUCTS_TRAIN_NODES)
-            context["e2e_epoch_pad_seeds"] = int(
-                steps_per_epoch * batch - PRODUCTS_TRAIN_NODES
-            )
-            bench_e2e(context, bd, tiles, seeds_epoch, table, caps=caps)
-        else:
-            log("budget exhausted before e2e bench")
-    except Exception as exc:
-        log(f"e2e bench failed: {exc}")
     try:
         if remaining() > 150:
             bench_tiered_pipeline(context, indptr_np, indices_np, caps)

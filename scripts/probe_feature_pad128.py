@@ -1,0 +1,83 @@
+"""Probe 7: does the 128-lane gather cliff apply to f32 FEATURE rows?
+
+probe_rowgather_width found int32 row gathers jump from 28M rows/s
+(L=32) to 145M rows/s (L=128) — the native-lane tile width. The feature
+table is [N, 100] f32 (~94M rows/s, r4 correction). If a [N, 128]-padded
+f32 table gathers at the L=128 rate, the e2e feature fetch (~1.1M rows/
+step) gets ~1.5x faster for 28% more HBM; the model then consumes
+x[:, :100] (one cheap contiguous slice).
+
+Measures [B]-row gathers from [N, D] f32 at D in {100, 112, 120, 128},
+plus gather+slice-to-100 at D=128.
+
+Run: python -u scripts/probe_feature_pad128.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+N = 2_449_029
+B = 262_144
+ITERS = 120
+
+
+def main():
+    table128 = jax.jit(
+        lambda k: jax.random.normal(k, (N, 128), jnp.float32)
+    )(jax.random.key(7))
+    table128.block_until_ready()
+    ts = []
+    for _ in range(6):
+        t0 = time.time()
+        float(jnp.sum(table128[0, :8]))
+        ts.append(time.time() - t0)
+    floor = float(np.median(ts))
+    print(f"rpc floor {floor:.3f}s", flush=True)
+
+    def timed(run, args, label, rows_per_iter=B):
+        t0 = time.time()
+        out = float(np.asarray(run(*args, jax.random.key(5)))[0])
+        compile_s = time.time() - t0
+        t0 = time.time()
+        out = float(np.asarray(run(*args, jax.random.key(6)))[0])
+        dt = max(time.time() - t0 - floor, 1e-9)
+        rate = rows_per_iter * ITERS / dt
+        print(
+            f"{label:28s}: {dt*1e3/ITERS:7.2f} ms/iter  {rate/1e6:7.1f}M rows/s  "
+            f"(compile+first {compile_s:.1f}s)",
+            flush=True,
+        )
+
+    def make(D, slice_to=None):
+        @jax.jit
+        def run(tab, key0):
+            t = tab[:, :D]
+
+            def body(acc, i):
+                kk = jax.random.fold_in(key0, i)
+                ids = jax.random.randint(kk, (B,), 0, N, jnp.int32)
+                got = jnp.take(t, ids, axis=0)
+                if slice_to is not None:
+                    got = got[:, :slice_to]
+                return acc + got.sum(dtype=jnp.float32), None
+
+            acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(ITERS, dtype=jnp.int32))
+            return jnp.stack([acc])
+
+        return run
+
+    for D in (100, 112, 120, 127, 128):
+        timed(make(D), (table128,), f"gather [N,{D}] f32")
+    timed(make(128, slice_to=100), (table128,), "gather [N,128] -> [:,:100]")
+
+
+if __name__ == "__main__":
+    main()
