@@ -517,55 +517,54 @@ def bench_e2e(context, bd, tiles, seeds_all, table, iters=None, classes=47, caps
             fused_probe = (params, opt_state, x0, ds_real.adjs, step_s)
     if fused_probe is not None and remaining() > 90:
         params, opt_state, x0, adjs0, step_s = fused_probe
-        if True:
-            # compute share: a model-only epoch (fwd/bwd + adam on fixed
-            # sampled inputs, same scan length) against the full step.
-            # x is perturbed per iteration so XLA cannot hoist the
-            # params-independent aggregation means out of the scan.
-            @jax.jit
-            def model_epoch(params, opt_state, x, adjs, lab, seeds0, key0):
-                y = jnp.take(lab, jnp.clip(seeds0, 0, lab.shape[0] - 1))
+        # compute share: a model-only epoch (fwd/bwd + adam on fixed
+        # sampled inputs, same scan length) against the full step.
+        # x is perturbed per iteration so XLA cannot hoist the
+        # params-independent aggregation means out of the scan.
+        @jax.jit
+        def model_epoch(params, opt_state, x, adjs, lab, seeds0, key0):
+            y = jnp.take(lab, jnp.clip(seeds0, 0, lab.shape[0] - 1))
 
-                def body(carry, i):
-                    p, o = carry
-                    key = jax.random.fold_in(key0, i)
-                    xx = x + (i.astype(x.dtype) * 1e-9)
+            def body(carry, i):
+                p, o = carry
+                key = jax.random.fold_in(key0, i)
+                xx = x + (i.astype(x.dtype) * 1e-9)
 
-                    def objective(pp):
-                        logits = model.apply(
-                            pp, xx, adjs, train=True, rngs={"dropout": key}
-                        )
-                        ll = jax.nn.log_softmax(logits)
-                        return -jnp.take_along_axis(ll, y[:, None], axis=1).mean()
+                def objective(pp):
+                    logits = model.apply(
+                        pp, xx, adjs, train=True, rngs={"dropout": key}
+                    )
+                    ll = jax.nn.log_softmax(logits)
+                    return -jnp.take_along_axis(ll, y[:, None], axis=1).mean()
 
-                    loss, grads = jax.value_and_grad(objective)(p)
-                    updates, o = tx.update(grads, o, p)
-                    p = optax.apply_updates(p, updates)
-                    return (p, o), loss
+                loss, grads = jax.value_and_grad(objective)(p)
+                updates, o = tx.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                return (p, o), loss
 
-                (_, _), losses = lax.scan(
-                    body, (params, opt_state), jnp.arange(iters, dtype=jnp.int32)
-                )
-                return losses
-
-            margs = (
-                params, opt_state, x0, adjs0, labels,
-                jnp.asarray(seeds_all[0]),
+            (_, _), losses = lax.scan(
+                body, (params, opt_state), jnp.arange(iters, dtype=jnp.int32)
             )
-            t0 = time.time()
-            float(model_epoch(*margs, jax.random.key(9))[-1])
-            mc = time.time() - t0
-            t0 = time.time()
-            float(model_epoch(*margs, jax.random.key(10))[-1])
-            dt2 = max(time.time() - t0 - _RPC_FLOOR_S, 1e-9)
-            compute_ms = dt2 * 1e3 / iters
-            context["e2e_compute_ms_per_step"] = round(compute_ms, 2)
-            context["e2e_compute_frac"] = round(compute_ms / (step_s * 1e3), 3)
-            log(
-                f"e2e compute share: model-only {compute_ms:.1f} ms of "
-                f"{step_s*1e3:.1f} ms/step = {compute_ms/(step_s*1e3):.0%} "
-                f"(compile {mc:.1f}s)"
-            )
+            return losses
+
+        margs = (
+            params, opt_state, x0, adjs0, labels,
+            jnp.asarray(seeds_all[0]),
+        )
+        t0 = time.time()
+        float(model_epoch(*margs, jax.random.key(9))[-1])
+        mc = time.time() - t0
+        t0 = time.time()
+        float(model_epoch(*margs, jax.random.key(10))[-1])
+        dt2 = max(time.time() - t0 - _RPC_FLOOR_S, 1e-9)
+        compute_ms = dt2 * 1e3 / iters
+        context["e2e_compute_ms_per_step"] = round(compute_ms, 2)
+        context["e2e_compute_frac"] = round(compute_ms / (step_s * 1e3), 3)
+        log(
+            f"e2e compute share: model-only {compute_ms:.1f} ms of "
+            f"{step_s*1e3:.1f} ms/step = {compute_ms/(step_s*1e3):.0%} "
+            f"(compile {mc:.1f}s)"
+        )
 
 
 def bench_tiered_pipeline(

@@ -28,11 +28,13 @@ TPU cost/compile model (measured on v5e):
   key-sort standing in for the inverse permutation (never scatter);
 - XLA's TPU compile time for million-element 1-D sort/cumsum/scan ops is
   pathological (~12-60 s EACH), while 2-D row ops compile in ~1 s and
-  identical sort signatures compile once per shape. So every sort below
-  uses the same (int32 x3, num_keys=1, stable) signature, and all running
+  identical sort signatures compile once per shape. The first sort uses
+  a (key + 2 payloads) signature (it needs both origin position and seed
+  slot); the second and third use a slimmer (key + 1 payload) signature —
+  1/3 less data movement per pass, one extra cached compile. All running
   sums/scans are blocked into [rows, 1024] two-level form.
 
-Per hop: three same-signature sorts + blocked cumsums + elementwise work,
+Per hop: three sorts (two signatures) + blocked cumsums + elementwise work,
 O(W log W) with tiny constants, fully static shapes, jittable.
 """
 
@@ -51,9 +53,17 @@ _BLOCK = 1024
 
 
 def _sort3(key: jax.Array, a: jax.Array, b: jax.Array):
-    """Stable sort by ``key`` carrying two payloads. Every call site uses
-    this one signature so XLA compiles the sort network once per shape."""
+    """Stable sort by ``key`` carrying two payloads (the first pass, which
+    genuinely needs both origin position and seed slot)."""
     return lax.sort((key, a, b), num_keys=1, is_stable=True)
+
+
+def _sort2(key: jax.Array, a: jax.Array):
+    """Stable sort by ``key`` carrying ONE payload — the second and third
+    reindex passes need only one, and the slimmer tuple moves 1/3 less
+    data per pass (measured 6.7 -> 5.8 ms on the 811k hop-3 reindex;
+    the extra compiled sort signature is a one-time cache entry)."""
+    return lax.sort((key, a), num_keys=1, is_stable=True)
 
 
 def _blocked(x: jax.Array, fill) -> Tuple[jax.Array, int]:
@@ -169,7 +179,7 @@ def local_reindex(
 
     # back to input order: sort by original position (the inverse
     # permutation as a key-sort — scatters are ~15x a sort on TPU)
-    _, local_all, _ = _sort3(order, canonical, canonical)
+    _, local_all = _sort2(order, canonical)
     # n_id: sort values by output slot (valid seeds -> their slot, new
     # uniques -> their rank slot, everything else -> past the end)
     outkey = jnp.where(
@@ -178,7 +188,7 @@ def local_reindex(
         jnp.where(new_unique, n_seed + rank, W),
     )
     outval = jnp.where(outkey < W, sv, sentinel)
-    _, n_id, _ = _sort3(outkey, outval, outval)
+    _, n_id = _sort2(outkey, outval)
 
     count = n_seed + new_unique.sum().astype(jnp.int32)
     return ReindexResult(

@@ -156,10 +156,12 @@ def gumbel_topk_positions(
         jnp.log(jnp.maximum(w, 1e-30)) + g,
         -jnp.inf,
     )
-    _, pos = lax.top_k(scores, k)
+    vals, pos = lax.top_k(scores, k)
     n_valid = jnp.minimum(deg, k)
-    # zero-weight candidates are never valid draws; count only finite scores
-    finite = jnp.take_along_axis(scores, pos, axis=1) > -jnp.inf
+    # zero-weight candidates are never valid draws; count only finite
+    # scores — read off top_k's OWN values (a take_along_axis here would
+    # lower to a B*k-descriptor gather; the values are already in hand)
+    finite = vals > -jnp.inf
     valid = (jnp.arange(k, dtype=jnp.int32)[None, :] < n_valid[:, None]) & finite
     return pos.astype(jnp.int32), valid
 
@@ -196,6 +198,71 @@ def weighted_sample_layer(
     flat = jnp.take_along_axis(lanes, pos.astype(ptr.dtype), axis=1)
     nbrs = jnp.take(indices, flat)
     return nbrs, valid
+
+
+def _tiled_bd_lookup(bd, seeds, seed_valid):
+    """(base, deg) rows for clipped seeds; deg zeroed where invalid."""
+    n = bd.shape[0]
+    s = jnp.clip(seeds, 0, n - 1).astype(jnp.int32)
+    both = jnp.take(bd, s, axis=0)
+    return both[:, 0], jnp.where(seed_valid, both[:, 1], 0)
+
+
+def _tiled_resolve(tiles, base, pos, k):
+    """Resolve drawn positions to neighbor ids through the tile table:
+    k-split row gathers + one-hot lane selects (k separate [B]-row
+    gathers measured faster than one [B*k]: probe_tiled_variants 6.2 vs
+    7.1 ms; one-hot instead of take_along_axis — the descriptor trap,
+    probe_fetch_final). Shared by the uniform and weighted tiled layers
+    so the fetch pattern is tuned in ONE place."""
+    rows = base[:, None] + lax.shift_right_logical(pos, LANE.bit_length() - 1)
+    rows = jnp.clip(rows, 0, tiles.shape[0] - 1)
+    lane = jnp.bitwise_and(pos, LANE - 1)
+    ar = jnp.arange(LANE, dtype=jnp.int32)
+    cols = []
+    for j in range(k):
+        win = jnp.take(tiles, rows[:, j], axis=0)
+        oh = lane[:, j][:, None] == ar[None, :]
+        cols.append(jnp.where(oh, win, 0).sum(axis=1))
+    return jnp.stack(cols, axis=1).astype(tiles.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "max_deg"))
+def tiled_weighted_sample_layer(
+    bd: jax.Array,
+    tiles: jax.Array,
+    wtiles: jax.Array,
+    seeds: jax.Array,
+    seed_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+    max_deg: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """Weighted one-hop sample over the tile layout.
+
+    ``wtiles`` is the weights array laid out with the SAME tile map as
+    ``tiles`` (`build_tiled_host(indptr, weights, np.float32)`), so each
+    row's first ``ceil(max_deg/128)`` weight tiles arrive as row gathers
+    — ~128x fewer descriptors than the flat path's [B, max_deg] lane
+    window — and chosen positions resolve like `tiled_sample_layer`.
+    Draw-identical to :func:`weighted_sample_layer` on the same key when
+    ``max_deg`` is a multiple of 128 (same [B, max_deg] Gumbel shape,
+    same scores, same top-k). Same truncation semantics: each row
+    considers its first ``min(deg, max_deg)`` edges.
+    """
+    base, deg = _tiled_bd_lookup(bd, seeds, seed_valid)
+    deg = jnp.minimum(deg, max_deg)
+    T = -(-max_deg // LANE)
+    m_rows = tiles.shape[0]
+    # weight window: T per-row tile fetches (k-split style — a [B, T]
+    # 3-D gather compiles pathologically, see _tiled_resolve)
+    parts = []
+    for t in range(T):
+        tr = jnp.clip(base + t, 0, m_rows - 1)
+        parts.append(jnp.take(wtiles, tr, axis=0))
+    w_rows = jnp.concatenate(parts, axis=1)  # [B, T*128] >= max_deg
+    pos, valid = gumbel_topk_positions(key, deg, k, w_rows)
+    return _tiled_resolve(tiles, base, pos, k), valid
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -355,24 +422,9 @@ def tiled_sample_layer(
     resolved via k 2-D row gathers + one-hot lane selects. Measured at
     products hop-3 shape: fetch 6.5 vs 9.0 ms (scripts/probe_fetch_final.py).
     """
-    n = bd.shape[0]
-    s = jnp.clip(seeds, 0, n - 1).astype(jnp.int32)
-    both = jnp.take(bd, s, axis=0)
-    base, deg = both[:, 0], both[:, 1]
-    deg = jnp.where(seed_valid, deg, 0)
+    base, deg = _tiled_bd_lookup(bd, seeds, seed_valid)
     pos, valid = fisher_yates_positions(key, deg, k)
-    rows = base[:, None] + lax.shift_right_logical(pos, LANE.bit_length() - 1)
-    rows = jnp.clip(rows, 0, tiles.shape[0] - 1)
-    lane = jnp.bitwise_and(pos, LANE - 1)
-    ar = jnp.arange(LANE, dtype=jnp.int32)
-    cols = []
-    for j in range(k):  # k-split: k [B]-row gathers measured faster than
-        #                 one [B*k] (probe_tiled_variants: 6.2 vs 7.1 ms)
-        win = jnp.take(tiles, rows[:, j], axis=0)
-        oh = lane[:, j][:, None] == ar[None, :]
-        cols.append(jnp.where(oh, win, 0).sum(axis=1))
-    nbrs = jnp.stack(cols, axis=1).astype(tiles.dtype)
-    return nbrs, valid
+    return _tiled_resolve(tiles, base, pos, k), valid
 
 
 def neighbor_prob(

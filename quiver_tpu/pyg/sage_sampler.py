@@ -39,6 +39,7 @@ from ..ops.sample import (
     sample_layer as _sample_layer_op,
     sample_prob as _sample_prob,
     tiled_sample_layer as _tiled_sample_layer_op,
+    tiled_weighted_sample_layer as _tiled_weighted_sample_layer_op,
     weighted_sample_layer as _weighted_sample_layer_op,
 )
 from ..ops.reindex import local_reindex
@@ -469,8 +470,11 @@ class GraphSageSampler:
         neighbor fetch rides 2-D row gathers (~1.4x the element-gather
         rate, measured) at ~2-3x flat-CSR HBM bytes; "flat" keeps the
         plain CSR (use when HBM is tight). Draw-identical on the same
-        seed. Weighted sampling always uses the flat layout (its lane
-        window already reads contiguous rows).
+        seed (weighted: when max_deg is a multiple of 128). Weighted
+        tiled additionally tiles the edge weights
+        (`to_device_tiled_weights`) so the [B, max_deg] weight window
+        rides ceil(max_deg/128) row gathers per row instead of max_deg
+        element gathers.
     dedup : True (default) dedups every hop like the reference's hash-table
         reindex; False uses the fused no-reindex hot path
         (`sample_dense_fused`) — fastest on TPU, n_id may repeat nodes
@@ -530,8 +534,7 @@ class GraphSageSampler:
             # distribution; qt_sample_layer_weighted) — the reference has
             # no CPU weighted path at all (weight_sample is CUDA-only,
             # cuda_random.cu.hpp:177-221).
-        # weighted draws need the flat CSR lane windows; tiled adds nothing
-        self.layout = "flat" if weighted else layout
+        self.layout = layout
         self._seed = seed
         self._call = 0
         self._dev_arrays = None
@@ -550,10 +553,11 @@ class GraphSageSampler:
     # -- device-graph binding (reference lazy_init_quiver, sage_sampler.py:98-113)
     def lazy_init_quiver(self):
         """Bind the graph to the device and return the binding: the
-        ``(bd, tiles)`` pair under the default tiled layout, the flat
-        ``(indptr, indices)`` pair under ``layout='flat'``/weighted.
-        Callers needing the flat pair regardless of layout should use
-        ``self.csr_topo.to_device()``."""
+        ``(bd, tiles)`` pair under the default tiled layout (weighted
+        samplers included — their weight tiles bind separately via
+        ``to_device_tiled_weights``), the flat ``(indptr, indices)`` pair
+        under ``layout='flat'``. Callers needing the flat pair regardless
+        of layout should use ``self.csr_topo.to_device()``."""
         if self.layout == "tiled":
             if self._dev_tiled is None:
                 self._dev_tiled = self.csr_topo.to_device_tiled(self._device_obj())
@@ -600,18 +604,25 @@ class GraphSageSampler:
     def _engine(self):
         """(indptr, indices, sample_fn, id_dtype) for the dense pipelines.
         indptr/indices are None under the tiled layout — the sample_fn
-        closure carries the (bd, tiles) arrays instead."""
-        if self.weighted:
-            indptr, indices = self.lazy_init_quiver()
-            return indptr, indices, self._weighted_sample_fn(), indices.dtype
+        closure carries the (bd, tiles[, wtiles]) arrays instead."""
         if self.layout == "tiled":
             bd, tiles = self.lazy_init_quiver()
+            if self.weighted:
+                wtiles = self.csr_topo.to_device_tiled_weights(self._device_obj())
+                max_deg = self.max_deg
 
-            def sample_fn(cur, cur_valid, k, key):
-                return _tiled_sample_layer_op(bd, tiles, cur, cur_valid, k, key)
+                def sample_fn(cur, cur_valid, k, key):
+                    return _tiled_weighted_sample_layer_op(
+                        bd, tiles, wtiles, cur, cur_valid, k, key, max_deg
+                    )
+            else:
+                def sample_fn(cur, cur_valid, k, key):
+                    return _tiled_sample_layer_op(bd, tiles, cur, cur_valid, k, key)
 
             return None, None, sample_fn, tiles.dtype
         indptr, indices = self.lazy_init_quiver()
+        if self.weighted:
+            return indptr, indices, self._weighted_sample_fn(), indices.dtype
         return indptr, indices, None, indices.dtype
 
     # -- dense static-shape surface --------------------------------------

@@ -163,6 +163,7 @@ class CSRTopo:
         state = self.__dict__.copy()
         state["_device_cache"] = None
         state["_tiled_cache"] = None
+        state["_wtiled_cache"] = None
         return state
 
     def share_memory_(self):
@@ -239,6 +240,30 @@ class CSRTopo:
             tiles = jax.device_put(tiles, device)
         self._tiled_cache = (key, (bd, tiles))
         return self._tiled_cache[1]
+
+    def to_device_tiled_weights(self, device=None):
+        """Edge weights in the SAME tile map as `to_device_tiled`'s edge
+        tiles (``[M, 128]`` f32) — the weighted sampler's lane windows
+        then ride row gathers too (`ops.sample.tiled_weighted_sample_layer`)."""
+        import jax
+
+        import jax.numpy as jnp
+
+        from .ops.sample import build_tiled_host
+
+        if self.edge_weights is None:
+            raise ValueError("no edge_weights on this CSRTopo")
+        key = ("wtiled", str(device))
+        if getattr(self, "_wtiled_cache", None) is not None and self._wtiled_cache[0] == key:
+            return self._wtiled_cache[1]
+        _, wtiles_np = build_tiled_host(
+            self.indptr, self.edge_weights, np.float32
+        )
+        wtiles = jnp.asarray(wtiles_np)
+        if device is not None:
+            wtiles = jax.device_put(wtiles, device)
+        self._wtiled_cache = (key, wtiles)
+        return wtiles
 
 
 def heat_reorder(

@@ -652,5 +652,55 @@ def test_sampler_layout_knob(graph):
     for adj_a, adj_b in zip(a.adjs, b.adjs):
         np.testing.assert_array_equal(np.asarray(adj_a.mask), np.asarray(adj_b.mask))
         np.testing.assert_array_equal(np.asarray(adj_a.cols), np.asarray(adj_b.cols))
+    # weighted samplers ride the tiled layout too (weights get their own
+    # tile table; see test_tiled_weighted_sampler_end_to_end)
     sw = GraphSageSampler(topo_w, [4], mode="TPU", weighted=True)
-    assert sw.layout == "flat"
+    assert sw.layout == "tiled"
+
+
+def test_tiled_weighted_bit_identical(graph):
+    """Weighted tiled sampling (weight window = tile-row gathers) draws
+    BIT-IDENTICALLY to the flat weighted path on the same key when
+    max_deg is a multiple of 128 (same Gumbel shape, same scores)."""
+    from quiver_tpu.ops.sample import (
+        build_tiled_host, tiled_weighted_sample_layer, weighted_sample_layer,
+    )
+
+    rng = np.random.default_rng(5)
+    w = rng.random(graph.edge_count).astype(np.float32)
+    indptr, indices = np.asarray(graph.indptr), np.asarray(graph.indices)
+    bd, tiles = build_tiled_host(indptr, indices)
+    _, wtiles = build_tiled_host(indptr, w, np.float32)
+    seeds = jnp.asarray(np.arange(graph.node_count, dtype=np.int32))
+    sv = jnp.ones(seeds.shape, bool)
+    key = jax.random.key(21)
+    a, va = weighted_sample_layer(
+        jnp.asarray(indptr), jnp.asarray(indices.astype(np.int32)),
+        jnp.asarray(w), seeds, sv, 4, key, 128,
+    )
+    b, vb = tiled_weighted_sample_layer(
+        jnp.asarray(bd), jnp.asarray(tiles), jnp.asarray(wtiles),
+        seeds, sv, 4, key, 128,
+    )
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    np.testing.assert_array_equal(
+        np.asarray(a)[np.asarray(va)], np.asarray(b)[np.asarray(vb)]
+    )
+
+
+def test_tiled_weighted_sampler_end_to_end(graph):
+    """GraphSageSampler(weighted=True) on the default tiled layout: only
+    positive-weight edges are drawn; matches the flat weighted sampler's
+    draws on the same seed (max_deg multiple of 128)."""
+    ew = np.where(np.asarray(graph.indices) % 2 == 0, 1.0, 0.0).astype(np.float32)
+    topo = CSRTopo(indptr=graph.indptr, indices=graph.indices, edge_weights=ew)
+    st = GraphSageSampler(topo, [4], mode="TPU", weighted=True, max_deg=128, seed=3)
+    sf = GraphSageSampler(
+        topo, [4], mode="TPU", weighted=True, max_deg=128, seed=3, layout="flat"
+    )
+    assert st.layout == "tiled" and sf.layout == "flat"
+    ds_t = st.sample_dense(np.arange(64))
+    ds_f = sf.sample_dense(np.arange(64))
+    np.testing.assert_array_equal(np.asarray(ds_t.n_id), np.asarray(ds_f.n_id))
+    sampled = np.asarray(ds_t.n_id)[64 : int(ds_t.count)]
+    assert (sampled % 2 == 0).all()
