@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+LANE = 128  # native int32 lane width — the tile-layout row size
+
 
 def pad_widths(batch: int, sizes, caps=None):
     """Static padded n_id widths per hop: ``W_{l+1} = min(cap_l, W_l*(1+k_l))``.
@@ -301,9 +303,6 @@ def sample_layer(
     flat = jnp.clip(flat, 0, jnp.asarray(indices.shape[0] - 1, ptr.dtype))
     nbrs = jnp.take(indices, flat)
     return nbrs, valid
-
-
-LANE = 128  # native int32 lane width — the tile row size
 
 
 def build_tiled_host(

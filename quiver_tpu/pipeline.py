@@ -198,12 +198,16 @@ class PipelineStats:
     # around every stage body and every device step. THE falsifiable
     # overlap evidence — summarize with `overlap_summary()`; unlike a
     # seq-minus-pipe subtraction against a separately-timed link probe,
-    # these are one clock over one run
-    spans: list = None
+    # these are one clock over one run. Bounded (deque) so a long-running
+    # pipeline doesn't accumulate spans forever; the summary then covers
+    # the most recent window
+    spans: object = None
 
     def record(self, stage: str, t0: float, t1: float) -> None:
         if self.spans is None:
-            self.spans = []
+            import collections
+
+            self.spans = collections.deque(maxlen=100_000)
         self.spans.append((stage, t0, t1))
 
     def overlap_summary(self) -> dict:
