@@ -44,6 +44,13 @@ class CheckpointManager:
 
     def save(self, step: int, state: Dict[str, Any], wait: bool = True) -> None:
         ocp = _ocp()
+        import jax
+
+        # numpy SCALAR leaves (np.int64 step counters etc.) are rejected by
+        # newer orbax StandardSave type validation; 0-d ndarrays round-trip
+        state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x, state
+        )
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()

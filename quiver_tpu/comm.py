@@ -144,12 +144,8 @@ def _exchange_jit(requests, tables, *, mesh, axis):
         resp = lax.all_to_all(rows, axis, split_axis=0, concat_axis=0)  # [H, L, D]
         return resp[None]  # [1, H, L, D]
 
-    try:
-        shard_map = jax.shard_map
-    except AttributeError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _sm
+    from .utils import shard_map_compat as shard_map
 
-        shard_map = _sm
     return shard_map(
         body,
         mesh=mesh,

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import axis_size_compat
+
 
 def sharded_gather(table_block: jax.Array, ids: jax.Array, axis_name) -> jax.Array:
     """Gather rows by *global* id from a row-sharded table.
@@ -52,7 +54,7 @@ def _partial_rows(table_block: jax.Array, ids: jax.Array, axes) -> jax.Array:
     rows_per_shard = table_block.shape[0]
     idx = lax.axis_index(axes[0])
     for a in axes[1:]:
-        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+        idx = idx * axis_size_compat(a) + lax.axis_index(a)
     id_dt = ids.dtype if ids.dtype == jnp.int64 else jnp.int32
     local = ids.astype(id_dt) - idx.astype(id_dt) * rows_per_shard
     in_range = (local >= 0) & (local < rows_per_shard)
@@ -205,7 +207,7 @@ def sharded_gather_hot_cold(
     # NEITHER hot nor cold — they must not consume budget lanes
     n_cold_global = cold_block.shape[0]
     for a in feat_axes:
-        n_cold_global = n_cold_global * lax.axis_size(a)
+        n_cold_global = n_cold_global * axis_size_compat(a)
     is_cold = (ids >= hot_rows) & (ids < hot_rows + n_cold_global)
     n_cold = is_cold.sum().astype(jnp.int32)
     order = jnp.argsort(jnp.where(is_cold, 0, 1), stable=True)

@@ -23,20 +23,47 @@ request budgets must be provisioned for the worst-case skew, which on
 degree-ordered power-law graphs is the full frontier width (the same
 analysis as the grouped feature gather, see NEXT.md round-2 note), so the
 lane count matches the all_gather/psum formulation while adding sorts.
+
+Two shard LAYOUTS share all of the collective machinery above:
+
+- ``layout="flat"`` (`ShardedTopology`): each shard keeps its contiguous CSR
+  block as a local indptr + flat indices array and resolves drawn positions
+  with one-element gathers (`ops.sample.row_windows`);
+- ``layout="tiled"`` (`TiledShardedTopology`): each shard's block is rebuilt
+  into the 128-lane tile layout of `ops.sample.build_tiled_host` — a local
+  ``(base, degree)`` table plus a ``[M, 128]`` tile table — so position
+  resolution rides 2-D ROW gathers + one-hot lane selects, the fetch shape
+  behind the single-chip 2.58x fused-SEPS win (PERF_NOTES.md "ROUND-5").
+  The collective payloads are IDENTICAL between layouts (same ``[W, k]``
+  neighbor/valid return, same frontier all_gather); only the local HBM
+  fetch shape changes — `sampling_comm_bytes(layout=...)` models both.
+  Tiled is the TPU-mode default (`resolve_topology_layout`), matching the
+  single-chip ``GraphSageSampler(layout="tiled")`` default; SCALING.md
+  carries the flat-vs-tiled comparison.
 """
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..utils import axis_size_compat
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.sample import fisher_yates_positions, pad_widths, row_windows
+from ..ops.sample import (
+    LANE,
+    _tiled_bd_lookup,
+    _tiled_resolve,
+    build_tiled_host,
+    fisher_yates_positions,
+    pad_widths,
+    row_windows,
+)
 
 
 class ShardedTopology(NamedTuple):
@@ -70,6 +97,55 @@ def topology_specs(feat_axes) -> "ShardedTopology":
     return ShardedTopology(
         indptr=P(feat_axes, None), indices=P(feat_axes, None), row_start=P()
     )
+
+
+class TiledShardedTopology(NamedTuple):
+    """Row-sharded CSR in the 128-lane TILE layout (`build_tiled_topology_shards`).
+
+    ``bd``    [P, R_max, 2] int32 — per-shard LOCAL (tile_base, degree)
+              table (`ops.sample.tiled_base_host` of the shard's block),
+              row-padded so rows past the shard's range read as degree 0;
+    ``tiles`` [P, M_max, 128] — per-shard tile tables (`build_tiled_host`
+              of the block), tile-count-padded so the blocks stack;
+    ``row_start`` [P+1]      — global row boundaries (replicated; shard p
+              owns rows ``row_start[p]:row_start[p+1]``), same contract
+              as `ShardedTopology`.
+    """
+
+    bd: jax.Array
+    tiles: jax.Array
+    row_start: jax.Array
+
+    @property
+    def n_shards(self) -> int:
+        return self.bd.shape[0]
+
+    def specs(self, feat_axes) -> "TiledShardedTopology":
+        """shard_map in_specs pytree for this topology striped over
+        ``feat_axes`` (row_start is replicated)."""
+        return tiled_topology_specs(feat_axes)
+
+
+def tiled_topology_specs(feat_axes) -> "TiledShardedTopology":
+    """`topology_specs` for the tiled layout: bd/tile blocks striped over
+    ``feat_axes``, row boundaries replicated."""
+    return TiledShardedTopology(
+        bd=P(feat_axes, None, None),
+        tiles=P(feat_axes, None, None),
+        row_start=P(),
+    )
+
+
+def resolve_topology_layout(layout: Optional[str]) -> str:
+    """Default the sharded-topology layout per backend: ``None`` means
+    "tiled" on TPU (matching the single-chip `GraphSageSampler` TPU
+    default) and "flat" elsewhere (virtual CPU meshes keep the layout the
+    hermetic tests were seeded with unless they opt in explicitly)."""
+    if layout is None:
+        layout = "tiled" if jax.default_backend() == "tpu" else "flat"
+    if layout not in ("flat", "tiled"):
+        raise ValueError(f"unsupported topology layout: {layout!r}")
+    return layout
 
 
 def partition_rows_by_edges(indptr: np.ndarray, n_shards: int) -> np.ndarray:
@@ -121,9 +197,52 @@ def build_topology_shards(
     return indptr_blocks, indices_blocks, row_start.astype(rs_dt)
 
 
+def build_tiled_topology_shards(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    n_shards: int,
+    pad_multiple: int = 8,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side TILED shard construction: (bd_blocks, tiles_blocks,
+    row_start) as stacked numpy arrays (see `TiledShardedTopology`).
+
+    Row boundaries come from the same `partition_rows_by_edges` split as
+    the flat build, and each shard's contiguous block is rebuilt with
+    `build_tiled_host` on its LOCAL indptr — so a shard's tile table holds
+    exactly the edges of its flat indices block, in the same per-row
+    order (the parity tests lean on this). Per-shard tile counts are
+    padded to the max (rounded up to ``pad_multiple`` tile rows) so the
+    blocks stack into one ``[P, M_max, 128]`` device array; bd blocks are
+    row-padded with degree-0 entries so out-of-range lookups draw nothing.
+    """
+    indptr = np.asarray(indptr)
+    indices = np.asarray(indices)
+    row_start = partition_rows_by_edges(indptr, n_shards)
+    r_max = int(np.max(row_start[1:] - row_start[:-1])) if n_shards else 0
+    r_max = max(r_max, 1)
+    blocks = []
+    for p in range(n_shards):
+        lo, hi = int(row_start[p]), int(row_start[p + 1])
+        local_ptr = (indptr[lo : hi + 1] - indptr[lo]).astype(np.int64)
+        local_idx = indices[int(indptr[lo]) : int(indptr[hi])]
+        blocks.append(build_tiled_host(local_ptr, local_idx, indices.dtype))
+    m_max = max(max(t.shape[0] for _, t in blocks), 1)
+    m_max = -(-m_max // pad_multiple) * pad_multiple
+    bd_blocks = np.zeros((n_shards, r_max, 2), np.int32)
+    tiles_blocks = np.zeros((n_shards, m_max, LANE), indices.dtype)
+    for p, (bd, tiles) in enumerate(blocks):
+        bd_blocks[p, : bd.shape[0]] = bd
+        tiles_blocks[p, : tiles.shape[0]] = tiles
+    rs_dt = np.int32 if int(row_start[-1]) < 2**31 else np.int64
+    return bd_blocks, tiles_blocks, row_start.astype(rs_dt)
+
+
 def shard_topology_rows(
-    mesh: Mesh, topo, axes: Optional[Tuple[str, ...]] = None
-) -> ShardedTopology:
+    mesh: Mesh,
+    topo,
+    axes: Optional[Tuple[str, ...]] = None,
+    layout: Optional[str] = None,
+) -> Union["ShardedTopology", "TiledShardedTopology"]:
     """Place a `CSRTopo` row-sharded over the mesh's feature axes.
 
     Each device ends up holding ONLY its contiguous CSR block (~E/P edges;
@@ -132,20 +251,36 @@ def shard_topology_rows(
 
     ``axes`` defaults to the mesh's feature axes ((host, ici) on a 3-axis
     mesh, else (ici,)); the blocks are replicated over the remaining axes.
+
+    ``layout`` picks the per-shard block format: "flat" (`ShardedTopology`)
+    or "tiled" (`TiledShardedTopology`, the 128-lane tile layout). ``None``
+    resolves per backend (`resolve_topology_layout`: tiled on TPU). Pair
+    with the same ``layout`` on `make_sharded_topo_train_step`.
     """
     from .train import mesh_axes
 
+    layout = resolve_topology_layout(layout)
     if axes is None:
         _, axes, _ = mesh_axes(mesh)
     axes = (axes,) if isinstance(axes, str) else tuple(axes)
     n_shards = 1
     for a in axes:
         n_shards *= mesh.shape[a]
+    rep = NamedSharding(mesh, P())
+    if layout == "tiled":
+        bd_b, tiles_b, row_start = build_tiled_topology_shards(
+            topo.indptr, topo.indices, n_shards
+        )
+        blk3 = NamedSharding(mesh, P(axes, None, None))
+        return TiledShardedTopology(
+            bd=jax.device_put(jnp.asarray(bd_b), blk3),
+            tiles=jax.device_put(jnp.asarray(tiles_b), blk3),
+            row_start=jax.device_put(jnp.asarray(row_start), rep),
+        )
     indptr_b, indices_b, row_start = build_topology_shards(
         topo.indptr, topo.indices, n_shards
     )
     blk_sharding = NamedSharding(mesh, P(axes, None))
-    rep = NamedSharding(mesh, P())
     return ShardedTopology(
         indptr=jax.device_put(jnp.asarray(indptr_b), blk_sharding),
         indices=jax.device_put(jnp.asarray(indices_b), blk_sharding),
@@ -156,8 +291,49 @@ def shard_topology_rows(
 def _flat_axis_index(axes: Tuple[str, ...]):
     idx = lax.axis_index(axes[0])
     for a in axes[1:]:
-        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+        idx = idx * axis_size_compat(a) + lax.axis_index(a)
     return idx
+
+
+def _psum_assemble(nbrs, valid, axes):
+    """Owner-exclusive full assembly: shard contributions are zeros off
+    the owner, so a psum over the striping axes IS the gather."""
+    return lax.psum(nbrs, axes), lax.psum(valid, axes) > 0
+
+
+def _grouped_collective_sample(partial_fn, cur, cur_valid, k, axes, group_axis, via):
+    """The ONE grouped-sample implementation both shard layouts ride:
+    all_gather the per-group frontiers over ``group_axis``, draw once via
+    ``partial_fn(all_cur, all_valid) -> (nbrs, valid_int32)`` (a layout's
+    un-reduced shard contribution at the gathered width), then return each
+    group its own ``[W, k]`` slice through one of the two spellings —
+    ``via="scatter"`` psum_scatters the ``[G, W, k]`` partials over the
+    group axis (ring cost (G-1)/G) and psums the remaining striping axes at
+    width W; ``via="psum"`` is the round-3 full-psum+slice spelling (2x the
+    group-axis bytes, G x the other axes' width — kept selectable for the
+    SCALING.md comparison)."""
+    h = axis_size_compat(group_axis)
+    w = cur.shape[0]
+    all_cur = lax.all_gather(cur, group_axis).reshape(-1)
+    all_valid = lax.all_gather(cur_valid, group_axis).reshape(-1)
+    if via == "psum" or group_axis not in axes:
+        nbrs, valid = _psum_assemble(*partial_fn(all_cur, all_valid), axes)
+        me = lax.axis_index(group_axis)
+        return nbrs.reshape(h, w, k)[me], valid.reshape(h, w, k)[me]
+    if via != "scatter":
+        raise ValueError(f"unknown via {via!r}")
+    nbrs, valid = partial_fn(all_cur, all_valid)
+    nbrs = lax.psum_scatter(
+        nbrs.reshape(h, w, k), group_axis, scatter_dimension=0, tiled=False
+    )
+    valid = lax.psum_scatter(
+        valid.reshape(h, w, k), group_axis, scatter_dimension=0, tiled=False
+    )
+    other = tuple(a for a in axes if a != group_axis)
+    if other:
+        nbrs = lax.psum(nbrs, other)
+        valid = lax.psum(valid, other)
+    return nbrs, valid > 0
 
 
 def sharded_sample_layer(
@@ -185,9 +361,7 @@ def sharded_sample_layer(
     nbrs, valid = _sample_layer_partial(
         indptr_blk, indices_blk, row_start, cur, cur_valid, k, key, axes
     )
-    nbrs = lax.psum(nbrs, axes)
-    valid = lax.psum(valid, axes) > 0
-    return nbrs, valid
+    return _psum_assemble(nbrs, valid, axes)
 
 
 def _sample_layer_partial(
@@ -213,6 +387,48 @@ def _sample_layer_partial(
     return nbrs, valid.astype(jnp.int32)
 
 
+def _tiled_sample_layer_partial(
+    bd_blk, tiles_blk, row_start, cur, cur_valid, k, key, axes
+):
+    """`_sample_layer_partial` over the TILE layout: the owner test and the
+    Fisher-Yates draw are identical (same key, same per-row degree — the
+    draw is bit-equal to the flat path's), only position resolution differs:
+    tile-row gathers + one-hot lane selects through `_tiled_resolve` instead
+    of flat element gathers, the same fetch shape as the single-chip
+    `tiled_sample_layer`."""
+    idx = _flat_axis_index(axes)
+    start = jnp.take(row_start, idx)
+    end = jnp.take(row_start, idx + 1)
+    local = (cur - start).astype(jnp.int32)
+    mine = cur_valid & (cur >= start) & (cur < end)
+    base, deg = _tiled_bd_lookup(bd_blk, local, mine)
+    pos, valid = fisher_yates_positions(key, deg, k)
+    nbrs = _tiled_resolve(tiles_blk, base, pos, k)
+    nbrs = jnp.where(valid, nbrs, 0)
+    return nbrs, valid.astype(jnp.int32)
+
+
+def tiled_sharded_sample_layer(
+    bd_blk: jax.Array,
+    tiles_blk: jax.Array,
+    row_start: jax.Array,
+    cur: jax.Array,
+    cur_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+    axes,
+) -> Tuple[jax.Array, jax.Array]:
+    """`sharded_sample_layer` over the TILE shard layout
+    (`TiledShardedTopology`): same contract, same owner-exclusive psum
+    assembly, bit-identical draws on the same key — the shard-local fetch
+    rides 2-D row gathers instead of element gathers."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    nbrs, valid = _tiled_sample_layer_partial(
+        bd_blk, tiles_blk, row_start, cur, cur_valid, k, key, axes
+    )
+    return _psum_assemble(nbrs, valid, axes)
+
+
 def sharded_sample_layer_grouped(
     indptr_blk: jax.Array,
     indices_blk: jax.Array,
@@ -227,45 +443,46 @@ def sharded_sample_layer_grouped(
 ) -> Tuple[jax.Array, jax.Array]:
     """`sharded_sample_layer` for frontiers that DIFFER across ``group_axis``
     (one of the striping axes, typically "host" — data-parallel groups span
-    it, so each host's frontier is distinct).
-
-    The frontiers are all_gathered over ``group_axis`` (making them identical
-    across every participant) and sampled once for all groups — the same
-    grouped pattern as `collectives.sharded_gather_grouped`, with the same
-    two return-trip spellings: ``via="scatter"`` (default) psum_scatters the
-    ``[G, W, k]`` partials over ``group_axis`` (each group receives only its
-    own slice, ring cost (G-1)/G) then psums the remainder over the other
-    striping axes at width W; ``via="psum"`` is the round-3 full-psum+slice
-    spelling (2x the group-axis bytes, G x the other axes' width — kept for
-    the SCALING.md comparison).
+    it, so each host's frontier is distinct). Grouped machinery and both
+    ``via`` return-trip spellings live in `_grouped_collective_sample`.
     """
     axes = (axes,) if isinstance(axes, str) else tuple(axes)
-    h = lax.axis_size(group_axis)
-    w = cur.shape[0]
-    all_cur = lax.all_gather(cur, group_axis).reshape(-1)
-    all_valid = lax.all_gather(cur_valid, group_axis).reshape(-1)
-    if via == "psum" or group_axis not in axes:
-        nbrs, valid = sharded_sample_layer(
+
+    def partial_fn(all_cur, all_valid):
+        return _sample_layer_partial(
             indptr_blk, indices_blk, row_start, all_cur, all_valid, k, key, axes
         )
-        me = lax.axis_index(group_axis)
-        return nbrs.reshape(h, w, k)[me], valid.reshape(h, w, k)[me]
-    if via != "scatter":
-        raise ValueError(f"unknown via {via!r}")
-    nbrs, valid = _sample_layer_partial(
-        indptr_blk, indices_blk, row_start, all_cur, all_valid, k, key, axes
+
+    return _grouped_collective_sample(
+        partial_fn, cur, cur_valid, k, axes, group_axis, via
     )
-    nbrs = lax.psum_scatter(
-        nbrs.reshape(h, w, k), group_axis, scatter_dimension=0, tiled=False
+
+
+def tiled_sharded_sample_layer_grouped(
+    bd_blk: jax.Array,
+    tiles_blk: jax.Array,
+    row_start: jax.Array,
+    cur: jax.Array,
+    cur_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+    axes,
+    group_axis: str,
+    via: str = "scatter",
+) -> Tuple[jax.Array, jax.Array]:
+    """`sharded_sample_layer_grouped` over the TILE shard layout: identical
+    grouped machinery and ``via`` spellings (`_grouped_collective_sample`),
+    tiled shard-local fetches."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+
+    def partial_fn(all_cur, all_valid):
+        return _tiled_sample_layer_partial(
+            bd_blk, tiles_blk, row_start, all_cur, all_valid, k, key, axes
+        )
+
+    return _grouped_collective_sample(
+        partial_fn, cur, cur_valid, k, axes, group_axis, via
     )
-    valid = lax.psum_scatter(
-        valid.reshape(h, w, k), group_axis, scatter_dimension=0, tiled=False
-    )
-    other = tuple(a for a in axes if a != group_axis)
-    if other:
-        nbrs = lax.psum(nbrs, other)
-        valid = lax.psum(valid, other)
-    return nbrs, valid > 0
 
 
 def gather_comm_bytes(
@@ -339,6 +556,7 @@ def sampling_comm_bytes(
     id_bytes: int = 4,
     feat_bytes: int = 4,
     via: str = "scatter",
+    layout: str = "flat",
 ) -> Dict[str, float]:
     """Static per-step collective-traffic model for the sharded-topology
     train step — the ICI/DCN byte accounting the multichip artifacts log.
@@ -355,6 +573,20 @@ def sampling_comm_bytes(
     SCALING.md comparison). This is a *model* — on real hardware XLA may
     pick other algorithms — but it makes relative layout costs comparable
     without a pod.
+
+    ``layout`` ("flat" | "tiled", the `ShardedTopology` vs
+    `TiledShardedTopology` shard formats) does NOT change the collective
+    accounting — both layouts move the identical ``[W, k]`` neighbor/valid
+    return and frontier all_gather — but it changes the shard-LOCAL HBM
+    fetch shape, reported as two extra keys: ``hbm_descriptors`` (gather
+    descriptors issued per chip per step: one per frontier row for the
+    degree/base lookup plus one per drawn position) and ``hbm_fetch_bytes``
+    (bytes those descriptors move: 128-lane tile rows under "tiled",
+    single elements under "flat"). Descriptor COUNTS match between layouts;
+    what differs is the bytes per descriptor and — the reason tiled wins —
+    the issue RATE: TPU row gathers stream ~1.4-2.6x faster than element
+    gathers (PERF_NOTES.md; `scaling.sharded_fetch_table` applies the
+    measured rates).
     """
     from .train import mesh_axes
 
@@ -389,13 +621,23 @@ def sampling_comm_bytes(
             )
             add_psum(per_group_elems, elem_bytes, axes=ici_axes)
 
+    layout = resolve_topology_layout(layout)
+    hbm_desc = 0.0
+    hbm_fetch = 0.0
     for l, k in enumerate(sizes):
         if has_host:
             add_all_gather_host(widths[l], id_bytes + 1)  # frontier ids + valid
         add_grouped(widths[l] * k, id_bytes + 4)  # nbrs + int32 valid return
         if feature_dim:
             add_grouped(widths[l] * k * feature_dim, feat_bytes)
+        # shard-local fetch: every chip resolves the all_gathered frontier
+        w = widths[l] * hostsz
+        hbm_desc += w + w * k  # degree/base lookup + k-split position fetch
+        per_fetch = LANE * id_bytes if layout == "tiled" else id_bytes
+        hbm_fetch += w * 8 + w * k * per_fetch
     if feature_dim:
         add_grouped(widths[0] * feature_dim, feat_bytes)  # seed rows
+    out["hbm_descriptors"] = hbm_desc
+    out["hbm_fetch_bytes"] = hbm_fetch
     out["total_bytes"] = out["ici_bytes"] + out["dcn_bytes"]
     return out

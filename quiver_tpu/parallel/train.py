@@ -28,10 +28,10 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 moved shard_map out of experimental
-    from jax import shard_map as _shard_map_fn
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map_fn
+# jax >= 0.6 top-level shard_map vs older experimental spelling: one
+# compat wrapper (utils.shard_map_compat) absorbs both the location and
+# the check_vma/check_rep rename
+from ..utils import axis_size_compat, shard_map_compat as _shard_map_fn
 
 from ..pyg.sage_sampler import (
     sample_and_gather_dedup,
@@ -157,7 +157,7 @@ def _fold_group_key(key, has_host):
     ici group."""
     dp_idx = lax.axis_index("dp")
     if has_host:
-        dp_idx = lax.axis_index("host") * lax.axis_size("dp") + dp_idx
+        dp_idx = lax.axis_index("host") * axis_size_compat("dp") + dp_idx
     return jax.random.fold_in(key, dp_idx)
 
 
@@ -300,10 +300,11 @@ def make_sharded_topo_train_step(
     pipeline: str = "dedup",
     hot_rows: Optional[int] = None,
     cold_budget=None,
+    layout: Optional[str] = None,
 ):
     """`make_sharded_train_step` with the GRAPH row-sharded across the mesh.
 
-    Build ``step(params, opt_state, key, stopo: ShardedTopology, feat_block,
+    Build ``step(params, opt_state, key, stopo, feat_block,
     labels, seeds) -> (params, opt_state, loss)``. Unlike
     `make_sharded_train_step` — which replicates indptr/indices in every
     HBM — each device holds only its contiguous CSR block
@@ -315,6 +316,15 @@ def make_sharded_topo_train_step(
     first all_gathered over it (hosts sample different seeds), mirroring the
     grouped feature gather.
 
+    ``layout`` selects the shard block format ``stopo`` must carry —
+    "flat" (`ShardedTopology`) or "tiled" (`TiledShardedTopology`, the
+    128-lane tile layout whose row-gather fetch shape won the single-chip
+    2.58x fused-SEPS round). ``None`` resolves per backend
+    (`topology.resolve_topology_layout`: tiled on TPU, matching the
+    single-chip `GraphSageSampler` default). Build ``stopo`` with the SAME
+    ``layout`` on `shard_topology_rows`; collective payloads and sampling
+    draws are identical between layouts (same key -> same neighbors).
+
     ``hot_rows``/``cold_budget`` compose the replicated-hot feature tier
     with the sharded topology (multi-host meshes; same contract as
     `make_sharded_train_step`): pass ``(hot_block, cold_block)`` from
@@ -323,8 +333,15 @@ def make_sharded_topo_train_step(
     Per-step collective traffic for this layout is statically modeled by
     `topology.sampling_comm_bytes` — log it next to any multichip artifact.
     """
-    from .topology import sharded_sample_layer, sharded_sample_layer_grouped
+    from .topology import (
+        resolve_topology_layout,
+        sharded_sample_layer,
+        sharded_sample_layer_grouped,
+        tiled_sharded_sample_layer,
+        tiled_sharded_sample_layer_grouped,
+    )
 
+    layout = resolve_topology_layout(layout)
     has_host, data_axes, feat_axes, hot_cold = _validate_step_config(
         mesh, pipeline, caps, hot_rows, cold_budget
     )
@@ -335,20 +352,35 @@ def make_sharded_topo_train_step(
             has_host, hot_cold, feat_axes, hot_rows, cold_budget, overflow_acc
         )
 
-        indptr_blk = stopo.indptr[0]    # [R_max+1] this shard's local indptr
-        indices_blk = stopo.indices[0]  # [E_pad]   this shard's edge block
         row_start = stopo.row_start     # [P+1] replicated boundaries
+        if layout == "tiled":
+            bd_blk = stopo.bd[0]        # [R_max, 2] this shard's (base, deg)
+            tiles_blk = stopo.tiles[0]  # [M_max, 128] this shard's tile table
 
-        def sample_fn(cur, cur_valid, k, sub):
-            if not has_host:
-                return sharded_sample_layer(
-                    indptr_blk, indices_blk, row_start, cur, cur_valid, k,
-                    sub, feat_axes,
+            def sample_fn(cur, cur_valid, k, sub):
+                if not has_host:
+                    return tiled_sharded_sample_layer(
+                        bd_blk, tiles_blk, row_start, cur, cur_valid, k,
+                        sub, feat_axes,
+                    )
+                return tiled_sharded_sample_layer_grouped(
+                    bd_blk, tiles_blk, row_start, cur, cur_valid, k, sub,
+                    feat_axes, "host",
                 )
-            return sharded_sample_layer_grouped(
-                indptr_blk, indices_blk, row_start, cur, cur_valid, k, sub,
-                feat_axes, "host",
-            )
+        else:
+            indptr_blk = stopo.indptr[0]    # [R_max+1] shard-local indptr
+            indices_blk = stopo.indices[0]  # [E_pad] this shard's edge block
+
+            def sample_fn(cur, cur_valid, k, sub):
+                if not has_host:
+                    return sharded_sample_layer(
+                        indptr_blk, indices_blk, row_start, cur, cur_valid, k,
+                        sub, feat_axes,
+                    )
+                return sharded_sample_layer_grouped(
+                    indptr_blk, indices_blk, row_start, cur, cur_valid, k, sub,
+                    feat_axes, "host",
+                )
 
         key, dropout_key = jax.random.split(_fold_group_key(key, has_host))
         if pipeline == "fused":
@@ -366,9 +398,12 @@ def make_sharded_topo_train_step(
             params, opt_state, dropout_key, ds, x, labels, seeds,
         )
 
-    from .topology import topology_specs
+    from .topology import tiled_topology_specs, topology_specs
 
-    topo_specs = topology_specs(feat_axes)
+    topo_specs = (
+        tiled_topology_specs(feat_axes) if layout == "tiled"
+        else topology_specs(feat_axes)
+    )
     feat_spec, out_specs = _step_specs(hot_cold, feat_axes)
     sharded = _shard_map_fn(
         step_local,

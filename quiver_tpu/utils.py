@@ -484,6 +484,37 @@ def force_virtual_cpu_devices(n_devices: int) -> None:
     )
 
 
+def axis_size_compat(axis_name):
+    """`lax.axis_size` across the API drift (inside shard_map/pmap only):
+    older jax has no ``lax.axis_size``; ``psum(1, axis)`` is the documented
+    equivalent and constant-folds to a Python int at trace time, so the
+    result is usable in static shapes either way."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """`jax.shard_map` across the API drift, the ONE spelling every caller
+    (library, tests, scripts) goes through: jax >= 0.6 exposes top-level
+    ``jax.shard_map(..., check_vma=)``; older releases only have
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)`` — same knob,
+    renamed. Passing the new name to an old build is a TypeError before
+    tracing, so the fallback is exact."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return sm(f, check_vma=check_vma, **kw)
+    except TypeError:
+        return sm(f, check_rep=check_vma, **kw)
+
+
 def init_p2p(device_list: Optional[List[int]] = None) -> None:
     """Compat no-op (reference utils.py:251-257 / quiver_feature.cu:363-406).
 

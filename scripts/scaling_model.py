@@ -41,14 +41,33 @@ def main():
         step_s = 0.0415  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
         source = "PERF_NOTES.md round-4 default 41.5 ms"
 
-    from quiver_tpu.parallel.scaling import format_markdown, products_scaling_table
+    from quiver_tpu.parallel.scaling import (
+        ShapeMesh,
+        format_fetch_markdown,
+        format_markdown,
+        products_scaling_table,
+        sharded_fetch_table,
+    )
 
     bw = {"ici_bytes_per_s": args.ici_gbps * 1e9, "dcn_bytes_per_s": args.dcn_gbps * 1e9}
     rows = products_scaling_table(
         step_s, steps_per_epoch_1chip=args.steps_per_epoch, bandwidths=bw
     )
     md = format_markdown(rows, step_s, bw)
+    # flat-vs-tiled shard-LOCAL fetch term at the products config on the
+    # 2-host sharded-topology mesh (collective bytes are layout-invariant;
+    # this per-chip HBM term is where the layouts differ)
+    fetch_mesh = ShapeMesh(
+        ("host", "dp", "ici"), {"host": 2, "dp": 2, "ici": 2}
+    )
+    fetch_rows = sharded_fetch_table(fetch_mesh, (15, 10, 5), 1024)
+    fetch_md = (
+        "## Sharded-topology shard-local fetch: flat vs tiled "
+        "(host=2,dp=2,ici=2, products config)\n\n"
+        + format_fetch_markdown(fetch_rows)
+    )
     print(md, file=sys.stderr)
+    print("\n" + fetch_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -60,11 +79,12 @@ def main():
             f"Single-chip step source: {source}.\n\n"
         )
         with open(args.out, "w") as fh:
-            fh.write(header + md + "\n")
+            fh.write(header + md + "\n\n" + fetch_md + "\n")
     print(json.dumps({
         "step_s_1chip": step_s,
         "source": source,
         "rows": [r._asdict() for r in rows],
+        "sharded_fetch": [r._asdict() for r in fetch_rows],
     }))
 
 

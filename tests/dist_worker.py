@@ -13,13 +13,16 @@ mode "train": ONE `make_sharded_train_step` step on the process-spanning
 (dp=1, ici=2) mesh — the loss is printed so the parent test can assert it
 matches a single-controller run of the identical step (same keys, same
 mesh shape, same arithmetic; only the process layout differs).
+mode "train_topo_tiled": same, through `make_sharded_topo_train_step`
+with the TILED row-sharded topology (`TiledShardedTopology`): each
+process ends up holding only its own 128-lane tile block of the CSR.
 """
 
 import os
 import sys
 
 
-def train_main(pid: int, port: str) -> None:
+def train_main(pid: int, port: str, topo_tiled: bool = False) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.setdefault("XLA_FLAGS", "")
 
@@ -53,16 +56,33 @@ def train_main(pid: int, port: str) -> None:
         sh = NamedSharding(mesh, spec)
         return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
 
-    step = case["make_step"](mesh)
     params = jax.tree_util.tree_map(lambda a: gput(a, P()), case["params_np"])
     opt_state = jax.tree_util.tree_map(lambda a: gput(a, P()), case["opt_np"])
-    args = (
-        params, opt_state, jax.random.key(2),
-        gput(case["indptr"], P()), gput(case["indices"], P()),
-        gput(case["feat_padded"], P(("ici",), None)),
-        gput(case["labels"], P()),
-        gput(CASE_SEEDS, P("dp")),
-    )
+    if topo_tiled:
+        from quiver_tpu.parallel import TiledShardedTopology
+
+        bd_b, tiles_b, row_start = case["stopo_np"]
+        stopo = TiledShardedTopology(
+            bd=gput(bd_b, P(("ici",), None, None)),
+            tiles=gput(tiles_b, P(("ici",), None, None)),
+            row_start=gput(row_start, P()),
+        )
+        step = case["make_step_topo_tiled"](mesh)
+        args = (
+            params, opt_state, jax.random.key(2), stopo,
+            gput(case["feat_padded"], P(("ici",), None)),
+            gput(case["labels"], P()),
+            gput(CASE_SEEDS, P("dp")),
+        )
+    else:
+        step = case["make_step"](mesh)
+        args = (
+            params, opt_state, jax.random.key(2),
+            gput(case["indptr"], P()), gput(case["indices"], P()),
+            gput(case["feat_padded"], P(("ici",), None)),
+            gput(case["labels"], P()),
+            gput(CASE_SEEDS, P("dp")),
+        )
     _, _, loss = step(*args)
     print(f"worker {pid} loss {float(loss):.8f}", flush=True)
     print(f"worker {pid} OK", flush=True)
@@ -71,8 +91,8 @@ def train_main(pid: int, port: str) -> None:
 def main() -> None:
     pid = int(sys.argv[1])
     port = sys.argv[2]
-    if len(sys.argv) > 3 and sys.argv[3] == "train":
-        train_main(pid, port)
+    if len(sys.argv) > 3 and sys.argv[3] in ("train", "train_topo_tiled"):
+        train_main(pid, port, topo_tiled=sys.argv[3] == "train_topo_tiled")
         return
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.setdefault("XLA_FLAGS", "")

@@ -51,9 +51,24 @@ def _run_workers(mode=None):
             p.kill()
         pytest.fail("distributed workers timed out:\n" + "\n".join(outs))
     for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and _CPU_MULTIPROCESS_UNSUPPORTED in out:
+            # capability gap, not a code bug: jax <= 0.4.x cannot run
+            # multi-process computations on the CPU backend at all (the
+            # collectives path these tests exist to exercise). The tests
+            # stay live and run for real on any jax whose CPU backend has
+            # cross-process collectives.
+            pytest.skip(
+                "this jax's CPU backend does not implement multiprocess "
+                "computations; 2-process exchange untestable here"
+            )
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"worker {pid} OK" in out, out
     return outs
+
+
+_CPU_MULTIPROCESS_UNSUPPORTED = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
 
 
 def test_two_process_exchange():
@@ -91,6 +106,50 @@ def test_two_process_sharded_train_step_matches_single_controller():
     assert np.isfinite(expect)
 
     outs = _run_workers(mode="train")
+    for pid, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith(f"worker {pid} loss")]
+        assert line, out
+        got = float(line[0].split()[-1])
+        assert abs(got - expect) < 1e-5, (got, expect, out)
+
+
+def test_two_process_tiled_topo_train_step_matches_single_controller():
+    """`make_sharded_topo_train_step(layout="tiled")` end to end across two
+    OS processes: each process holds ONLY its own tile block of the
+    row-sharded CSR (the round-6 tiled shard layout), and one step must
+    produce the same loss as the identical single-controller run."""
+    from sharded_train_case import CASE_SEEDS, build_case
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quiver_tpu.parallel import TiledShardedTopology
+
+    case = build_case()
+    mesh = case["make_mesh"]()
+    step = case["make_step_topo_tiled"](mesh)
+
+    def put(x, spec=P()):
+        return jax.device_put(jax.numpy.asarray(x), NamedSharding(mesh, spec))
+
+    bd_b, tiles_b, row_start = case["stopo_np"]
+    stopo = TiledShardedTopology(
+        bd=put(bd_b, P(("ici",), None, None)),
+        tiles=put(tiles_b, P(("ici",), None, None)),
+        row_start=put(row_start),
+    )
+    params = jax.tree_util.tree_map(put, case["params_np"])
+    opt_state = jax.tree_util.tree_map(put, case["opt_np"])
+    _, _, loss = step(
+        params, opt_state, jax.random.key(2), stopo,
+        put(case["feat_padded"], P(("ici",), None)),
+        put(case["labels"]), put(CASE_SEEDS, P("dp")),
+    )
+    expect = float(loss)
+    assert np.isfinite(expect)
+
+    outs = _run_workers(mode="train_topo_tiled")
     for pid, out in enumerate(outs):
         line = [l for l in out.splitlines() if l.startswith(f"worker {pid} loss")]
         assert line, out

@@ -26,7 +26,7 @@ from quiver_tpu.parallel import (
     sharded_gather_hot_cold,
 )
 from quiver_tpu.parallel.topology import gather_comm_bytes
-from quiver_tpu.utils import CSRTopo
+from quiver_tpu.utils import CSRTopo, shard_map_compat
 from test_e2e import make_community_graph
 
 HOT = 32  # hot prefix rows (heat-ordered table)
@@ -47,7 +47,7 @@ def _run_gather(mesh, hot_dev, cold_dev, ids_per_group, hot_rows, budget):
         return rows[None], overflow[None]
 
     sm = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             f,
             mesh=mesh,
             in_specs=(P(ici_axes, None), P(feat_axes, None), P(("host", "dp"))),

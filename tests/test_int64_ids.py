@@ -104,6 +104,7 @@ from jax.sharding import PartitionSpec as P
 from quiver_tpu.utils import CSRTopo
 from quiver_tpu.pyg.sage_sampler import sample_dense_pure
 from quiver_tpu.parallel import make_mesh, replicate, shard_feature_rows, sharded_gather
+from quiver_tpu.utils import shard_map_compat
 
 rng = np.random.default_rng(0)
 ei = np.stack([rng.integers(0, 50, 600), rng.integers(0, 50, 600)])
@@ -119,7 +120,7 @@ mesh = make_mesh(8)
 table = rng.standard_normal((64, 4)).astype(np.float32)
 ids = rng.integers(0, 64, 17).astype(np.int64)
 block = shard_feature_rows(mesh, table)
-out = jax.jit(jax.shard_map(
+out = jax.jit(shard_map_compat(
     lambda b, i: sharded_gather(b, i, "ici"), mesh=mesh,
     in_specs=(P("ici", None), P()), out_specs=P(), check_vma=False,
 ))(block, replicate(mesh, ids))

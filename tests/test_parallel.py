@@ -19,7 +19,7 @@ from quiver_tpu.parallel import (
     sharded_gather,
 )
 from quiver_tpu.models import GraphSAGE
-from quiver_tpu.utils import CSRTopo
+from quiver_tpu.utils import CSRTopo, shard_map_compat
 from test_e2e import make_community_graph
 
 
@@ -57,7 +57,7 @@ def test_sharded_gather_matches_fancy_index():
         return sharded_gather(block, ids, "ici")
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             f,
             mesh=mesh,
             in_specs=(P("ici", None), P()),
@@ -80,7 +80,7 @@ def test_sharded_gather_oob_ids_zero():
         return sharded_gather(block, ids, "ici")
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             f, mesh=mesh, in_specs=(P("ici", None), P()), out_specs=P(), check_vma=False
         )
     )
@@ -266,7 +266,7 @@ def test_multihost_gather_distinct_ids_exact():
         return sharded_gather_grouped(block, ids, feat_axes, "host")
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             f,
             mesh=mesh,
             in_specs=(P(feat_axes, None), P(data_axes)),
