@@ -319,6 +319,86 @@ def bench_feature(context, table_dev, iters=800, batch=262_144):
     context["feature_tiered20_gbps"] = round(tiered_gbps, 2)
 
 
+def bench_quant_feature(context, table_dev, iters=800, batch=262_144):
+    """Quantized feature store (quiver_tpu.quant): fused dequant-on-gather
+    GB/s for the int8 codec on the hot HBM path, next to the fp32 hot rate
+    from `bench_feature`. The table is ENCODED ON DEVICE (one jitted pass;
+    shipping a host-encoded copy through the tunnel would cost minutes) and
+    the gather+decode loop scans in-jit like every other device bench.
+    Reported both ways: wire-true GB/s via `trace.gbps(bytes_per_elem=1)`
+    (the bytes the gather actually touches) and the f32-equivalent rate
+    (rows delivered x 4 B — comparable to the fp32 row). Row-rate-bound
+    regimes (PERF_NOTES.md) should show similar ROW rates with 1/4 the
+    bytes touched; the f32-equivalent number is then roughly the fp32 rate
+    while HBM pressure drops 4x."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from quiver_tpu.quant import get_codec
+    from quiver_tpu.trace import gbps
+
+    codec = get_codec("int8")
+    n_nodes, dim = table_dev.shape
+    rng = np.random.default_rng(3)
+    ids_dev = jax.device_put(
+        jnp.asarray(rng.integers(0, n_nodes, batch).astype(np.int32))
+    )
+
+    @jax.jit
+    def encode_dev(tab):
+        # device-side mirror of Int8Codec.encode: bit-identical payload
+        # (np.rint == jnp.round half-to-even, span-0 rows store q=0);
+        # scale/zero may differ by 1 ulp (XLA lowers the /254 constant
+        # divide to a reciprocal multiply) — irrelevant for a throughput
+        # bench. Host-exact encode lives in quant.codecs; this exists only
+        # because shipping a host-encoded table through the tunnel costs
+        # minutes.
+        rmin = tab.min(axis=1)
+        span = tab.max(axis=1) - rmin
+        pos = span > 0
+        scale = jnp.where(pos, span / 254.0, 1.0)
+        inv = jnp.where(pos, 254.0 / jnp.where(pos, span, 1.0), 0.0)
+        q = jnp.clip(
+            jnp.round((tab - rmin[:, None]) * inv[:, None]) - 127.0, -127, 127
+        ).astype(jnp.int8)
+        q = q * pos[:, None].astype(q.dtype)  # span-0 rows store q=0
+        zero = jnp.where(pos, -127.0 - rmin / scale, -rmin)
+        return q, scale, zero
+
+    q, scale, zero = encode_dev(table_dev)
+    q.block_until_ready()
+
+    @jax.jit
+    def gather_dequant_many(payload, s, z, idx):
+        def body(acc, i):
+            shifted = (idx + i * 977) % payload.shape[0]
+            rows = jnp.take(payload, shifted, axis=0).astype(jnp.float32)
+            rows = (rows - jnp.take(z, shifted)[:, None]) * jnp.take(s, shifted)[:, None]
+            return acc + rows.sum(dtype=jnp.float32), None
+
+        acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(iters, dtype=jnp.int32))
+        return acc
+
+    float(gather_dequant_many(q, scale, zero, ids_dev))  # compile + warm
+    t0 = time.time()
+    float(gather_dequant_many(q, scale, zero, ids_dev))
+    dt = max(time.time() - t0 - _RPC_FLOOR_S, 1e-9)
+    wire = gbps(iters * batch, dim, dt, bytes_per_elem=codec.bytes_per_elem)
+    f32eq = gbps(iters * batch, dim, dt)
+    log(
+        f"quant int8 fused dequant-gather: {wire:.2f} GB/s wire "
+        f"({f32eq:.2f} GB/s f32-equiv, {iters * batch / dt / 1e6:.1f}M rows/s; "
+        f"hot capacity x{codec.capacity_multiplier(dim):.2f} at D={dim})"
+    )
+    context["quant_int8_gather_gbps_wire"] = round(wire, 2)
+    context["quant_int8_gather_gbps_f32equiv"] = round(f32eq, 2)
+    context["quant_int8_mrows_per_s"] = round(iters * batch / dt / 1e6, 1)
+    context["quant_int8_hot_capacity_multiplier"] = round(
+        codec.capacity_multiplier(dim), 2
+    )
+
+
 def bench_host_sampler(context, indptr_np, indices_np, seeds_np, iters=3):
     """Host-engine SEPS on the products-shaped graph — the direct
     comparison against the reference's CPU sampler baseline (1.84M SEPS,
@@ -881,6 +961,13 @@ def main():
             log("budget exhausted before feature bench")
     except Exception as exc:
         log(f"feature bench failed: {exc}")
+    try:
+        if remaining() > 60:
+            bench_quant_feature(context, table)
+        else:
+            log("budget exhausted before quant feature bench")
+    except Exception as exc:
+        log(f"quant feature bench failed: {exc}")
     try:
         if remaining() > 60:
             bench_host_sampler(

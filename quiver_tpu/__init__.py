@@ -27,6 +27,8 @@ from .partition import (
     quiver_partition_feature,
 )
 from . import comm, pyg, trace
+from . import quant
+from .quant import QuantizedFeature
 from .comm import HostRankTable, NcclComm, TpuComm, getNcclId
 from .pipeline import (
     TieredBatch,
@@ -62,6 +64,8 @@ __all__ = [
     "parse_size",
     "partition_feature_without_replication",
     "pyg",
+    "quant",
+    "QuantizedFeature",
     "inference",
     "quiver_partition_feature",
     "reindex_by_config",

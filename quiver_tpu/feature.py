@@ -48,6 +48,44 @@ class DeviceConfig:
     device_cache_size: Union[int, str] = 0
 
 
+def validate_lookup_ids(
+    node_idx, n: int, feature_order: Optional[np.ndarray] = None,
+    local_order_applied: bool = False,
+) -> np.ndarray:
+    """Opt-in STRICT id validation for feature lookups (host-side, not
+    jittable). The jit gather paths (`lookup_padded`, `tiered_lookup`)
+    deliberately ``jnp.clip`` out-of-range ids into the table — negative
+    ids land on row 0, ids ``>= N`` on the last row — because a data-
+    dependent raise cannot exist inside an XLA program; the eager paths
+    zero-fill instead. Both are silent by design (sampler sentinel padding
+    must flow through). Call this at ingest boundaries where an
+    out-of-range id means corrupt input, not padding.
+
+    Returns the flattened int64 ids; raises ValueError naming the bad
+    count and examples. With ``local_order_applied`` (distributed path),
+    ids whose remap entry is negative — globals this host does not own —
+    are invalid too.
+    """
+    ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
+    if local_order_applied:
+        if feature_order is None:
+            raise ValueError("local-order validation needs the feature_order map")
+        oob = (ids < 0) | (ids >= feature_order.shape[0])
+        bad = oob | (feature_order[np.where(oob, 0, ids)] < 0)
+        domain = f"owned global ids (map size {feature_order.shape[0]})"
+    else:
+        bad = (ids < 0) | (ids >= n)
+        domain = f"[0, {n})"
+    if bad.any():
+        examples = ids[bad][:8].tolist()
+        raise ValueError(
+            f"{int(bad.sum())} of {ids.size} lookup ids outside {domain}; "
+            f"examples: {examples} (jit lookups would clip these, eager "
+            "lookups would zero-fill — see Feature.validate_ids)"
+        )
+    return ids
+
+
 @jax.jit
 def _padded_gather(table: jax.Array, ids: jax.Array) -> jax.Array:
     return jnp.take(table, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
@@ -283,6 +321,13 @@ class Feature:
         if valid is not None:
             rows = rows * valid[:, None].astype(rows.dtype)
         return rows
+
+    def validate_ids(self, node_idx) -> np.ndarray:
+        """Strict opt-in id check: raise instead of the lookup paths'
+        silent clip/zero-fill. See :func:`validate_lookup_ids`."""
+        return validate_lookup_ids(
+            node_idx, self._n, self.feature_order, self._local_order_applied
+        )
 
     # ------------------------------------------------------------------ misc
     @property

@@ -390,6 +390,100 @@ def format_fetch_markdown(rows: Sequence[FetchPrediction]) -> str:
     return "\n".join(lines)
 
 
+class QuantPrediction(NamedTuple):
+    codec: str
+    bytes_per_elem: float
+    row_bytes: float           # payload + per-row side-table bytes
+    hot_capacity_multiplier: float  # rows hot per HBM byte, vs fp32
+    gather_gb_per_step: float  # HBM bytes the step's row gathers touch
+    h2d_gb_per_step: float     # cold wire bytes (side tables stay on device)
+    gather_reduction: float    # fraction of the fp32 gather bytes
+    h2d_reduction: float       # fraction of the fp32 H2D bytes
+
+
+def quant_fetch_table(
+    sizes: Sequence[int],
+    batch_per_group: int,
+    feature_dim: int,
+    caps: Optional[Sequence[Optional[int]]] = None,
+    cold_frac: float = 0.2,
+    codecs: Sequence[str] = ("fp32", "bf16", "int8"),
+) -> List[QuantPrediction]:
+    """Per-codec fetch/byte rows for the quantized feature store
+    (`quiver_tpu.quant`): what each codec does to the three byte walls the
+    tiered step pays —
+
+    - hot capacity: ``4*D / row_bytes`` more rows fit the same HBM budget
+      (int8 at D=100: 3.70x — the 20% fp32 hot tier becomes ~74%, i.e.
+      most cold host-gathers become hot HBM hits before any wire speedup).
+      This is the amortized full-residency figure: ``QuantizedFeature``
+      charges the full-N side tables at ingest, so realized hot rows are
+      ``(budget - side_bytes_per_row*N) / payload_row_bytes``;
+    - gather bytes: the step's final padded n_id width (`pad_widths`, the
+      dedup/tiered pipelines' single full-row gather) times row bytes;
+    - H2D bytes: ``cold_frac`` of that width crosses the host link at
+      PAYLOAD width (per-row side tables are device-replicated,
+      quant/feature.py) — the wire leg `trace.gbps(bytes_per_elem=...)`
+      measures.
+
+    Codec byte shapes come from the live `quant.codecs` registry, so a
+    registered custom codec shows up by adding its name to ``codecs``.
+    """
+    from ..ops.sample import pad_widths
+    from ..quant.codecs import get_codec
+
+    widths = pad_widths(batch_per_group, sizes, caps)
+    w = widths[-1]
+    base_row = 4.0 * feature_dim
+    base_gather = w * base_row
+    base_h2d = cold_frac * w * base_row
+    rows: List[QuantPrediction] = []
+    for name in codecs:
+        c = get_codec(name)
+        row_b = c.row_bytes(feature_dim)
+        gather = w * row_b
+        h2d = cold_frac * w * c.bytes_per_elem * feature_dim
+        rows.append(
+            QuantPrediction(
+                codec=c.name,
+                bytes_per_elem=c.bytes_per_elem,
+                row_bytes=row_b,
+                hot_capacity_multiplier=base_row / row_b,
+                gather_gb_per_step=gather / 1e9,
+                h2d_gb_per_step=h2d / 1e9,
+                gather_reduction=gather / base_gather,
+                # cold_frac=0 (fully HBM-resident): no H2D leg, reduction
+                # is vacuously 1.0 rather than 0/0
+                h2d_reduction=h2d / base_h2d if base_h2d else 1.0,
+            )
+        )
+    return rows
+
+
+def format_quant_markdown(rows: Sequence[QuantPrediction]) -> str:
+    lines = [
+        "| codec | B/elem | row B | hot capacity x | gather GB/step | H2D GB/step | gather vs f32 | H2D vs f32 |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r.codec} | {r.bytes_per_elem:g} | {r.row_bytes:g} "
+            f"| {r.hot_capacity_multiplier:.2f} | {r.gather_gb_per_step:.4f} "
+            f"| {r.h2d_gb_per_step:.4f} | {r.gather_reduction:.0%} "
+            f"| {r.h2d_reduction:.0%} |"
+        )
+    lines.append("")
+    lines.append(
+        "Rows gathered/step = final padded n_id width (pad_widths); side "
+        "tables (int8 fp32 scale+zero, 8 B/row) are device-replicated so "
+        "they count against hot capacity but never the H2D wire "
+        "(quiver_tpu/quant). The capacity multiplier compounds with the "
+        "byte shrink: more rows hot means FEWER cold H2D rows on top of "
+        "each row being cheaper."
+    )
+    return "\n".join(lines)
+
+
 def format_markdown(rows: Sequence[LayoutPrediction], step_s_1chip: float,
                     bandwidths: Optional[Dict[str, float]] = None) -> str:
     bw = dict(DEFAULT_BANDWIDTHS)

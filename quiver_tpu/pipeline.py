@@ -446,18 +446,18 @@ class TrainPipeline:
             self.stats.record("upload", t0, _time.monotonic())
             return out
 
+        q = collections.deque()
         try:
-            q = collections.deque()
 
             def launch():
                 f1 = spool.submit(sample_next)
                 f2 = gpool.submit(gather, f1)
-                q.append(upool.submit(upload, f2))
+                q.append((f1, f2, upool.submit(upload, f2)))
 
             for _ in range(self.depth + 2):
                 launch()
             while True:
-                batch = q.popleft().result()
+                batch = q.popleft()[-1].result()
                 if batch is None:
                     break
                 launch()
@@ -482,6 +482,26 @@ class TrainPipeline:
                         {"params": params, "opt_state": opt_state},
                         wait=False,
                     )
+        except BaseException:
+            # a stage (or the step) raised mid-epoch: cancel every QUEUED
+            # stage future on all three pools so the blocking shutdown below
+            # cannot sit behind batches nobody will consume, and mark EVERY
+            # future of every in-flight chain as observed — including the
+            # sample/gather futures, which can fail on their own (not just
+            # unwind via CancelledError from a cancelled upstream) and
+            # would otherwise log "exception was never retrieved" at GC.
+            # The ORIGINAL exception then re-raises — the clean path's
+            # shutdown alone would leave prefetched chains queued and the
+            # caller guessing why the iterator died
+            for pool in (spool, gpool, upool):
+                pool.shutdown(wait=False, cancel_futures=True)
+            while q:
+                for f in q.popleft():
+                    f.cancel()
+                    f.add_done_callback(
+                        lambda fut: fut.cancelled() or fut.exception()
+                    )
+            raise
         finally:
             spool.shutdown(wait=True)
             gpool.shutdown(wait=True)

@@ -1,0 +1,208 @@
+"""Feature codecs — per-row compression for the tiered feature store.
+
+The paper's split is "sampling is latency-critical, feature collection is
+bandwidth-critical" (SURVEY.md section 2); every byte model in this repo
+(NEXT.md item 2, scaling.py) says the non-compute share of the step is
+dominated by feature fetches. On TPU the cheapest byte is the one never
+gathered: storing encoded rows in every tier simultaneously
+
+- multiplies the effective HBM hot-cache capacity (more rows hot ->
+  fewer cold host gathers at all),
+- shrinks the HBM bytes each fused gather touches, and
+- shrinks the H2D wire bytes of the cold prefetch path
+  (PyTorch-Direct, arXiv 2101.07956, and the GPU-initiated direct-storage
+  work, arXiv 2306.16384, attack the same wall on GPUs).
+
+A codec is storage-layout only: training still consumes float32 rows.
+Dequantization composes into the caller's jitted program (gather encoded
+rows + per-row side entries, decode in-register) — the encoded table is
+never materialized as f32.
+
+Codec contract (duck-typed; see :class:`Codec`):
+
+- ``name``: registry key.
+- ``storage_dtype``: numpy dtype of the encoded ``[N, D]`` payload — this
+  is what every tier (HBM shard, ICI stripe, host tail, H2D wire) holds.
+- ``bytes_per_elem``: payload bytes per element (wire-true ``trace.gbps``
+  accounting).
+- ``side_bytes_per_row``: bytes of per-row side tables (int8: fp32 scale +
+  zero = 8). Side tables stay device-resident (they are ~2% of an fp32
+  row at D=100) and never ride the H2D wire.
+- ``encode(arr) -> QuantizedRows`` (host, numpy in / numpy out).
+- ``decode(enc) -> np.ndarray`` — the host-side oracle; the in-jit path
+  must match it bit-for-bit (tests/test_quant.py pins this).
+- ``dequant(q, scale, zero)`` — the in-jit decode; plain jnp ops so it
+  traces into the caller's program (NOT jitted itself).
+
+Register custom codecs with :func:`register_codec`; anything satisfying
+the contract works end to end (QuantizedFeature, the pipeline, the
+scaling tables all go through the registry).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..shard_tensor import normalize_dtype
+
+
+class QuantizedRows(NamedTuple):
+    """Encoded rows + per-row side tables (a pytree: jit-traversable).
+
+    payload: ``[N, D]`` array in the codec's storage dtype.
+    scale/zero: ``[N]`` float32 per-row affine tables, or None for codecs
+    without side tables (fp32, bf16).
+    """
+
+    payload: Any
+    scale: Optional[Any] = None
+    zero: Optional[Any] = None
+
+
+class Codec:
+    """Base codec: the fp32 identity (useful as the baseline row of every
+    byte table, and as the template for custom codecs)."""
+
+    name = "fp32"
+    storage_dtype = np.dtype(np.float32)
+    bytes_per_elem = 4.0
+    side_bytes_per_row = 0.0
+
+    def row_bytes(self, dim: int) -> float:
+        """Total stored bytes per row (payload + side tables) — the unit of
+        hot-cache capacity accounting."""
+        return self.bytes_per_elem * dim + self.side_bytes_per_row
+
+    def capacity_multiplier(self, dim: int) -> float:
+        """How many encoded rows fit where one fp32 row did."""
+        return (4.0 * dim) / self.row_bytes(dim)
+
+    # ---------------------------------------------------------------- encode
+    def encode(self, arr) -> QuantizedRows:
+        return QuantizedRows(np.ascontiguousarray(arr, np.float32))
+
+    # ----------------------------------------------------------- host decode
+    def decode(self, enc: QuantizedRows) -> np.ndarray:
+        return np.asarray(enc.payload, np.float32)
+
+    # -------------------------------------------------------- in-jit decode
+    def dequant(self, q, scale=None, zero=None):
+        """Decode gathered rows inside the caller's jitted program.
+
+        ``q``: ``[..., D]`` encoded rows; ``scale``/``zero``: per-row side
+        entries broadcast over the last axis (``[...]``-shaped), or None.
+        """
+        return q.astype(jnp.float32)
+
+
+class Bf16Codec(Codec):
+    """Lossless-ish bfloat16 cast: 2x capacity, no side tables. bf16 keeps
+    f32's exponent range, so the cast never overflows — error is pure
+    mantissa rounding (rel ~2^-8), which GNN training shrugs off (the
+    existing ``Feature(dtype="bfloat16")`` tier relies on the same fact)."""
+
+    name = "bf16"
+    storage_dtype = normalize_dtype("bfloat16")
+    bytes_per_elem = 2.0
+    side_bytes_per_row = 0.0
+
+    def encode(self, arr) -> QuantizedRows:
+        return QuantizedRows(
+            np.ascontiguousarray(np.asarray(arr, np.float32).astype(self.storage_dtype))
+        )
+
+    def decode(self, enc: QuantizedRows) -> np.ndarray:
+        return np.asarray(enc.payload).astype(np.float32)
+
+
+class Int8Codec(Codec):
+    """Per-row affine int8: ``x ~ (q - zero) * scale`` with fp32 scale and
+    fp32 zero-POINT (q-space) side tables. 4x payload compression; max abs
+    error per element is ``~row_span / 508`` (q spans [-127, 127] over the
+    row's [min, max]) PLUS a few ulps of the row's magnitude — the fp32
+    output-representability floor, which only matters for rows whose
+    offset is huge relative to their span (|rmin| >> span: the q-space
+    zero is then large and its own fp32 rounding costs ~ulp(|row|) in
+    value space; measured <= 0.51*scale + ~2.5*ulp, pinned in tests).
+    A value-space offset (``q*s + rmin``) would shave that ulp term but
+    its decode is mul-then-add, which XLA contracts into an FMA under jit
+    (measured on the CPU backend, survives lax.optimization_barrier) —
+    breaking the bit-for-bit host/jit parity this codec guarantees; rows
+    that close to the fp32 floor gain nothing from any f32-output codec.
+
+    The decode is deliberately sub-then-mul, NOT mul-then-add: XLA fuses
+    ``q*s + z`` into an FMA under jit (measured 1-ulp drift vs numpy on
+    the CPU backend), while ``(q - z) * s`` admits no value-changing
+    fusion — so the in-jit fused dequant-gather matches the host decode
+    BIT-FOR-BIT on every backend (tests/test_quant.py pins it).
+
+    Safe when rows are not heavy-tailed WITHIN a row (the span sets the
+    grid): degree-normalized embeddings, one-hot-ish floats, and standard
+    feature matrices all qualify; rows mixing O(1) and O(1e4) magnitudes
+    do not — use bf16 there. docs/api.md carries the guidance table.
+    """
+
+    name = "int8"
+    storage_dtype = np.dtype(np.int8)
+    bytes_per_elem = 1.0
+    side_bytes_per_row = 8.0  # fp32 scale + fp32 zero-point
+
+    def encode(self, arr) -> QuantizedRows:
+        arr = np.ascontiguousarray(arr, np.float32)
+        rmin = arr.min(axis=1)
+        rmax = arr.max(axis=1)
+        span = rmax - rmin
+        pos = span > 0
+        scale = np.where(pos, span / np.float32(254.0), np.float32(1.0)).astype(
+            np.float32
+        )
+        with np.errstate(divide="ignore"):
+            inv = np.where(pos, np.float32(254.0) / span, np.float32(0.0)).astype(
+                np.float32
+            )
+        q = np.clip(
+            np.rint((arr - rmin[:, None]) * inv[:, None]) - 127.0, -127, 127
+        ).astype(np.int8)
+        # zero-point in q-space: decode(-127) lands on ~rmin. Constant rows
+        # (span 0) store q=0, scale=1, zero=-rmin -> decode EXACTLY rmin
+        zero = np.where(
+            pos, np.float32(-127.0) - rmin / scale, -rmin
+        ).astype(np.float32)
+        q[~pos] = 0
+        return QuantizedRows(q, scale, zero)
+
+    def decode(self, enc: QuantizedRows) -> np.ndarray:
+        q = np.asarray(enc.payload)
+        scale = np.asarray(enc.scale, np.float32)
+        zero = np.asarray(enc.zero, np.float32)
+        return (q.astype(np.float32) - zero[..., None]) * scale[..., None]
+
+    def dequant(self, q, scale=None, zero=None):
+        if scale is None or zero is None:
+            raise ValueError("int8 dequant needs per-row scale and zero tables")
+        return (q.astype(jnp.float32) - zero[..., None]) * scale[..., None]
+
+
+CODECS = {c.name: c for c in (Codec(), Bf16Codec(), Int8Codec())}
+
+
+def register_codec(codec) -> None:
+    """Add a custom codec to the registry (overwrites an existing name)."""
+    CODECS[codec.name] = codec
+
+
+def get_codec(codec: Union[str, Codec]):
+    """Resolve a codec name (or pass through an instance)."""
+    if isinstance(codec, str):
+        try:
+            return CODECS[codec]
+        except KeyError:
+            raise ValueError(
+                f"unknown codec {codec!r}; registered: {sorted(CODECS)}"
+            ) from None
+    return codec

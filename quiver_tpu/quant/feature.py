@@ -1,0 +1,283 @@
+"""QuantizedFeature — the tiered feature store over encoded rows.
+
+Composes with the existing :class:`quiver_tpu.feature.Feature` rather than
+reimplementing it: the degree-descending reorder happens HERE (so the
+per-row side tables stay aligned with the stored row order), then an inner
+``Feature`` tiers the ENCODED payload through the unchanged machinery —
+hot HBM prefix (``device_replicate``), ICI-striped clique
+(``p2p_clique_replicate``), cold host tail, budget math and IPC shims all
+reused with ``dtype = codec.storage_dtype``. Every tier therefore holds
+encoded rows, and the hot prefix covers up to
+``codec.capacity_multiplier(D)``x the rows the same HBM budget bought in
+fp32 (int8 at D=100: ~3.7x, realized at full residency — see the
+capacity-accounting note below).
+
+The wrapper quacks like ``Feature`` where the pipeline reads it
+(``shard_tensor``/``feature_order``/``dim``/``shape``/``dtype``), so
+``TieredFeaturePipeline(QuantizedFeature(...))`` works unchanged: the host
+cold gather runs the dtype-agnostic native byte engine over the encoded
+tail and the H2D upload ships storage-dtype rows — wire bytes shrink by
+the same factor. The train step decodes after the scatter
+(:func:`quiver_tpu.quant.lookup.quantized_tiered_lookup`).
+
+Capacity accounting: the per-row side tables (fp32 scale/zero over ALL N
+rows, int8 only) are device-replicated — at 8 B/row they are ~2% of an
+fp32 table at D=100 — so cold lookups never ship scale over the wire.
+Their full-N footprint is charged against ``device_cache_size`` FIRST
+(they are resident regardless of hot fraction); the remaining budget
+buys hot payload rows. :meth:`side_table_bytes` reports the footprint;
+the amortized per-row multiplier ``codec.capacity_multiplier(D)`` (what
+``scaling.quant_fetch_table`` tabulates) is realized at full residency.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..feature import Feature
+from ..shard_tensor import _device_of
+from ..utils import CSRTopo, IciTopo, parse_size, reindex_feature
+from .codecs import QuantizedRows, get_codec
+from .lookup import gather_dequant, quantized_tiered_lookup
+
+
+class QuantizedFeature:
+    """Tiered ``[N, D]`` feature store holding CODEC-ENCODED rows.
+
+    Constructor mirrors :class:`Feature` plus ``codec`` (a registry name —
+    ``"fp32"`` | ``"bf16"`` | ``"int8"`` — or any object satisfying the
+    codec contract, see ``quant.codecs``).
+    """
+
+    def __init__(
+        self,
+        codec: Union[str, object] = "int8",
+        rank: int = 0,
+        device_list: Optional[Sequence[int]] = None,
+        device_cache_size: Union[int, str] = 0,
+        cache_policy: str = "device_replicate",
+        csr_topo: Optional[CSRTopo] = None,
+    ):
+        self.codec = get_codec(codec)
+        self.rank = rank
+        self.device_list = list(device_list) if device_list else [rank]
+        self.device_cache_size = parse_size(device_cache_size)
+        if cache_policy == "ici_replicate":
+            cache_policy = "p2p_clique_replicate"
+        self.cache_policy = cache_policy
+        self.csr_topo = csr_topo
+        self.feature_order: Optional[np.ndarray] = None
+        self.inner: Optional[Feature] = None
+        self._n = 0
+        self._dim: Optional[int] = None
+        self._scale_np: Optional[np.ndarray] = None
+        self._zero_np: Optional[np.ndarray] = None
+        self._scale_dev = None
+        self._zero_dev = None
+        self._order_dev = None
+
+    # ------------------------------------------------------------------ build
+    def from_cpu_tensor(self, cpu_tensor) -> None:
+        """Ingest the f32 table: reorder (degree-descending when a
+        ``csr_topo`` is attached), encode, then tier the encoded payload
+        through an inner ``Feature``."""
+        arr = np.asarray(cpu_tensor, np.float32)
+        if arr.ndim != 2:
+            raise ValueError("features must be [N, D]")
+        self._n, self._dim = arr.shape
+        # honest HBM accounting: the per-row side tables span ALL N rows
+        # regardless of hot fraction (cold dequant-after-scatter reads them
+        # on device), so their full footprint is charged against the budget
+        # FIRST; the remainder buys hot payload rows. The amortized
+        # codec.row_bytes multiplier (3.70x at int8/D=100) is realized at
+        # full residency; small budgets pay the fixed side cost up front.
+        side_total = self.codec.side_bytes_per_row * self._n
+        if 0 < self.device_cache_size < side_total:
+            # a stated budget the side tables alone overflow is a config
+            # error, not a 0-hot-rows store: .scale/.zero would still put
+            # the full tables on device, silently exceeding the budget.
+            # (device_cache_size=0 stays the explicit all-cold opt-in —
+            # side tables ride along on first use, as documented.)
+            raise ValueError(
+                f"device_cache_size ({self.device_cache_size} B) cannot even "
+                f"hold the {self.codec.name} codec's device-resident side "
+                f"tables ({int(side_total)} B for N={self._n}); raise the "
+                "budget or use a sideless codec (bf16)"
+            )
+        payload_row_bytes = self._dim * np.dtype(self.codec.storage_dtype).itemsize
+        cache_rows = min(
+            int(max(0.0, self.device_cache_size - side_total) // payload_row_bytes),
+            self._n,
+        )
+        if self.csr_topo is not None:
+            # same hot-ratio policy as Feature.from_cpu_tensor, with rows
+            # priced at the CODEC's row bytes — the capacity multiplier is
+            # exactly what widens this ratio
+            if self.cache_policy == "p2p_clique_replicate":
+                clique = IciTopo.detect().get_clique(self.rank)
+                ratio = min(cache_rows * len(clique), self._n) / max(self._n, 1)
+            else:
+                ratio = cache_rows / max(self._n, 1)
+            arr, order = reindex_feature(self.csr_topo, arr, ratio)
+            self.feature_order = order
+            self.csr_topo.feature_order = order
+        enc = self.codec.encode(arr)
+        # the inner Feature re-derives cache_rows from ITS row bytes, so
+        # hand it exactly cache_rows * payload bytes (csr_topo=None: the
+        # reorder already happened here, against quant-priced capacity)
+        inner = Feature(
+            rank=self.rank,
+            device_list=self.device_list,
+            device_cache_size=cache_rows * payload_row_bytes,
+            cache_policy=self.cache_policy,
+            csr_topo=None,
+            dtype=self.codec.storage_dtype,
+        )
+        inner.from_cpu_tensor(enc.payload)
+        self.inner = inner
+        self._scale_np = None if enc.scale is None else np.asarray(enc.scale, np.float32)
+        self._zero_np = None if enc.zero is None else np.asarray(enc.zero, np.float32)
+        self._scale_dev = self._zero_dev = self._order_dev = None
+
+    # ------------------------------------------------------------- delegation
+    # the attribute surface TieredFeaturePipeline and tests read; the
+    # pipeline stages encoded rows without knowing the table is quantized
+    @property
+    def shard_tensor(self):
+        return None if self.inner is None else self.inner.shard_tensor
+
+    @property
+    def dtype(self):
+        return np.dtype(self.codec.storage_dtype)
+
+    @property
+    def shape(self):
+        return (self._n, self._dim)
+
+    @property
+    def dim(self) -> int:
+        return self._dim or 0
+
+    def size(self, axis: int) -> int:
+        return self.shape[axis]
+
+    @property
+    def hot_rows(self) -> int:
+        """Rows resident in this handle's HBM shards (the hot prefix)."""
+        st = self.shard_tensor
+        if st is None:
+            return 0
+        return sum(o.end - o.start for _, _, o in st.device_shards)
+
+    def side_table_bytes(self) -> int:
+        """Device-resident side-table footprint (0 for sideless codecs)."""
+        if self._scale_np is None:
+            return 0
+        return self._scale_np.nbytes + self._zero_np.nbytes
+
+    # ------------------------------------------------------------ side tables
+    @property
+    def scale(self):
+        """[N_stored] f32 scale table on this rank's device (None if the
+        codec has no side tables)."""
+        if self._scale_np is None:
+            return None
+        if self._scale_dev is None:
+            self._scale_dev = jax.device_put(
+                jnp.asarray(self._scale_np), _device_of(self.rank)
+            )
+        return self._scale_dev
+
+    @property
+    def zero(self):
+        if self._zero_np is None:
+            return None
+        if self._zero_dev is None:
+            self._zero_dev = jax.device_put(
+                jnp.asarray(self._zero_np), _device_of(self.rank)
+            )
+        return self._zero_dev
+
+    # ----------------------------------------------------------------- lookup
+    def __getitem__(self, node_idx) -> jax.Array:
+        """Eager tiered gather + decode by ORIGINAL node id: encoded rows
+        cross every tier boundary (ICI / H2D) at codec width, decode runs
+        on device over the gathered batch only. Invalid ids yield zero
+        rows (same contract as ``Feature.__getitem__``)."""
+        ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
+        invalid = (ids < 0) | (ids >= self._n)
+        safe = np.where(invalid, 0, ids)
+        stored = self.feature_order[safe] if self.feature_order is not None else safe
+        q = self.inner.shard_tensor[stored]
+        if self._scale_np is not None:
+            s = jnp.asarray(self._scale_np[stored])
+            z = jnp.asarray(self._zero_np[stored])
+            x = self.codec.dequant(q, s, z)
+        else:
+            x = self.codec.dequant(q)
+        if invalid.any():
+            x = x * jnp.asarray(~invalid, x.dtype)[:, None]
+        return x
+
+    def lookup_padded(
+        self, node_idx: jax.Array, valid: Optional[jax.Array] = None
+    ) -> jax.Array:
+        """Jit-friendly fused dequant-gather for fully HBM-resident tables
+        (same residency requirement and id-CLIP semantics as
+        ``Feature.lookup_padded``; see ``validate_ids`` for the strict
+        opt-in check)."""
+        st = self.shard_tensor
+        if st is None or st.cpu_tensor is not None or len(st.device_shards) != 1:
+            raise ValueError(
+                "lookup_padded needs a fully HBM-resident feature; "
+                "use __getitem__ (tiered) or the quantized pipeline"
+            )
+        table = st.device_shards[0][1]
+        ids = node_idx
+        if self.feature_order is not None:
+            if self._order_dev is None:
+                self._order_dev = jnp.asarray(self.feature_order)
+            ids = jnp.take(
+                self._order_dev,
+                jnp.clip(ids, 0, self._order_dev.shape[0] - 1),
+            )
+        rows = gather_dequant(self.codec, table, ids, self.scale, self.zero)
+        if valid is not None:
+            rows = rows * valid[:, None].astype(rows.dtype)
+        return rows
+
+    def validate_ids(self, node_idx) -> np.ndarray:
+        """Opt-in strict id validation (host-side); see
+        :meth:`Feature.validate_ids`."""
+        from ..feature import validate_lookup_ids
+
+        return validate_lookup_ids(node_idx, self._n)
+
+    def decode_rows(self, node_idx) -> np.ndarray:
+        """Host-side oracle decode by ORIGINAL node id (numpy end to end;
+        the bit-for-bit reference the fused paths are tested against).
+        Requires the encoded payload to be host-reachable only through the
+        shard book — it re-gathers via ``__getitem__`` semantics on host
+        tiers; intended for tests/debugging, not the hot path."""
+        ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
+        invalid = (ids < 0) | (ids >= self._n)
+        safe = np.where(invalid, 0, ids)
+        stored = self.feature_order[safe] if self.feature_order is not None else safe
+        st = self.inner.shard_tensor
+        q = np.asarray(st[stored])  # gather through the tiers, then host math
+        enc = QuantizedRows(
+            q,
+            None if self._scale_np is None else self._scale_np[stored],
+            None if self._zero_np is None else self._zero_np[stored],
+        )
+        x = self.codec.decode(enc)
+        if not x.flags.writeable:
+            # identity decodes (fp32) hand back the read-only jax view
+            x = x.copy()
+        x[invalid] = 0.0
+        return x

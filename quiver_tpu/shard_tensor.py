@@ -189,6 +189,18 @@ class ShardTensor:
         dev_rows = sum(o.end - o.start for _, _, o in self.device_shards)
         return dev_rows / max(self._n_rows, 1)
 
+    def tier_bytes(self) -> Dict[str, int]:
+        """Actual byte footprint per tier at the STORED dtype — what the
+        quantized capacity tables (`scaling.quant_fetch_table`) predict and
+        tests verify: an int8 store's hot shard holds 4x the rows of an
+        fp32 store in the same device bytes."""
+        row = (self._dim or 0) * self.dtype.itemsize
+        dev = sum((o.end - o.start) * row for _, _, o in self.device_shards)
+        host = 0 if self.cpu_tensor is None else (
+            (self.cpu_offset.end - self.cpu_offset.start) * row
+        )
+        return {"device": dev, "host": host, "row": row}
+
     # ----------------------------------------------------------------- gather
     def __getitem__(self, ids) -> jax.Array:
         """Gather rows by global id onto ``current_device``.

@@ -112,8 +112,30 @@ def seps(sampled_edges: int, seconds: float) -> float:
     return sampled_edges / max(seconds, 1e-12)
 
 
-def gbps(num_rows: int, feature_dim: int, seconds: float, bytes_per_elem: int = 4) -> float:
-    """Feature-collection throughput in GB/s (reference bench_feature.py:44-46)."""
+def dtype_bytes(dtype) -> int:
+    """Bytes per element for a dtype spelling ("float32", "bfloat16",
+    np.int8, a numpy dtype, ...) — the helper quantized benches use so
+    `gbps` reports WIRE bytes, not fp32-equivalent bytes. For a codec,
+    pass ``codec.bytes_per_elem`` directly instead (int8 payload = 1)."""
+    import numpy as np
+
+    if str(dtype) in ("bfloat16", "bf16"):
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.bfloat16).itemsize
+    return np.dtype(dtype).itemsize
+
+
+def gbps(
+    num_rows: int, feature_dim: int, seconds: float, bytes_per_elem: float = 4
+) -> float:
+    """Feature-collection throughput in GB/s (reference bench_feature.py:44-46).
+
+    ``bytes_per_elem`` must be the TRUE stored/wire width of the gathered
+    rows — `dtype_bytes(table.dtype)` for plain tables, the codec's
+    ``bytes_per_elem`` for quantized ones (may be fractional for packed
+    codecs). The fp32 default exists for reference parity only; a quant
+    bench that leaves it at 4 reports fantasy bandwidth."""
     return num_rows * feature_dim * bytes_per_elem / max(seconds, 1e-12) / 1e9
 
 

@@ -1,0 +1,148 @@
+"""Synthetic accuracy + byte probe for the quantized feature store.
+
+Trains the hermetic community-graph task twice through the REAL tiered
+prefetch pipeline — fp32 `Feature` vs int8 `QuantizedFeature` (same
+sampler seed, same init, same HBM byte budget) — and reports whether the
+int8 loss curve tracks fp32 within tolerance, plus the measured wire
+bytes each run actually staged and a fused dequant-gather rate on the
+current backend. This is the runnable form of
+tests/test_quant.py::test_int8_e2e_matches_fp32_loss_curve; on a real
+TPU, bench.py's `quant_int8_*` context rows carry the hardware rates.
+
+Usage: JAX_PLATFORMS=cpu python scripts/quant_probe.py [--steps 12]
+Prints ONE json line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def community_graph(n_comm=4, per_comm=40, intra=6, seed=0):
+    rng = np.random.default_rng(seed)
+    n = n_comm * per_comm
+    src, dst = [], []
+    for u in range(n):
+        cu = u // per_comm
+        for v in rng.choice(per_comm, intra, replace=False) + cu * per_comm:
+            src.append(u)
+            dst.append(int(v))
+    feat = rng.standard_normal((n, 16)).astype(np.float32)
+    labels = (np.arange(n) // per_comm).astype(np.int32)
+    return np.stack([np.array(src), np.array(dst)]), feat, labels, n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--tol", type=float, default=0.25)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu import CSRTopo, Feature, QuantizedFeature
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.pipeline import (
+        TieredFeaturePipeline,
+        TrainPipeline,
+        make_tiered_train_step,
+    )
+    from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+    from quiver_tpu.quant import get_codec, make_quantized_train_step
+    from quiver_tpu.trace import gbps
+
+    edge_index, feat, labels, n = community_graph()
+    dim = feat.shape[1]
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, n, 32).astype(np.int64) for _ in range(args.steps)]
+    lab = jnp.asarray(labels)
+    budget_rows = n // 2
+    c8 = get_codec("int8")
+
+    def run(feature, step_maker):
+        topo = CSRTopo(edge_index=edge_index)
+        sampler = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=1)
+        model = GraphSAGE(hidden_dim=32, out_dim=4, num_layers=2, dropout=0.0)
+        tx = optax.adam(5e-3)
+        pipe = TieredFeaturePipeline(feature)
+        step_fn = step_maker(model, tx, pipe)
+        ds0 = sampler.sample_dense(batches[0])
+        x0 = jnp.zeros((ds0.n_id.shape[0], dim), jnp.float32)
+        params = model.init(jax.random.key(0), x0, ds0.adjs)
+        opt_state = tx.init(params)
+        tp = TrainPipeline(sampler, feature, step_fn, tiered=pipe)
+        _, _, losses = tp.run_epoch(batches, params, opt_state, jax.random.key(1))
+        # wire bytes actually staged: cold rows x D x stored element width
+        wire = tp.stats.cold_rows * dim * int(np.dtype(feature.dtype).itemsize)
+        return np.asarray(losses), tp.stats.cold_rows, wire
+
+    f32 = Feature(rank=0, device_list=[0], device_cache_size=budget_rows * dim * 4)
+    f32.from_cpu_tensor(feat)
+    losses_f, cold_f, wire_f = run(
+        f32, lambda m, tx, p: make_tiered_train_step(m, tx, lab, p.hot_table)
+    )
+
+    q8 = QuantizedFeature(
+        "int8", rank=0,
+        # full-N side tables are charged at ingest; this buys exactly
+        # budget_rows of hot int8 payload (same hot set as the fp32 run)
+        device_cache_size=int(n * c8.side_bytes_per_row + budget_rows * dim),
+    )
+    q8.from_cpu_tensor(feat)
+    losses_q, cold_q, wire_q = run(
+        q8,
+        lambda m, tx, p: make_quantized_train_step(
+            m, tx, lab, p.hot_table, q8.scale, q8.zero, codec="int8"
+        ),
+    )
+
+    # fused dequant-gather rate on THIS backend (CPU mesh unless run on TPU):
+    # wire-true bytes via trace.gbps(bytes_per_elem=codec)
+    from quiver_tpu.quant import gather_dequant
+
+    enc = c8.encode(feat)
+    payload = jnp.asarray(enc.payload)
+    scale, zero = jnp.asarray(enc.scale), jnp.asarray(enc.zero)
+    ids = jnp.asarray(rng.integers(0, n, 4096).astype(np.int32))
+    g = jax.jit(lambda p, i, s, z: gather_dequant(c8, p, i, s, z))
+    np.asarray(g(payload, ids, scale, zero))  # compile + warm
+    iters = 50
+    t0 = time.time()
+    acc = None
+    for _ in range(iters):
+        acc = g(payload, ids, scale, zero)
+    jax.block_until_ready(acc)
+    dt = time.time() - t0
+    rate_wire = gbps(iters * ids.shape[0], dim, dt, bytes_per_elem=c8.bytes_per_elem)
+
+    diff = np.abs(losses_q - losses_f)
+    out = {
+        "metric": "quant_int8_vs_fp32_probe",
+        "steps": args.steps,
+        "loss_fp32": [round(float(x), 5) for x in losses_f],
+        "loss_int8": [round(float(x), 5) for x in losses_q],
+        "max_abs_loss_diff": round(float(diff.max()), 5),
+        "final4_mean_diff": round(
+            float(abs(losses_q[-4:].mean() - losses_f[-4:].mean())), 5
+        ),
+        "within_tol": bool(diff.max() < args.tol),
+        "int8_learns": bool(losses_q[-4:].mean() < losses_q[:4].mean()),
+        "cold_rows": {"fp32": int(cold_f), "int8": int(cold_q)},
+        "cold_wire_bytes": {"fp32": int(wire_f), "int8": int(wire_q)},
+        "hot_capacity_multiplier": round(c8.capacity_multiplier(dim), 3),
+        "gather_gbps_wire_int8": round(rate_wire, 4),
+        "backend": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,9 @@ def main():
         ShapeMesh,
         format_fetch_markdown,
         format_markdown,
+        format_quant_markdown,
         products_scaling_table,
+        quant_fetch_table,
         sharded_fetch_table,
     )
 
@@ -66,8 +68,16 @@ def main():
         "(host=2,dp=2,ici=2, products config)\n\n"
         + format_fetch_markdown(fetch_rows)
     )
+    # per-codec quantized feature-store rows (quiver_tpu.quant): hot-cache
+    # capacity multiplier + gather/H2D byte reduction at the products config
+    quant_rows = quant_fetch_table((15, 10, 5), 1024, 100)
+    quant_md = (
+        "## Quantized feature store: per-codec capacity / byte table "
+        "(products config, D=100)\n\n" + format_quant_markdown(quant_rows)
+    )
     print(md, file=sys.stderr)
     print("\n" + fetch_md, file=sys.stderr)
+    print("\n" + quant_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -79,12 +89,15 @@ def main():
             f"Single-chip step source: {source}.\n\n"
         )
         with open(args.out, "w") as fh:
-            fh.write(header + md + "\n\n" + fetch_md + "\n")
+            fh.write(
+                header + md + "\n\n" + fetch_md + "\n\n" + quant_md + "\n"
+            )
     print(json.dumps({
         "step_s_1chip": step_s,
         "source": source,
         "rows": [r._asdict() for r in rows],
         "sharded_fetch": [r._asdict() for r in fetch_rows],
+        "quant_fetch": [r._asdict() for r in quant_rows],
     }))
 
 

@@ -187,6 +187,65 @@ def test_feature_set_mmap_file(tmp_path, table):
     np.testing.assert_allclose(got[4], np.zeros(16))
 
 
+def test_lookup_padded_clip_semantics_direct(table):
+    """Pin the jit path's out-of-range contract (feature.py _padded_gather):
+    ids are silently jnp.clip'ed — negatives land on row 0, ids >= N on the
+    LAST row. This is deliberate (a data-dependent raise cannot exist in an
+    XLA program); validate_ids is the strict opt-in."""
+    import jax.numpy as jnp
+
+    feat = Feature(rank=0, device_list=[0], device_cache_size=500 * 16 * 4)
+    feat.from_cpu_tensor(table)
+    got = np.asarray(feat.lookup_padded(jnp.asarray(np.array([-5, 0, 499, 500, 10_000]))))
+    np.testing.assert_allclose(got[0], table[0])     # negative -> row 0
+    np.testing.assert_allclose(got[3], table[499])   # N -> last row
+    np.testing.assert_allclose(got[4], table[499])   # >> N -> last row
+    np.testing.assert_allclose(got[1:3], table[[0, 499]])
+
+
+def test_lookup_padded_clip_semantics_remapped(table):
+    """Same pin for the feature_order-remapped path (_padded_gather_ordered):
+    the CLIP happens in ORIGINAL id space first, so an oob id resolves to
+    the clamped original id's row — bit-identical to looking up id N-1."""
+    import jax.numpy as jnp
+
+    edge_index = make_random_graph(500, 4000, seed=9)
+    topo = CSRTopo(edge_index=edge_index)
+    feat = Feature(
+        rank=0, device_list=[0], device_cache_size=500 * 16 * 4, csr_topo=topo
+    )
+    feat.from_cpu_tensor(table)
+    assert feat.feature_order is not None
+    got = np.asarray(feat.lookup_padded(jnp.asarray(np.array([700, 499, -3, 0]))))
+    np.testing.assert_allclose(got[0], table[499])  # oob -> clamped id 499's row
+    np.testing.assert_allclose(got[1], table[499])
+    np.testing.assert_allclose(got[2], table[0])    # negative -> id 0's row
+    np.testing.assert_allclose(got[3], table[0])
+
+
+def test_validate_ids_opt_in(table):
+    """The strict helper: raises naming the bad count/examples where the
+    lookup paths stay silent — both the direct and the local-order paths."""
+    import pytest
+
+    feat = Feature(rank=0, device_list=[0], device_cache_size=500 * 16 * 4)
+    feat.from_cpu_tensor(table)
+    ok = feat.validate_ids(np.array([0, 17, 499]))
+    assert ok.dtype == np.int64 and ok.tolist() == [0, 17, 499]
+    with pytest.raises(ValueError, match=r"2 of 4 .*examples: \[-1, 500\]"):
+        feat.validate_ids(np.array([-1, 0, 500, 499]))
+
+    # distributed remap: unowned globals are invalid even when in range
+    dist = Feature(rank=0, device_list=[0], device_cache_size=10 * 16 * 4)
+    dist.from_cpu_tensor(table[:10])
+    dist.set_local_order(np.arange(10, 20, dtype=np.int64))
+    dist.validate_ids(np.array([10, 19]))
+    with pytest.raises(ValueError, match="owned global ids"):
+        dist.validate_ids(np.array([3, 12]))  # 3 is in [0, map) but unowned
+    with pytest.raises(ValueError, match="owned global ids"):
+        dist.validate_ids(np.array([10_000]))
+
+
 def test_native_gather_rows_any_dtype():
     """The byte-row native gather serves every C-contiguous dtype (the
     reference kernel is float32-only, quiver_feature.cu:65-69); bf16 cold

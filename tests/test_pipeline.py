@@ -236,6 +236,57 @@ def test_train_pipeline_checkpoint_and_resume(tmp_path):
         TrainPipeline(sampler, f, step_fn, tiered=pipe, checkpoint_every=5)
 
 
+def test_pipeline_stage_error_shuts_down_and_reraises():
+    """A prefetch stage raising mid-epoch must surface the ORIGINAL error
+    promptly (pools cancelled + shut down) instead of hanging the iterator
+    — and the pipeline must stay usable for a fresh epoch afterwards
+    (each _run builds fresh pools)."""
+    edge_index, feat, labels, n = community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    f = Feature(rank=0, device_list=[0],
+                device_cache_size=(n // 2) * feat.shape[1] * 4,
+                cache_policy="device_replicate", csr_topo=topo)
+    f.from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=1)
+    model = GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
+    tx = optax.adam(5e-3)
+    pipe = TieredFeaturePipeline(f)
+    step_fn = make_tiered_train_step(model, tx, jnp.asarray(labels), pipe.hot_table)
+
+    rng = np.random.default_rng(0)
+    batches = [rng.integers(0, n, 32).astype(np.int64) for _ in range(6)]
+    ds0 = sampler.sample_dense(batches[0])
+    x0 = jnp.zeros((ds0.n_id.shape[0], feat.shape[1]), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    opt_state = tx.init(params)
+
+    tp = TrainPipeline(sampler, f, step_fn, depth=2, tiered=pipe)
+
+    def exploding_samples():
+        # two good batches, then the SAMPLE stage blows up mid-epoch
+        # (depth+2 chains are already in flight when it does)
+        yield sampler.sample_dense(batches[0])
+        yield sampler.sample_dense(batches[1])
+        raise RuntimeError("sampler exploded mid-epoch")
+
+    with pytest.raises(RuntimeError, match="sampler exploded mid-epoch"):
+        tp.run_epoch_iter(exploding_samples(), params, opt_state, jax.random.key(1))
+
+    # the step raising propagates the same way
+    def bad_step(p, o, k, b):
+        raise RuntimeError("step exploded")
+
+    tp_bad = TrainPipeline(sampler, f, bad_step, depth=2, tiered=pipe)
+    with pytest.raises(RuntimeError, match="step exploded"):
+        tp_bad.run_epoch(batches, params, opt_state, jax.random.key(1))
+
+    # and a fresh epoch on the surviving pipeline still trains cleanly
+    params2, opt2, losses = tp.run_epoch(
+        batches[:3], params, opt_state, jax.random.key(2)
+    )
+    assert len(losses) == 3 and all(np.isfinite(losses))
+
+
 def test_train_pipeline_depth2_matches_depth1():
     """depth=2 stages two batches ahead (generator serialized by a lock);
     same sampler seed + same key must give the same loss sequence as
