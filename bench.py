@@ -811,6 +811,70 @@ def bench_tiered_pipeline(
         context["tiered_overlap_step_spans"] = step_spans
 
 
+def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
+    """Online serving engine (`quiver_tpu.serve`) on the products graph:
+    closed-loop Zipfian replay through the REAL micro-batcher + coalescer +
+    embedding cache, at two skews. One fixed bucket (64) keeps this to ONE
+    compile; the per-dispatch RPC floor (`context["rpc_floor_s"]`) bounds
+    every latency number in this tunneled environment — read the hit-rate /
+    coalescing / dispatch-count columns as the hardware-true signal and the
+    QPS as a floor (a co-located host skips the tunnel entirely)."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.pyg import GraphSageSampler
+    from quiver_tpu.serve import ServeConfig, ServeEngine, zipfian_trace
+
+    topo = CSRTopo(indptr=indptr_np, indices=indices_np)
+    n_nodes = topo.node_count
+    model = GraphSAGE(hidden_dim=256, out_dim=47, num_layers=3, dropout=0.0)
+
+    def make_sampler():
+        return GraphSageSampler(
+            topo, sizes=[15, 10, 5], mode="TPU", caps=caps, seed=11
+        )
+
+    s0 = make_sampler()
+    ds0 = s0.sample_dense(np.arange(64, dtype=np.int64))
+    params = model.init(
+        jax.random.key(3),
+        jnp.zeros((ds0.n_id.shape[0], table.shape[1]), jnp.float32),
+        ds0.adjs,
+    )
+    for alpha in (0.0, 0.99):
+        eng = ServeEngine(
+            model, params, make_sampler(), table,
+            ServeConfig(max_batch=64, buckets=(64,), max_delay_ms=2.0,
+                        cache_entries=1 << 16),
+        )
+        # warm the single bucket's compile off the clock, then reset counters
+        eng.predict(np.arange(64, dtype=np.int64))
+        eng.cache.invalidate()
+        eng.reset_stats()
+        trace = zipfian_trace(n_nodes, n_requests, alpha=alpha, seed=17)
+        t0 = time.time()
+        eng.predict(trace)
+        wall = time.time() - t0
+        s = eng.stats
+        lat = s.latency.snapshot()
+        key = f"serve_zipf{alpha:g}"
+        context[f"{key}_qps"] = round(n_requests / wall, 1)
+        context[f"{key}_p50_ms"] = round(lat["p50_ms"], 2)
+        context[f"{key}_p95_ms"] = round(lat["p95_ms"], 2)
+        context[f"{key}_p99_ms"] = round(lat["p99_ms"], 2)
+        context[f"{key}_cache_hit_rate"] = round(s.cache.hit_rate, 4)
+        context[f"{key}_dispatches"] = s.dispatches
+        context[f"{key}_coalesced"] = s.coalesced
+        log(
+            f"serve zipf={alpha}: {n_requests / wall:.0f} QPS, p50/p95/p99 "
+            f"{lat['p50_ms']:.1f}/{lat['p95_ms']:.1f}/{lat['p99_ms']:.1f} ms, "
+            f"hit rate {s.cache.hit_rate:.0%}, {s.dispatches} dispatches, "
+            f"{s.coalesced} coalesced"
+        )
+
+
 def wait_for_backend(max_wait_s=None):
     """The axon tunnel can be down for stretches (observed: hours). Probe
     backend health in a SUBPROCESS (in-process init failures are cached by
@@ -985,6 +1049,13 @@ def main():
             log("budget exhausted before tiered pipeline bench")
     except Exception as exc:
         log(f"tiered pipeline bench failed: {exc}")
+    try:
+        if remaining() > 120:
+            bench_serve(context, indptr_np, indices_np, table, caps)
+        else:
+            log("budget exhausted before serve bench")
+    except Exception as exc:
+        log(f"serve bench failed: {exc}")
 
     seps_fused = results.get("fused", 0.0)
     print(

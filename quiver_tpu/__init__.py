@@ -28,7 +28,9 @@ from .partition import (
 )
 from . import comm, pyg, trace
 from . import quant
+from . import serve
 from .quant import QuantizedFeature
+from .serve import ServeConfig, ServeEngine
 from .comm import HostRankTable, NcclComm, TpuComm, getNcclId
 from .pipeline import (
     TieredBatch,
@@ -66,6 +68,9 @@ __all__ = [
     "pyg",
     "quant",
     "QuantizedFeature",
+    "serve",
+    "ServeConfig",
+    "ServeEngine",
     "inference",
     "quiver_partition_feature",
     "reindex_by_config",

@@ -118,6 +118,55 @@ def _cached_apply(model):
         return jax.jit(lambda p, x, adjs: model.apply(p, x, adjs))
 
 
+def pad_seed_batch(
+    batch: np.ndarray, batch_size: int, out: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Pad a 1-D seed batch up to ``batch_size`` by repeating ``batch[-1]``
+    (the convention every fixed-shape eval/serve path here uses — the
+    duplicate rows are sliced off after the forward). Pass ``out`` to reuse
+    one buffer across a loop instead of allocating per batch."""
+    batch = np.asarray(batch)
+    if batch.shape[0] == 0:
+        raise ValueError("cannot pad an empty seed batch")
+    if batch.shape[0] > batch_size:
+        raise ValueError(f"batch of {batch.shape[0]} exceeds batch_size={batch_size}")
+    if out is None or out.shape[0] != batch_size or out.dtype != batch.dtype:
+        out = np.empty(batch_size, batch.dtype)
+    out[: batch.shape[0]] = batch
+    out[batch.shape[0] :] = batch[-1]
+    return out
+
+
+def lookup_features(feature, n_id, ids_out: Optional[np.ndarray] = None):
+    """Feature rows for a sampled ``n_id`` — one helper for every consumer
+    (``sampled_eval``, the serve engine): raw ``[N, D]`` numpy tables get the
+    clip-and-take path (``ids_out`` reuses the clipped-id buffer across
+    calls), quiver ``Feature``/``QuantizedFeature`` objects their tiered
+    ``__getitem__``."""
+    if isinstance(feature, np.ndarray):
+        ids = np.asarray(n_id)
+        if ids_out is not None and ids_out.shape == ids.shape:
+            np.clip(ids, 0, feature.shape[0] - 1, out=ids_out)
+            ids = ids_out
+        else:
+            ids = np.clip(ids, 0, feature.shape[0] - 1)
+        return jnp.asarray(feature[ids])
+    return feature[n_id]
+
+
+def batch_logits(
+    apply, params, sampler, feature, padded_batch, ids_out=None
+) -> jax.Array:
+    """One fixed-shape eval step: sample ``padded_batch`` with ``sampler``,
+    gather its features, run the jitted ``apply``. This IS the unbatched
+    `sampled_eval` inner loop — the serve engine dispatches through the same
+    function, which is what makes served logits bit-identical to offline
+    eval on the same (sampler state, batch) pair."""
+    ds = sampler.sample_dense(padded_batch)
+    x = lookup_features(feature, ds.n_id, ids_out=ids_out)
+    return apply(params, x, ds.adjs)
+
+
 def sampled_eval(
     model,
     params,
@@ -134,18 +183,17 @@ def sampled_eval(
     labels = np.asarray(labels)
     correct = 0
     apply = _cached_apply(model)
+    # hoisted per-batch work: one padded seed buffer reused across the loop
+    # (pad_seed_batch writes in place) and one clipped-id buffer for the
+    # raw-table path, allocated lazily at the first batch's n_id shape
+    seed_buf = np.empty(batch_size, nodes.dtype)
+    ids_buf: Optional[np.ndarray] = None
     for lo in range(0, nodes.shape[0], batch_size):
-        batch = nodes[lo : lo + batch_size]
-        if batch.shape[0] < batch_size:  # pad to keep one compiled shape
-            batch = np.concatenate(
-                [batch, np.full(batch_size - batch.shape[0], batch[-1], batch.dtype)]
-            )
+        batch = pad_seed_batch(nodes[lo : lo + batch_size], batch_size, out=seed_buf)
         ds = sampler.sample_dense(batch)
-        if isinstance(feature, np.ndarray):  # raw [N, D] table
-            ids = np.clip(np.asarray(ds.n_id), 0, feature.shape[0] - 1)
-            x = jnp.asarray(feature[ids])
-        else:  # quiver Feature (tiered lookup)
-            x = feature[ds.n_id]
+        if isinstance(feature, np.ndarray) and ids_buf is None:
+            ids_buf = np.empty(np.asarray(ds.n_id).shape, np.asarray(ds.n_id).dtype)
+        x = lookup_features(feature, ds.n_id, ids_out=ids_buf)
         logits = apply(params, x, ds.adjs)
         pred = np.asarray(jnp.argmax(logits, axis=-1))[: min(batch_size, nodes.shape[0] - lo)]
         correct += int((pred == labels[nodes[lo : lo + batch_size]]).sum())

@@ -484,6 +484,100 @@ def format_quant_markdown(rows: Sequence[QuantPrediction]) -> str:
     return "\n".join(lines)
 
 
+class ServePrediction(NamedTuple):
+    bucket: int            # dispatched batch shape
+    hit_rate: float        # embedding-cache hit rate
+    unique_frac: float     # unique seeds / requests among cache misses
+    dispatch_s: float      # sample + gather + forward per bucket dispatch
+    requests_per_dispatch: float
+    qps: float             # sustainable device-bound throughput
+    device_us_per_request: float
+    floor_p50_ms: float    # latency floor: half the flush window + dispatch
+
+
+def serve_table(
+    t_sample_s: float,
+    t_gather_s: float,
+    t_forward_s: float,
+    ref_batch: int,
+    buckets: Sequence[int] = (8, 32, 64),
+    hit_rates: Sequence[float] = (0.0, 0.5, 0.9),
+    unique_frac: float = 0.8,
+    max_delay_ms: float = 2.0,
+) -> List[ServePrediction]:
+    """Analytic QPS model for the online serving engine
+    (`quiver_tpu.serve.ServeEngine`) from MEASURED per-batch costs.
+
+    The engine's device work per dispatch is exactly one offline eval step
+    (`inference.batch_logits`): sample + gather + forward at the bucket
+    shape. Feed the three measured costs at a reference batch ``ref_batch``
+    (bench.py's sampling/feature/e2e sections, or scripts/serve_probe.py on
+    CPU); they are scaled to each bucket linearly in batch rows — honest at
+    large shapes because all three paths are descriptor/row-count bound,
+    not occupancy bound (PERF_NOTES.md), but OPTIMISTIC for tiny buckets:
+    the linear model omits the fixed per-dispatch overhead (kernel launch,
+    host sync — and in this tunneled setup the 0.06-0.13 s RPC floor,
+    `bench.py` context ``rpc_floor_s``), which does not shrink with batch
+    and dominates small dispatches. Read small-bucket rows as ceilings on
+    dispatch speed, large-bucket rows as floors when the cost input is a
+    train step (which additionally pays backward + update).
+
+    Request algebra: of R incoming requests/s, ``(1-hit_rate)`` miss the
+    embedding cache and ``unique_frac`` of those survive coalescing, so one
+    bucket-B dispatch retires ``B / ((1-hit_rate) * unique_frac)`` requests.
+    Sustainable QPS is that over the dispatch time; the p50 latency floor
+    is half the flush window plus one dispatch (a request arrives mid-
+    window on average, then rides the next flush).
+    """
+    rows: List[ServePrediction] = []
+    per_seed = (t_sample_s + t_gather_s + t_forward_s) / max(ref_batch, 1)
+    for b in buckets:
+        t_dispatch = per_seed * b
+        for h in hit_rates:
+            miss = (1.0 - h) * unique_frac
+            rpd = b / miss if miss > 0 else math.inf
+            qps = rpd / t_dispatch
+            rows.append(
+                ServePrediction(
+                    bucket=b,
+                    hit_rate=h,
+                    unique_frac=unique_frac,
+                    dispatch_s=t_dispatch,
+                    requests_per_dispatch=rpd,
+                    qps=qps,
+                    device_us_per_request=(
+                        0.0 if math.isinf(rpd) else t_dispatch / rpd * 1e6
+                    ),
+                    floor_p50_ms=max_delay_ms / 2 + t_dispatch * 1e3,
+                )
+            )
+    return rows
+
+
+def format_serve_markdown(rows: Sequence[ServePrediction]) -> str:
+    lines = [
+        "| bucket | cache hit | req/dispatch | dispatch ms | QPS | device us/req | p50 floor ms |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        rpd = "inf" if math.isinf(r.requests_per_dispatch) else f"{r.requests_per_dispatch:.0f}"
+        qps = "inf" if math.isinf(r.qps) else f"{r.qps:.0f}"
+        lines.append(
+            f"| {r.bucket} | {r.hit_rate:.0%} | {rpd} "
+            f"| {r.dispatch_s*1e3:.2f} | {qps} "
+            f"| {r.device_us_per_request:.1f} | {r.floor_p50_ms:.2f} |"
+        )
+    lines.append("")
+    lines.append(
+        "QPS = bucket / ((1-hit)*unique_frac) / dispatch_s — device-bound "
+        "ceiling, ignores host queueing; p50 floor = max_delay_ms/2 + one "
+        "dispatch. Costs scale linearly from the measured reference batch "
+        "(row-count-bound regime, PERF_NOTES.md); the serving engine's "
+        "measured counterpart is scripts/serve_probe.py / bench.py serve."
+    )
+    return "\n".join(lines)
+
+
 def format_markdown(rows: Sequence[LayoutPrediction], step_s_1chip: float,
                     bandwidths: Optional[Dict[str, float]] = None) -> str:
     bw = dict(DEFAULT_BANDWIDTHS)

@@ -1,0 +1,32 @@
+"""quiver_tpu.serve — online inference engine.
+
+Turns individual node-prediction requests into efficient fixed-shape device
+work: dynamic micro-batching (bucketed pad-to-fixed shapes, one compiled
+program per bucket), cross-request coalescing (identical seeds within a
+flush window share one sample/gather/forward), and a params-versioned
+embedding cache (hot nodes served from host memory; `update_params`
+invalidates). See `engine.py` for the design and docs/api.md "Online
+serving" for the contract.
+"""
+
+from .cache import EmbeddingCache
+from .engine import (
+    ServeConfig,
+    ServeEngine,
+    ServeResult,
+    ServeStats,
+    default_buckets,
+)
+from .trace_gen import poisson_arrivals, trace_skew_stats, zipfian_trace
+
+__all__ = [
+    "EmbeddingCache",
+    "ServeConfig",
+    "ServeEngine",
+    "ServeResult",
+    "ServeStats",
+    "default_buckets",
+    "poisson_arrivals",
+    "trace_skew_stats",
+    "zipfian_trace",
+]

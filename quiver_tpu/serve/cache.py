@@ -1,0 +1,90 @@
+"""Params-versioned embedding cache for the online serving engine.
+
+Serving traffic is skewed — a Zipf-0.99 trace sends >50% of requests to a
+few percent of nodes (scripts/serve_probe.py measures it) — so the cheapest
+"device work" is the dispatch that never happens: repeat requests for a hot
+node are answered straight from host memory. Correctness hinges on the
+cache never outliving the weights that produced its entries, hence every
+entry is keyed by ``(node_id, params_version)`` and the engine bumps the
+version (and calls :meth:`EmbeddingCache.invalidate`) on every weight
+update. A stale-versioned entry is treated as a miss and dropped on touch,
+so even a racing insert from an in-flight flush of the previous version can
+never be served.
+
+Note the semantics the engine documents: a served result may be CACHE-AGED
+— computed any time since the current ``params_version`` was installed —
+but never crosses a version boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Hashable, Optional
+
+import numpy as np
+
+from ..trace import HitRateCounter
+
+
+class EmbeddingCache:
+    """LRU of computed embeddings/logits keyed by ``(node_id,
+    params_version)``.
+
+    One entry per node id: a put under a newer version overwrites the
+    node's older entry (the old value could never be served again anyway).
+    ``capacity`` counts entries (rows), not bytes — the engine sizes it as
+    ``cache_entries``. Thread-safe; hit/miss/eviction counters live in
+    ``self.counters`` (:class:`quiver_tpu.trace.HitRateCounter`).
+    """
+
+    def __init__(self, capacity: int, counters: Optional[HitRateCounter] = None):
+        if capacity < 0:
+            raise ValueError("capacity must be >= 0")
+        self.capacity = int(capacity)
+        self.counters = counters if counters is not None else HitRateCounter()
+        self.invalidations = 0
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, node_id: Hashable, version: int) -> Optional[np.ndarray]:
+        """Value for ``node_id`` at exactly ``version``, else None. A hit
+        refreshes LRU recency; a stale-versioned entry counts as a miss AND
+        an eviction (it is dropped on touch)."""
+        with self._lock:
+            ent = self._entries.get(node_id)
+            if ent is None:
+                self.counters.miss()
+                return None
+            ver, value = ent
+            if ver != version:
+                del self._entries[node_id]
+                self.counters.evict()
+                self.counters.miss()
+                return None
+            self._entries.move_to_end(node_id)
+            self.counters.hit()
+            return value
+
+    def put(self, node_id: Hashable, version: int, value: np.ndarray) -> None:
+        if self.capacity == 0:
+            return
+        with self._lock:
+            if node_id in self._entries:
+                del self._entries[node_id]
+            self._entries[node_id] = (version, value)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.counters.evict()
+
+    def invalidate(self) -> int:
+        """Drop every entry (the engine calls this on weight update).
+        Returns how many entries were dropped."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self.invalidations += 1
+            return n

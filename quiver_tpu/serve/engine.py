@@ -1,0 +1,421 @@
+"""Online serving engine: dynamic micro-batching, request coalescing, and a
+params-versioned embedding cache.
+
+`inference.sampled_eval` is an OFFLINE loop: it owns its batch composition
+and pays one sample + gather + forward per 1024 seeds. Online traffic
+inverts every assumption — requests arrive one at a time, skewed toward hot
+nodes, and each caller wants ONE row of logits at low latency. Paying a
+full dispatch per request would burn the whole device budget on padding;
+this engine turns the request stream back into efficient fixed-shape device
+work with three levers, applied in order of cheapness:
+
+1. **Embedding cache** (:class:`quiver_tpu.serve.cache.EmbeddingCache`):
+   repeat requests for a node already computed under the CURRENT
+   ``params_version`` are answered from host memory — no device work at
+   all. `update_params` bumps the version and invalidates, so a served
+   result may be cache-aged but never crosses a weight update.
+2. **Cross-request coalescing**: within a flush window, identical seed ids
+   collapse to ONE slot — 50 concurrent callers asking for the same hot
+   node cost one sample/gather/forward and share the result. Requests
+   arriving while that node is in flight attach to the in-flight slot.
+3. **Dynamic micro-batching**: cache-missing unique seeds queue until
+   ``max_batch`` are waiting or the oldest has aged ``max_delay_ms``, then
+   flush as one batch padded to a fixed BUCKET size (powers of two up to
+   ``max_batch`` by default). Fixed buckets mean one compiled program per
+   bucket serves all traffic — no per-request recompiles, ever.
+
+The device path is `inference.batch_logits` — the exact `sampled_eval`
+inner step (same sampler stream, same pad convention, same lookup, same
+cached jitted apply). That shared path is what makes served logits
+BIT-IDENTICAL to offline eval on the same (sampler state, batch) pair; the
+parity test replays the engine's dispatch log through a fresh sampler and
+compares exactly (tests/test_serve.py).
+
+Threading model: any number of client threads `submit`; one flush runs at a
+time (``_dispatch_lock`` serializes device work and keeps the sampler's
+key stream, ``_call`` indexed, deterministic in dispatch order). The engine
+is fully functional without its background thread — `submit` flushes
+inline when a batch fills, and `pump`/`flush` drive the delay policy
+manually, which is how the deterministic tests run it with an injected
+clock. `start()` adds a poller thread for real deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..inference import _cached_apply, batch_logits, pad_seed_batch
+from ..trace import HitRateCounter, LatencyHistogram
+from .cache import EmbeddingCache
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to ``max_batch`` (inclusive, appended if it is not
+    itself a power of two): the bucket ladder that bounds padding waste at
+    2x while keeping the compiled-program count at ``log2(max_batch)``."""
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1")
+    out: List[int] = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+@dataclass
+class ServeConfig:
+    """Engine knobs (see docs/api.md "Online serving").
+
+    max_batch      : flush as soon as this many unique cache-missing seeds
+                     are pending (also the largest bucket).
+    max_delay_ms   : flush a non-empty queue once its OLDEST request has
+                     waited this long — the latency/throughput trade knob.
+    buckets        : fixed batch shapes; a flush pads up to the smallest
+                     bucket >= its unique-seed count. Default: powers of
+                     two up to ``max_batch``. One compiled program per
+                     bucket actually used.
+    cache_entries  : embedding-cache capacity in rows (0 disables caching).
+    clock          : injectable monotonic clock (seconds) — latency metrics
+                     and the delay policy read ONLY this, so tests drive
+                     flush timing deterministically with a fake clock.
+    flush_poll_ms  : background flusher poll period (`start()` mode only).
+    record_dispatches : keep a log of (padded_batch, n_valid) per dispatch
+                     for parity replay/debugging (off by default: it grows
+                     with traffic).
+    """
+
+    max_batch: int = 64
+    max_delay_ms: float = 2.0
+    buckets: Optional[Sequence[int]] = None
+    cache_entries: int = 100_000
+    clock: Callable[[], float] = time.monotonic
+    flush_poll_ms: float = 0.2
+    record_dispatches: bool = False
+
+    def resolved_buckets(self) -> Tuple[int, ...]:
+        if self.buckets is None:
+            return default_buckets(self.max_batch)
+        bs = tuple(sorted(int(b) for b in self.buckets))
+        if not bs or bs[0] < 1:
+            raise ValueError("buckets must be positive")
+        if bs[-1] < self.max_batch:
+            raise ValueError(
+                f"largest bucket {bs[-1]} < max_batch {self.max_batch}: "
+                "a full flush would not fit any bucket"
+            )
+        return bs
+
+
+class _Slot:
+    """One unique (node_id, params_version) computation; every coalesced
+    request for it holds a reference and blocks on ``event``."""
+
+    __slots__ = ("node_id", "version", "event", "value", "error", "enqueue_t", "waiters")
+
+    def __init__(self, node_id: int, version: int, enqueue_t: float):
+        self.node_id = node_id
+        self.version = version
+        self.event = threading.Event()
+        self.value: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.enqueue_t = enqueue_t
+        self.waiters: List[float] = []  # submit timestamps, for latency
+
+    def resolve(self, value: Optional[np.ndarray], error=None) -> None:
+        self.value = value
+        self.error = error
+        self.event.set()
+
+
+class ServeResult:
+    """Handle returned by :meth:`ServeEngine.submit`."""
+
+    __slots__ = ("_slot", "_value")
+
+    def __init__(self, slot: Optional[_Slot] = None, value: Optional[np.ndarray] = None):
+        self._slot = slot
+        self._value = value
+
+    def done(self) -> bool:
+        return self._slot is None or self._slot.event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Logits row for the requested node (blocks until its flush
+        lands; raises the flush's exception if the dispatch failed).
+
+        The row is READ-ONLY — it is shared with the embedding cache and
+        every coalesced co-waiter. Copy before mutating."""
+        if self._slot is None:
+            return self._value
+        if not self._slot.event.wait(timeout):
+            raise TimeoutError("serve request not resolved in time")
+        if self._slot.error is not None:
+            raise self._slot.error
+        return self._slot.value
+
+
+@dataclass
+class ServeStats:
+    """Engine counters. ``requests`` counts every submit; ``coalesced``
+    the subset answered by attaching to an existing pending/in-flight slot;
+    the cache's own hit/miss/eviction counters live in ``cache``.
+    ``dispatches`` is the number of device batches actually launched —
+    the acceptance metric "dispatch count < N" reads this."""
+
+    requests: int = 0
+    coalesced: int = 0
+    dispatches: int = 0
+    dispatched_seeds: int = 0   # unique seeds sent to the device
+    padded_seeds: int = 0       # bucket slack rows computed and discarded
+    dispatch_buckets: Dict[int, int] = field(default_factory=dict)
+    cache: HitRateCounter = field(default_factory=HitRateCounter)
+    latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "requests": self.requests,
+            "coalesced": self.coalesced,
+            "dispatches": self.dispatches,
+            "dispatched_seeds": self.dispatched_seeds,
+            "padded_seeds": self.padded_seeds,
+            "dispatch_buckets": dict(self.dispatch_buckets),
+            "cache": self.cache.snapshot(),
+            "latency": self.latency.snapshot(),
+        }
+
+
+class ServeEngine:
+    """See the module docstring for the design; docs/api.md for the
+    contract. Typical use::
+
+        engine = ServeEngine(model, params, sampler, feature,
+                             ServeConfig(max_batch=32, max_delay_ms=2.0))
+        with engine:                      # starts the background flusher
+            logits = engine.predict([node_id])[0]
+
+    or fully synchronous (no thread)::
+
+        h = engine.submit(node_id)
+        engine.flush()
+        logits = h.result()
+    """
+
+    def __init__(self, model, params, sampler, feature,
+                 config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig()
+        self._buckets = self.config.resolved_buckets()
+        self._apply = _cached_apply(model)
+        self._params = params
+        self._sampler = sampler
+        self._feature = feature
+        self._clock = self.config.clock
+        self.stats = ServeStats()
+        self.cache = EmbeddingCache(self.config.cache_entries,
+                                    counters=self.stats.cache)
+        self.params_version = 0
+        self.dispatch_log: List[Tuple[np.ndarray, int]] = []
+        # queue state: _pending holds slots not yet flushed (insertion order
+        # = FIFO), _inflight slots snapshot-ed by a running flush
+        self._pending: "Dict[int, _Slot]" = {}
+        self._inflight: Dict[int, _Slot] = {}
+        self._lock = threading.Lock()          # queue + cache-version state
+        self._dispatch_lock = threading.Lock() # serializes device work
+        self._seed_bufs: Dict[Tuple[int, object], np.ndarray] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # -- request path -----------------------------------------------------
+
+    def submit(self, node_id: int) -> ServeResult:
+        """Enqueue one node-prediction request; returns a handle. Fills of
+        ``max_batch`` flush inline on the submitting thread."""
+        key = int(node_id)
+        now = self._clock()
+        need_flush = False
+        with self._lock:
+            self.stats.requests += 1
+            cached = self.cache.get(key, self.params_version)
+            if cached is not None:
+                self.stats.latency.record_ms((self._clock() - now) * 1e3)
+                return ServeResult(value=cached)
+            slot = self._pending.get(key) or self._inflight.get(key)
+            if slot is not None and slot.version == self.params_version:
+                self.stats.coalesced += 1
+            else:
+                slot = _Slot(key, self.params_version, now)
+                self._pending[key] = slot
+            slot.waiters.append(now)
+            if len(self._pending) >= self.config.max_batch:
+                need_flush = True
+        if need_flush:
+            self.flush()
+        return ServeResult(slot=slot)
+
+    def predict(self, node_ids, timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience: submit every id, make sure they flush
+        (inline when no background thread is running), return ``[len(ids),
+        C]`` logits in request order."""
+        handles = [self.submit(i) for i in np.asarray(node_ids).reshape(-1)]
+        if not handles:  # empty batch is a valid no-op (np.stack would raise)
+            return np.zeros((0, 0), np.float32)
+        if not self._running:
+            while any(not h.done() for h in handles) and self._drainable():
+                self.flush()
+        return np.stack([h.result(timeout) for h in handles])
+
+    # -- flush policy -----------------------------------------------------
+
+    def should_flush(self) -> bool:
+        with self._lock:
+            if not self._pending:
+                return False
+            if len(self._pending) >= self.config.max_batch:
+                return True
+            oldest = next(iter(self._pending.values())).enqueue_t
+            return (self._clock() - oldest) * 1e3 >= self.config.max_delay_ms
+
+    def pump(self) -> int:
+        """Apply the flush policy once: flush iff ``max_batch`` or
+        ``max_delay_ms`` demands it. Returns seeds dispatched (0 if the
+        policy held). This is the deterministic-test / external-event-loop
+        surface; the background thread just calls it on a poll timer."""
+        return self.flush() if self.should_flush() else 0
+
+    def flush(self) -> int:
+        """Dispatch up to ``max_batch`` pending unique seeds as one bucket-
+        padded device batch NOW (policy bypassed). Returns the number of
+        unique seeds dispatched."""
+        with self._dispatch_lock:
+            with self._lock:
+                if not self._pending:
+                    return 0
+                keys = list(self._pending)[: self.config.max_batch]
+                slots = [self._pending.pop(k) for k in keys]
+                self._inflight.update(zip(keys, slots))
+                # params snapshot only: version checks below deliberately
+                # re-read self.params_version so a concurrent update_params
+                # suppresses caching of the now-stale rows
+                params = self._params
+            try:
+                seeds = np.asarray(keys, dtype=np.int64)
+                bucket = self._bucket_for(len(seeds))
+                buf = self._seed_bufs.get((bucket, seeds.dtype.str))
+                padded = pad_seed_batch(seeds, bucket, out=buf)
+                self._seed_bufs[(bucket, seeds.dtype.str)] = padded
+                if self.config.record_dispatches:
+                    self.dispatch_log.append((padded.copy(), len(seeds)))
+                logits = np.asarray(batch_logits(
+                    self._apply, params, self._sampler, self._feature, padded
+                ))
+                # rows of this array are handed to every waiter AND the
+                # cache; read-only makes an in-place mutation by one caller
+                # a loud ValueError instead of silently corrupting every
+                # later cache hit for the node
+                if logits.flags.writeable:
+                    logits.setflags(write=False)
+                err = None
+            except BaseException as exc:  # resolve waiters, then re-raise
+                logits, err = None, exc
+            now = self._clock()
+            with self._lock:
+                for i, (k, slot) in enumerate(zip(keys, slots)):
+                    self._inflight.pop(k, None)
+                    if err is None:
+                        row = logits[i]
+                        if slot.version == self.params_version:
+                            self.cache.put(k, slot.version, row)
+                        slot.resolve(row)
+                    else:
+                        slot.resolve(None, error=err)
+                    for t0 in slot.waiters:
+                        self.stats.latency.record_ms((now - t0) * 1e3)
+                if err is None:
+                    self.stats.dispatches += 1
+                    self.stats.dispatched_seeds += len(seeds)
+                    self.stats.padded_seeds += bucket - len(seeds)
+                    self.stats.dispatch_buckets[bucket] = (
+                        self.stats.dispatch_buckets.get(bucket, 0) + 1
+                    )
+            if err is not None:
+                raise err
+            return len(seeds)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _drainable(self) -> bool:
+        with self._lock:
+            return bool(self._pending)
+
+    def reset_stats(self) -> None:
+        """Zero every counter/histogram AND re-point the embedding cache's
+        counter at the fresh `ServeStats` (the two must move together — a
+        bare ``stats.__init__()`` would leave the cache counting into the
+        detached old object). Benches call this after their warm-up pass;
+        cache CONTENTS are untouched (use `cache.invalidate()` for that)."""
+        with self._lock:
+            self.stats = ServeStats()
+            self.cache.counters = self.stats.cache
+
+    # -- weight updates ---------------------------------------------------
+
+    def update_params(self, params) -> None:
+        """Install new weights: bump ``params_version`` and invalidate the
+        embedding cache. Pending (not yet dispatched) slots are re-stamped
+        to the new version — their flush will compute under the new weights.
+        In-flight flushes of the OLD version still resolve their waiters
+        (those requests were accepted under the old weights) but their
+        results are never cached under the new version."""
+        with self._lock:
+            self._params = params
+            self.params_version += 1
+            self.cache.invalidate()
+            for slot in self._pending.values():
+                slot.version = self.params_version
+
+    # -- background flusher -----------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="quiver-serve-flusher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if drain:
+            while self._drainable():
+                self.flush()
+
+    def _poll_loop(self) -> None:
+        while self._running:
+            try:
+                self.pump()
+            except Exception:
+                # the failing flush already resolved its waiters with the
+                # error; keep serving subsequent requests
+                pass
+            time.sleep(self.config.flush_poll_ms / 1e3)
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,63 @@
+"""Seeded synthetic request traces for the serving benchmarks.
+
+Online node-prediction traffic is skewed: a handful of hub nodes (popular
+items, celebrity accounts) absorb most requests. The standard model is a
+Zipfian popularity law — request probability of the rank-``r`` node
+proportional to ``1/r**alpha`` — with ``alpha`` around 0.6-1.1 for web/
+recommendation workloads. These generators are fully seeded so every probe
+and test replays bit-identically; the rank->node mapping is a seeded
+permutation so "hot" nodes are scattered across the id space rather than
+being ids 0..k (which would alias with the degree-ordered hot feature
+prefix and flatter the cache).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def zipfian_trace(
+    n_nodes: int, n_requests: int, alpha: float = 0.99, seed: int = 0
+) -> np.ndarray:
+    """``[n_requests]`` int64 node ids drawn Zipf(``alpha``) over
+    ``n_nodes`` ranks (``alpha=0`` -> uniform). Deterministic per
+    ``(n_nodes, n_requests, alpha, seed)``."""
+    if n_nodes <= 0 or n_requests < 0:
+        raise ValueError("need n_nodes > 0 and n_requests >= 0")
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_nodes + 1, dtype=np.float64)
+    p = ranks ** (-float(alpha))
+    p /= p.sum()
+    drawn_ranks = rng.choice(n_nodes, size=n_requests, p=p)
+    node_of_rank = rng.permutation(n_nodes).astype(np.int64)
+    return node_of_rank[drawn_ranks]
+
+
+def poisson_arrivals(
+    n_requests: int, qps: float, seed: int = 0
+) -> np.ndarray:
+    """``[n_requests]`` float64 cumulative arrival times (seconds) of a
+    Poisson process at rate ``qps`` — the open-loop replay schedule for
+    latency-under-load probes."""
+    if qps <= 0:
+        raise ValueError("qps must be > 0")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / qps, size=n_requests)
+    return np.cumsum(gaps)
+
+
+def trace_skew_stats(trace: np.ndarray, top_frac: float = 0.01) -> dict:
+    """Observed skew of a trace: unique fraction and the request share of
+    the hottest ``top_frac`` of distinct nodes (the number a cache planner
+    actually wants)."""
+    trace = np.asarray(trace)
+    if trace.size == 0:
+        return {"unique_frac": 0.0, "top_share": 0.0, "distinct": 0}
+    _, counts = np.unique(trace, return_counts=True)
+    counts = np.sort(counts)[::-1]
+    k = max(1, int(np.ceil(top_frac * counts.size)))
+    return {
+        "unique_frac": counts.size / trace.size,
+        "top_share": float(counts[:k].sum() / trace.size),
+        "distinct": int(counts.size),
+    }

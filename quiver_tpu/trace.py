@@ -139,6 +139,131 @@ def gbps(
     return num_rows * feature_dim * bytes_per_elem / max(seconds, 1e-12) / 1e9
 
 
+# -- serving metrics ----------------------------------------------------------
+
+import bisect
+import math
+import threading
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram for the serving path.
+
+    Bounded memory regardless of request count: ``record_ms`` lands each
+    sample in one of ~``log(max/min)/log(growth)`` geometric buckets, so the
+    serve engine can keep one of these per metric forever without growing
+    per-request state. ``percentile`` answers within one bucket's resolution
+    (``growth`` = 1.25 -> ~12% worst case), which is the honest precision for
+    tail-latency reporting anyway; exact ``min``/``max`` are tracked on the
+    side and clamp the answer, so single-sample and extreme queries are
+    exact. Thread-safe: the engine's flusher and client threads record
+    concurrently.
+    """
+
+    def __init__(self, min_ms: float = 1e-3, max_ms: float = 6e4,
+                 growth: float = 1.25):
+        if not (min_ms > 0 and max_ms > min_ms and growth > 1):
+            raise ValueError("need 0 < min_ms < max_ms and growth > 1")
+        nb = int(math.ceil(math.log(max_ms / min_ms) / math.log(growth))) + 1
+        # bucket i covers (edges[i-1], edges[i]]; bucket 0 is (0, min_ms]
+        self._edges = [min_ms * growth ** i for i in range(nb)]
+        self._counts = [0] * (nb + 1)  # +1: overflow bucket above max_ms
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum_ms = 0.0
+        self.min_ms = math.inf
+        self.max_ms = 0.0
+
+    def record_ms(self, ms: float) -> None:
+        ms = float(ms)
+        i = bisect.bisect_left(self._edges, ms)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum_ms += ms
+            self.min_ms = min(self.min_ms, ms)
+            self.max_ms = max(self.max_ms, ms)
+
+    @property
+    def mean_ms(self) -> float:
+        return self.sum_ms / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]. Returns the geometric midpoint of the bucket the
+        p-th sample falls in, clamped to the observed [min, max]."""
+        if not 0 <= p <= 100:
+            raise ValueError("percentile wants p in [0, 100]")
+        with self._lock:
+            if not self.count:
+                return 0.0
+            rank = max(1, math.ceil(p / 100.0 * self.count))
+            acc = 0
+            for i, c in enumerate(self._counts):
+                acc += c
+                if acc >= rank:
+                    if i == 0:
+                        # underflow bucket (0, min edge]: the exact observed
+                        # minimum is the only honest answer down here
+                        mid = self.min_ms
+                    elif i == len(self._edges):
+                        # overflow bucket has no upper edge: report observed max
+                        mid = self.max_ms
+                    else:
+                        mid = math.sqrt(self._edges[i - 1] * self._edges[i])
+                    return min(max(mid, self.min_ms), self.max_ms)
+            return self.max_ms  # unreachable; guards float drift
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean_ms": self.mean_ms,
+            "p50_ms": self.percentile(50),
+            "p95_ms": self.percentile(95),
+            "p99_ms": self.percentile(99),
+            "min_ms": self.min_ms if self.count else 0.0,
+            "max_ms": self.max_ms,
+        }
+
+
+class HitRateCounter:
+    """Hit/miss/eviction counters for the serving caches (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def hit(self, n: int = 1) -> None:
+        with self._lock:
+            self.hits += n
+
+    def miss(self, n: int = 1) -> None:
+        with self._lock:
+            self.misses += n
+
+    def evict(self, n: int = 1) -> None:
+        with self._lock:
+            self.evictions += n
+
+    @property
+    def total(self) -> int:
+        return self.hits + self.misses
+
+    @property
+    def hit_rate(self) -> float:
+        t = self.total
+        return self.hits / t if t else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "hit_rate": self.hit_rate,
+        }
+
+
 # -- jax profiler pass-throughs ----------------------------------------------
 
 def start_profile(logdir: str) -> None:

@@ -46,8 +46,10 @@ def main():
         format_fetch_markdown,
         format_markdown,
         format_quant_markdown,
+        format_serve_markdown,
         products_scaling_table,
         quant_fetch_table,
+        serve_table,
         sharded_fetch_table,
     )
 
@@ -75,9 +77,31 @@ def main():
         "## Quantized feature store: per-codec capacity / byte table "
         "(products config, D=100)\n\n" + format_quant_markdown(quant_rows)
     )
+    # online-serving QPS model from the SAME single-chip step time. Two
+    # opposing biases, called out per row: feeding the TRAIN step cost is
+    # pessimistic at the reference batch (a serve dispatch skips backward +
+    # update), but the linear down-scaling to small buckets omits fixed
+    # per-dispatch overhead and is optimistic there (serve_table docstring)
+    serve_rows = serve_table(
+        step_s, 0.0, 0.0, ref_batch=1024, buckets=(64, 256, 1024),
+        hit_rates=(0.0, 0.5, 0.9), unique_frac=0.8, max_delay_ms=2.0,
+    )
+    serve_md = (
+        "## Online serving: predicted QPS vs bucket / cache hit "
+        "rate (quiver_tpu.serve)\n\n"
+        "Device cost per dispatch is the measured TRAIN step at batch 1024 "
+        "(pessimistic: a serve\ndispatch runs the same sample + gather + "
+        "forward but no backward/update), scaled\nlinearly to each bucket "
+        "(OPTIMISTIC at small buckets: fixed per-dispatch overhead is\n"
+        "omitted — see the serve_table docstring). Bucket-1024 rows are "
+        "floors; bucket-64 rows\nare not. The measured counterpart with the "
+        "real engine is scripts/serve_probe.py ->\nSERVE_r01.json.\n\n"
+        + format_serve_markdown(serve_rows)
+    )
     print(md, file=sys.stderr)
     print("\n" + fetch_md, file=sys.stderr)
     print("\n" + quant_md, file=sys.stderr)
+    print("\n" + serve_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -90,7 +114,8 @@ def main():
         )
         with open(args.out, "w") as fh:
             fh.write(
-                header + md + "\n\n" + fetch_md + "\n\n" + quant_md + "\n"
+                header + md + "\n\n" + fetch_md + "\n\n" + quant_md
+                + "\n\n" + serve_md + "\n"
             )
     print(json.dumps({
         "step_s_1chip": step_s,
@@ -98,6 +123,7 @@ def main():
         "rows": [r._asdict() for r in rows],
         "sharded_fetch": [r._asdict() for r in fetch_rows],
         "quant_fetch": [r._asdict() for r in quant_rows],
+        "serve": [r._asdict() for r in serve_rows],
     }))
 
 

@@ -1,0 +1,162 @@
+"""Synthetic online-serving probe: QPS / tail latency / cache hit rate vs
+request skew.
+
+Replays seeded Zipfian request traces through the REAL serving engine
+(`quiver_tpu.serve.ServeEngine` — micro-batching, coalescing, embedding
+cache) over a small community graph, at 2-3 skew settings and two cache
+sizes, and prints ONE json line (written to SERVE_r01.json by the round
+driver). On this 1-core CPU box the absolute QPS is a floor, not a
+ceiling — the point of the artifact is the TRAJECTORY: how hit rate,
+coalescing, and dispatch count move with skew, plus the serve_table
+prediction computed from the SAME measured per-batch costs so the next
+round can compare model vs measurement on real hardware.
+
+Usage: JAX_PLATFORMS=cpu python scripts/serve_probe.py [--requests 400]
+       [--out SERVE_r01.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def community_graph(n_comm=4, per_comm=60, intra=8, seed=0):
+    rng = np.random.default_rng(seed)
+    n = n_comm * per_comm
+    src, dst = [], []
+    for u in range(n):
+        cu = u // per_comm
+        for v in rng.choice(per_comm, intra, replace=False) + cu * per_comm:
+            src.append(u)
+            dst.append(int(v))
+    feat = rng.standard_normal((n, 16)).astype(np.float32)
+    return np.stack([np.array(src), np.array(dst)]), feat, n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.parallel.scaling import format_serve_markdown, serve_table
+    from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+    from quiver_tpu.serve import (
+        ServeConfig,
+        ServeEngine,
+        trace_skew_stats,
+        zipfian_trace,
+    )
+
+    edge_index, feat, n = community_graph()
+    model = GraphSAGE(hidden_dim=32, out_dim=4, num_layers=2, dropout=0.0)
+
+    def make_sampler():
+        return GraphSageSampler(
+            CSRTopo(edge_index=edge_index), sizes=[5, 5], mode="TPU", seed=1
+        )
+
+    s0 = make_sampler()
+    ds0 = s0.sample_dense(np.arange(args.max_batch, dtype=np.int64))
+    params = model.init(
+        jax.random.key(0), jnp.zeros((ds0.n_id.shape[0], feat.shape[1])), ds0.adjs
+    )
+
+    def run(alpha, cache_entries):
+        eng = ServeEngine(
+            model, params, make_sampler(), feat,
+            ServeConfig(max_batch=args.max_batch, max_delay_ms=2.0,
+                        cache_entries=cache_entries),
+        )
+        trace = zipfian_trace(n, args.requests, alpha=alpha, seed=42)
+        # warm EVERY bucket's compilation out of the timed window (the
+        # closed-loop drain can flush at any bucket size), then reset state
+        next_id = iter(range(n))
+        for b in eng.config.resolved_buckets():
+            for _ in range(b):
+                eng.submit(next(next_id))
+            eng.flush()
+        eng.cache.invalidate()
+        eng.reset_stats()
+        t0 = time.perf_counter()
+        eng.predict(trace)
+        wall = time.perf_counter() - t0
+        s = eng.stats
+        lat = s.latency.snapshot()
+        return {
+            "alpha": alpha,
+            "cache_entries": cache_entries,
+            "skew": trace_skew_stats(trace),
+            "qps": round(args.requests / wall, 1),
+            "p50_ms": round(lat["p50_ms"], 3),
+            "p95_ms": round(lat["p95_ms"], 3),
+            "p99_ms": round(lat["p99_ms"], 3),
+            "dispatches": s.dispatches,
+            "dispatched_seeds": s.dispatched_seeds,
+            "padded_seeds": s.padded_seeds,
+            "coalesced": s.coalesced,
+            "cache_hit_rate": round(s.cache.hit_rate, 4),
+            "requests_per_dispatch": round(
+                args.requests / max(s.dispatches, 1), 2
+            ),
+        }
+
+    points = []
+    for alpha in (0.0, 0.99, 1.3):
+        for cache_entries in (0, 4096):
+            points.append(run(alpha, cache_entries))
+
+    # measured per-batch dispatch cost at max_batch (one warm batch_logits
+    # step) -> the serve_table prediction from the same numbers
+    from quiver_tpu.inference import _cached_apply, batch_logits
+
+    apply = _cached_apply(model)
+    s1 = make_sampler()
+    seeds = np.arange(args.max_batch, dtype=np.int64)
+    np.asarray(batch_logits(apply, params, s1, feat, seeds))  # warm
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = batch_logits(apply, params, s1, feat, seeds)
+    jax.block_until_ready(out)
+    t_dispatch = (time.perf_counter() - t0) / iters
+    # the probe cannot split sample/gather/forward without perturbing the
+    # measurement; report the fused cost in the sample slot (the table sums
+    # the three legs, so the prediction is unchanged)
+    pred = serve_table(
+        t_dispatch, 0.0, 0.0, ref_batch=args.max_batch,
+        buckets=(args.max_batch,), hit_rates=(0.0, 0.5, 0.9),
+        unique_frac=0.8, max_delay_ms=2.0,
+    )
+
+    out = {
+        "metric": "serve_probe",
+        "requests": args.requests,
+        "max_batch": args.max_batch,
+        "backend": jax.devices()[0].platform,
+        "points": points,
+        "measured_dispatch_s": round(t_dispatch, 6),
+        "serve_table": [p._asdict() for p in pred],
+        "serve_table_md": format_serve_markdown(pred),
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

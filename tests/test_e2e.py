@@ -174,3 +174,71 @@ def test_full_inference_matches_sampled_eval():
     assert s_acc > 0.9, s_acc
     assert f_acc > 0.9, f_acc
     assert abs(s_acc - f_acc) < 0.08, (s_acc, f_acc)
+
+
+def test_sampled_eval_partial_final_batch():
+    """Pins the partial-final-batch path of `sampled_eval` (pad the last
+    batch with ``batch[-1]``, truncate the compare): previously untested.
+    The oracle replays the SAME padded batches through `batch_logits` with
+    a twin sampler, so any drift in the pad/truncate convention (or a pad
+    row leaking into the compare window) flips the accuracy."""
+    from quiver_tpu.inference import (
+        _cached_apply,
+        batch_logits,
+        pad_seed_batch,
+        sampled_eval,
+    )
+    from quiver_tpu.models import GraphSAGE
+
+    edge_index, feat_np, _, n = make_community_graph()
+    topo = CSRTopo(edge_index=edge_index)
+    model = GraphSAGE(hidden_dim=16, out_dim=4, num_layers=2, dropout=0.0)
+    make_sampler = lambda: GraphSageSampler(topo, sizes=[5, 5], mode="TPU", seed=9)
+
+    rng = np.random.default_rng(3)
+    nodes = rng.choice(n, 21, replace=False)  # 21 = 8 + 8 + partial 5
+    bs = 8
+    s0 = make_sampler()
+    ds0 = s0.sample_dense(np.arange(bs, dtype=nodes.dtype))
+    params = model.init(
+        jax.random.key(1), jnp.zeros((ds0.n_id.shape[0], feat_np.shape[1])), ds0.adjs
+    )
+
+    # oracle predictions per node, replaying the identical padded batches
+    # (fresh sampler: call index 0, 1, 2 — ds0 above consumed s0's index 0)
+    apply = _cached_apply(model)
+    oracle_sampler = make_sampler()
+    oracle_sampler.sample_dense(np.arange(bs, dtype=nodes.dtype))  # align index
+    preds = {}
+    for lo in range(0, nodes.shape[0], bs):
+        padded = pad_seed_batch(nodes[lo : lo + bs], bs)
+        logits = np.asarray(
+            batch_logits(apply, params, oracle_sampler, feat_np, padded)
+        )
+        for i in range(min(bs, nodes.shape[0] - lo)):
+            preds[int(padded[i])] = int(logits[i].argmax())
+
+    labels = np.zeros(n, np.int32)
+    for nid, p in preds.items():
+        labels[nid] = p
+    # s0 sits at call index 1 (ds0 consumed 0) — aligned with the oracle
+    assert sampled_eval(model, params, s0, feat_np, labels, nodes, bs) == 1.0
+
+    def aligned_sampler():
+        s = make_sampler()
+        s.sample_dense(np.arange(bs, dtype=nodes.dtype))  # burn index 0
+        return s
+
+    # negative control: flip ONLY the last (partial-batch) node's label —
+    # accuracy must drop by exactly 1/21, proving the tail node is counted
+    # once and the pad duplicates of it are not
+    labels2 = labels.copy()
+    labels2[nodes[-1]] = (labels2[nodes[-1]] + 1) % 4
+    acc = sampled_eval(model, params, aligned_sampler(), feat_np, labels2, nodes, bs)
+    assert acc == pytest.approx(20 / 21)
+
+    # divisible case stays exact too (no partial batch: pure regression guard)
+    acc16 = sampled_eval(
+        model, params, aligned_sampler(), feat_np, labels, nodes[:16], bs
+    )
+    assert acc16 == 1.0

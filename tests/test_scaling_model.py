@@ -3,6 +3,7 @@ it models (reference anchor: the measured 1-4 GPU tables in
 docs/Introduction_en.md:123-158, which this environment cannot measure)."""
 
 import numpy as np
+import pytest
 
 from quiver_tpu.parallel.scaling import (
     collective_payload_bytes,
@@ -231,3 +232,35 @@ def test_model_matches_compiled_step():
     # slack: loss pmean scalar + whatever small extras a compiler version
     # adds; the point is the BIG payloads match the model exactly
     assert predicted <= measured <= predicted * 1.1 + 256, (measured, predicted)
+
+
+def test_serve_table_request_algebra():
+    from quiver_tpu.parallel.scaling import format_serve_markdown, serve_table
+
+    rows = serve_table(
+        t_sample_s=0.01, t_gather_s=0.005, t_forward_s=0.005, ref_batch=100,
+        buckets=(10, 100), hit_rates=(0.0, 0.5, 0.9), unique_frac=0.8,
+        max_delay_ms=2.0,
+    )
+    assert len(rows) == 6
+    by = {(r.bucket, r.hit_rate): r for r in rows}
+    # per-seed cost 0.02/100 = 0.2ms -> bucket 10 dispatch 2ms, bucket 100 20ms
+    assert by[(10, 0.0)].dispatch_s == pytest.approx(2e-3)
+    assert by[(100, 0.0)].dispatch_s == pytest.approx(2e-2)
+    # no cache, unique_frac 0.8: one bucket-10 dispatch retires 12.5 requests
+    assert by[(10, 0.0)].requests_per_dispatch == pytest.approx(12.5)
+    assert by[(10, 0.0)].qps == pytest.approx(12.5 / 2e-3)
+    # hit rate 0.9 multiplies requests/dispatch (and QPS) by 10x vs 0.0
+    assert by[(10, 0.9)].qps == pytest.approx(by[(10, 0.0)].qps * 10)
+    # linear per-seed model: QPS ceiling is bucket-invariant...
+    assert by[(100, 0.5)].qps == pytest.approx(by[(10, 0.5)].qps)
+    # ...but the latency floor is not — that's the bucket trade-off
+    assert by[(100, 0.5)].floor_p50_ms > by[(10, 0.5)].floor_p50_ms
+    assert by[(10, 0.5)].floor_p50_ms == pytest.approx(1.0 + 2.0)
+    # device time per request = dispatch_s / requests_per_dispatch
+    r = by[(100, 0.5)]
+    assert r.device_us_per_request == pytest.approx(
+        r.dispatch_s / r.requests_per_dispatch * 1e6
+    )
+    md = format_serve_markdown(rows)
+    assert "| bucket |" in md and md.count("\n|") >= 6

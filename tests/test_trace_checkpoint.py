@@ -5,7 +5,15 @@ import os
 import numpy as np
 import pytest
 
-from quiver_tpu.trace import gbps, seps, timer, trace_report, trace_scope
+from quiver_tpu.trace import (
+    HitRateCounter,
+    LatencyHistogram,
+    gbps,
+    seps,
+    timer,
+    trace_report,
+    trace_scope,
+)
 from quiver_tpu.checkpoint import (
     CheckpointManager,
     load_partition_artifacts,
@@ -54,6 +62,66 @@ def test_trace_scope_syncs_device_work(monkeypatch):
 def test_metric_helpers():
     assert seps(1000, 0.5) == 2000
     assert abs(gbps(1000, 250, 1.0) - 1e-3) < 1e-9
+
+
+def test_latency_histogram_empty_and_single():
+    h = LatencyHistogram()
+    assert h.percentile(50) == 0.0 and h.count == 0 and h.mean_ms == 0.0
+    h.record_ms(3.7)
+    # single sample: min/max clamping makes every percentile exact
+    assert h.percentile(0) == pytest.approx(3.7)
+    assert h.percentile(50) == pytest.approx(3.7)
+    assert h.percentile(100) == pytest.approx(3.7)
+    assert h.mean_ms == pytest.approx(3.7)
+
+
+def test_latency_histogram_percentiles_within_bucket_resolution():
+    h = LatencyHistogram(growth=1.25)
+    vals = [float(v) for v in range(1, 101)]  # 1..100 ms
+    for v in vals:
+        h.record_ms(v)
+    assert h.count == 100
+    # log-bucketed: answers within one growth factor of the exact order stat
+    for p, exact in ((50, 50.0), (95, 95.0), (99, 99.0)):
+        got = h.percentile(p)
+        assert exact / 1.25 <= got <= exact * 1.25, (p, got)
+    assert h.min_ms == 1.0 and h.max_ms == 100.0
+    snap = h.snapshot()
+    assert snap["count"] == 100 and snap["p99_ms"] >= snap["p50_ms"]
+
+
+def test_latency_histogram_bounds_and_threads():
+    import threading
+
+    h = LatencyHistogram()
+    with pytest.raises(ValueError):
+        h.percentile(101)
+    with pytest.raises(ValueError):
+        LatencyHistogram(growth=1.0)
+    # overflow/underflow samples land in edge buckets, clamped to observed
+    h.record_ms(1e-6)
+    h.record_ms(1e9)
+    assert h.percentile(0) == pytest.approx(1e-6)
+    assert h.percentile(100) == pytest.approx(1e9)
+    ts = [
+        threading.Thread(target=lambda: [h.record_ms(1.0) for _ in range(500)])
+        for _ in range(4)
+    ]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert h.count == 2002  # no lost updates under concurrency
+
+
+def test_hit_rate_counter():
+    c = HitRateCounter()
+    assert c.hit_rate == 0.0
+    c.hit(3)
+    c.miss()
+    c.evict(2)
+    assert (c.hits, c.misses, c.evictions, c.total) == (3, 1, 2, 4)
+    assert c.hit_rate == pytest.approx(0.75)
+    snap = c.snapshot()
+    assert snap == {"hits": 3, "misses": 1, "evictions": 2, "hit_rate": 0.75}
 
 
 def test_checkpoint_roundtrip(tmp_path):
