@@ -814,15 +814,27 @@ def bench_tiered_pipeline(
 def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
     """Online serving engine (`quiver_tpu.serve`) on the products graph:
     closed-loop Zipfian replay through the REAL micro-batcher + coalescer +
-    embedding cache, at two skews. One fixed bucket (64) keeps this to ONE
-    compile; the per-dispatch RPC floor (`context["rpc_floor_s"]`) bounds
-    every latency number in this tunneled environment — read the hit-rate /
-    coalescing / dispatch-count columns as the hardware-true signal and the
-    QPS as a floor (a co-located host skips the tunnel entirely)."""
+    embedding cache, at two skews x in-flight window 1 (serial) and 2
+    (pipelined, two client threads + pollers; measured per-stage overlap
+    from `stats.spans`). One fixed bucket (64) keeps this to ONE compile,
+    pre-traced by `engine.warmup()`; the per-dispatch RPC floor
+    (`context["rpc_floor_s"]`) bounds every latency number in this tunneled
+    environment — read the hit-rate / coalescing / dispatch-count /
+    overlap columns as the hardware-true signal and the QPS as a floor (a
+    co-located host skips the tunnel entirely).
+
+    Also measures the serve dispatch cost SPLIT (NEXT.md follow-up b):
+    `inference.sample_batch` vs `inference.forward_logits` at the serve
+    bucket, recorded as ``serve_sample_s`` / ``serve_forward_s`` so
+    `scripts/scaling_model.py --bench` prices `scaling.serve_table` with
+    the eval-shaped cost instead of the pessimistic TRAIN-step bound."""
+    import threading
+
     import jax
     import jax.numpy as jnp
 
     from quiver_tpu import CSRTopo
+    from quiver_tpu.inference import _cached_apply, time_eval_split
     from quiver_tpu.models import GraphSAGE
     from quiver_tpu.pyg import GraphSageSampler
     from quiver_tpu.serve import ServeConfig, ServeEngine, zipfian_trace
@@ -843,36 +855,81 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
         jnp.zeros((ds0.n_id.shape[0], table.shape[1]), jnp.float32),
         ds0.adjs,
     )
+
+    # eval-shaped dispatch cost split at the serve bucket: the two stages
+    # of batch_logits timed separately (shared helper with serve_probe so
+    # the two artifacts use one methodology; the RPC floor bounds both
+    # legs the same way it bounds every number here)
+    apply = _cached_apply(model)
+    t_sample, t_forward = time_eval_split(
+        apply, params, make_sampler(), table, np.arange(64, dtype=np.int64)
+    )
+    context["serve_sample_s"] = round(t_sample, 6)
+    context["serve_forward_s"] = round(t_forward, 6)
+    context["serve_eval_ref_batch"] = 64
+    log(
+        f"serve dispatch split @64: sample {t_sample*1e3:.1f} ms + forward "
+        f"{t_forward*1e3:.1f} ms (eval-shaped serve_table inputs)"
+    )
+
     for alpha in (0.0, 0.99):
-        eng = ServeEngine(
-            model, params, make_sampler(), table,
-            ServeConfig(max_batch=64, buckets=(64,), max_delay_ms=2.0,
-                        cache_entries=1 << 16),
-        )
-        # warm the single bucket's compile off the clock, then reset counters
-        eng.predict(np.arange(64, dtype=np.int64))
-        eng.cache.invalidate()
-        eng.reset_stats()
-        trace = zipfian_trace(n_nodes, n_requests, alpha=alpha, seed=17)
-        t0 = time.time()
-        eng.predict(trace)
-        wall = time.time() - t0
-        s = eng.stats
-        lat = s.latency.snapshot()
-        key = f"serve_zipf{alpha:g}"
-        context[f"{key}_qps"] = round(n_requests / wall, 1)
-        context[f"{key}_p50_ms"] = round(lat["p50_ms"], 2)
-        context[f"{key}_p95_ms"] = round(lat["p95_ms"], 2)
-        context[f"{key}_p99_ms"] = round(lat["p99_ms"], 2)
-        context[f"{key}_cache_hit_rate"] = round(s.cache.hit_rate, 4)
-        context[f"{key}_dispatches"] = s.dispatches
-        context[f"{key}_coalesced"] = s.coalesced
-        log(
-            f"serve zipf={alpha}: {n_requests / wall:.0f} QPS, p50/p95/p99 "
-            f"{lat['p50_ms']:.1f}/{lat['p95_ms']:.1f}/{lat['p99_ms']:.1f} ms, "
-            f"hit rate {s.cache.hit_rate:.0%}, {s.dispatches} dispatches, "
-            f"{s.coalesced} coalesced"
-        )
+        for mif in (1, 2):
+            eng = ServeEngine(
+                model, params, make_sampler(), table,
+                ServeConfig(max_batch=64, buckets=(64,), max_delay_ms=2.0,
+                            cache_entries=1 << 16, max_in_flight=mif),
+            )
+            eng.warmup()  # pre-trace the bucket off the clock (twin sampler)
+            eng.cache.invalidate()
+            eng.reset_stats()
+            trace = zipfian_trace(n_nodes, n_requests, alpha=alpha, seed=17)
+            t0 = time.time()
+            client_errors = []
+            if mif == 1:
+                eng.predict(trace)  # round-8 closed loop, unchanged
+            else:
+                # saturated pipelined load: two closed-loop clients + the
+                # engine's pollers keep up to 2 flushes in flight. Client
+                # exceptions are captured, not dropped — a timed-out or
+                # failed trace must not record a plausible-looking QPS row
+                chunks = np.array_split(trace, 2)
+
+                def client(c):
+                    try:
+                        eng.predict(c, 600)
+                    except Exception as exc:
+                        client_errors.append(repr(exc))
+
+                with eng:
+                    ts = [threading.Thread(target=client, args=(c,)) for c in chunks]
+                    [t.start() for t in ts]
+                    [t.join() for t in ts]
+            wall = time.time() - t0
+            if client_errors:
+                context[f"serve_zipf{alpha:g}_mif{mif}_errors"] = client_errors
+                log(f"serve zipf={alpha} mif={mif} FAILED: {client_errors}")
+                continue
+            s = eng.stats
+            lat = s.latency.snapshot()
+            key = f"serve_zipf{alpha:g}" + ("" if mif == 1 else f"_mif{mif}")
+            context[f"{key}_qps"] = round(n_requests / wall, 1)
+            context[f"{key}_p50_ms"] = round(lat["p50_ms"], 2)
+            context[f"{key}_p95_ms"] = round(lat["p95_ms"], 2)
+            context[f"{key}_p99_ms"] = round(lat["p99_ms"], 2)
+            context[f"{key}_cache_hit_rate"] = round(s.cache.hit_rate, 4)
+            context[f"{key}_dispatches"] = s.dispatches
+            context[f"{key}_coalesced"] = s.coalesced
+            ov = s.spans.overlap_summary()
+            if mif > 1:
+                context[f"{key}_overlap_frac"] = ov.get("overlap_frac", 0.0)
+                context[f"{key}_inflight_peak"] = s.inflight_peak
+            log(
+                f"serve zipf={alpha} mif={mif}: {n_requests / wall:.0f} QPS, "
+                f"p50/p95/p99 {lat['p50_ms']:.1f}/{lat['p95_ms']:.1f}/"
+                f"{lat['p99_ms']:.1f} ms, hit rate {s.cache.hit_rate:.0%}, "
+                f"{s.dispatches} dispatches, {s.coalesced} coalesced"
+                + (f", overlap {ov.get('overlap_frac', 0.0):.0%}" if mif > 1 else "")
+            )
 
 
 def wait_for_backend(max_wait_s=None):

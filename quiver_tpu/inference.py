@@ -154,17 +154,62 @@ def lookup_features(feature, n_id, ids_out: Optional[np.ndarray] = None):
     return feature[n_id]
 
 
+def sample_batch(sampler, padded_batch):
+    """Stage 1 of the fixed-shape eval step: draw the sampler's next key
+    and dispatch the k-hop sample for ``padded_batch``. Split out of
+    :func:`batch_logits` so the pipelined serve engine can consume the
+    sampler's key stream in dispatch-index order (under its sequencing
+    lock) while the forward of the PREVIOUS flush still runs."""
+    return sampler.sample_dense(padded_batch)
+
+
+def forward_logits(apply, params, feature, ds, ids_out=None) -> jax.Array:
+    """Stage 2 of the fixed-shape eval step: gather features for an
+    already-sampled ``ds`` and run the jitted ``apply``. Composes with
+    :func:`sample_batch`; `batch_logits` is exactly the two in sequence."""
+    x = lookup_features(feature, ds.n_id, ids_out=ids_out)
+    return apply(params, x, ds.adjs)
+
+
 def batch_logits(
     apply, params, sampler, feature, padded_batch, ids_out=None
 ) -> jax.Array:
     """One fixed-shape eval step: sample ``padded_batch`` with ``sampler``,
     gather its features, run the jitted ``apply``. This IS the unbatched
     `sampled_eval` inner loop — the serve engine dispatches through the same
-    function, which is what makes served logits bit-identical to offline
-    eval on the same (sampler state, batch) pair."""
-    ds = sampler.sample_dense(padded_batch)
-    x = lookup_features(feature, ds.n_id, ids_out=ids_out)
-    return apply(params, x, ds.adjs)
+    two stages (`sample_batch` + `forward_logits`), which is what makes
+    served logits bit-identical to offline eval on the same (sampler state,
+    batch) pair."""
+    ds = sample_batch(sampler, padded_batch)
+    return forward_logits(apply, params, feature, ds, ids_out=ids_out)
+
+
+def time_eval_split(
+    apply, params, sampler, feature, padded_batch, iters: int = 10
+) -> Tuple[float, float]:
+    """Measured per-call seconds of the two `batch_logits` stages —
+    ``(t_sample_s, t_forward_s)`` at this batch shape — the EVAL-shaped
+    dispatch costs `parallel.scaling.serve_table` wants instead of a
+    train-step proxy. Warms one full untimed pass first; each timed leg
+    syncs once at the end (raw averages — on a tunneled backend the RPC
+    floor bounds both legs identically). One shared implementation so
+    `bench.py` and `scripts/serve_probe.py` report the same methodology."""
+    import time
+
+    ds = sample_batch(sampler, padded_batch)
+    jax.block_until_ready(ds.n_id)
+    jax.block_until_ready(forward_logits(apply, params, feature, ds))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ds = sample_batch(sampler, padded_batch)
+    jax.block_until_ready(ds.n_id)
+    t_sample = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = forward_logits(apply, params, feature, ds)
+    jax.block_until_ready(out)
+    t_forward = (time.perf_counter() - t0) / iters
+    return t_sample, t_forward
 
 
 def sampled_eval(

@@ -382,6 +382,11 @@ def gather_rows(table: np.ndarray, ids: np.ndarray) -> np.ndarray:
     # numpy fallback: enforce the same zero-row contract as the native
     # paths (fancy indexing would instead raise on ids >= N and silently
     # wrap negative ids to end-relative rows)
+    if table.shape[0] == 0:
+        # degenerate zero-row table: every id is out of range, and the
+        # np.where(ok, ids, 0) trick below would still index row 0 of an
+        # empty table (IndexError) where the native engines zero-fill
+        return np.zeros((ids.shape[0], table.shape[1]), table.dtype)
     ok = (ids >= 0) & (ids < table.shape[0])
     if ok.all():
         return np.ascontiguousarray(table[ids])

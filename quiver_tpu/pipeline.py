@@ -27,7 +27,7 @@ program is reused across batches (bounded recompiles).
 from __future__ import annotations
 
 import concurrent.futures
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Iterator, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from .comm import round_up_pow2
 from .feature import Feature
 from .pyg.sage_sampler import DenseSample, GraphSageSampler
-from .trace import trace_scope
+from .trace import SpanRecorder, trace_scope
 
 
 class TieredBatch(NamedTuple):
@@ -194,62 +194,24 @@ class PipelineStats:
     avg_device_sample_s: float = 0.0
     avg_cpu_sample_s: float = 0.0
     device_share: Optional[float] = None
-    # measured stage spans: (stage_name, t0, t1) monotonic pairs recorded
-    # around every stage body and every device step. THE falsifiable
-    # overlap evidence — summarize with `overlap_summary()`; unlike a
-    # seq-minus-pipe subtraction against a separately-timed link probe,
-    # these are one clock over one run. Bounded (deque) so a long-running
-    # pipeline doesn't accumulate spans forever; the summary then covers
-    # the most recent window
-    spans: object = None
+    # measured stage spans (trace.SpanRecorder): (stage_name, t0, t1)
+    # monotonic triples recorded around every stage body and every device
+    # step. THE falsifiable overlap evidence — summarize with
+    # `overlap_summary()`. The recorder snapshots before iterating, so the
+    # summary is safe to read mid-epoch while stage threads still append.
+    # Eagerly constructed: record() is called from all four stage threads,
+    # and a lazy None-check init could race at the first batch and drop
+    # the winner's early spans
+    spans: SpanRecorder = field(default_factory=SpanRecorder)
 
     def record(self, stage: str, t0: float, t1: float) -> None:
-        if self.spans is None:
-            import collections
-
-            self.spans = collections.deque(maxlen=100_000)
-        self.spans.append((stage, t0, t1))
+        self.spans.record(stage, t0, t1)
 
     def overlap_summary(self) -> dict:
-        """Measured concurrency of the recorded spans.
-
-        Returns busy seconds per stage, the union-covered wall, and:
-
-        - ``overlap_frac``: fraction of covered wall during which >= 2
-          stages were active — DIRECT evidence the stages overlap;
-        - ``hidden_frac_measured``: (sum of busy - covered) / sum of
-          busy — the share of total stage work hidden under another
-          stage. 0 = fully serial; (S-1)/S = S stages perfectly stacked.
-        """
-        spans = self.spans or []
-        if not spans:
-            return {}
-        busy: dict = {}
-        events = []
-        for stage, t0, t1 in spans:
-            busy[stage] = busy.get(stage, 0.0) + (t1 - t0)
-            events.append((t0, 1))
-            events.append((t1, -1))
-        events.sort()
-        covered = multi = 0.0
-        depth = 0
-        prev = events[0][0]
-        for t, d in events:
-            if depth >= 1:
-                covered += t - prev
-            if depth >= 2:
-                multi += t - prev
-            depth += d
-            prev = t
-        total_busy = sum(busy.values())
-        return {
-            "busy_s": {k: round(v, 4) for k, v in busy.items()},
-            "covered_wall_s": round(covered, 4),
-            "overlap_frac": round(multi / covered, 4) if covered else 0.0,
-            "hidden_frac_measured": (
-                round((total_busy - covered) / total_busy, 4) if total_busy else 0.0
-            ),
-        }
+        """Measured concurrency of the recorded spans — see
+        :meth:`quiver_tpu.trace.SpanRecorder.overlap_summary` (overlap_frac,
+        hidden_frac_measured, per-stage busy seconds)."""
+        return self.spans.overlap_summary() if self.spans else {}
 
 
 class TrainPipeline:

@@ -394,15 +394,22 @@ def probe_hop_counts(
     seeds_all: jax.Array,
     sizes: Tuple[int, ...],
     sample_fn=None,
+    cache: dict = None,
 ) -> np.ndarray:
     """Per-hop unique-frontier counts over ``m`` probe batches: ``[m, L]``.
 
     One jitted scan over the UNCAPPED dedup pipeline — one dispatch total,
     so probing is cheap even through a high-latency link (PERF_NOTES.md
-    measurement discipline). The default path reuses one module-level
-    compiled program across calls; a custom ``sample_fn`` (e.g. a weighted
-    sampler's one-hop op — caps MUST be calibrated under the distribution
-    they will serve) traces its own scan per call.
+    measurement discipline). The default flat-CSR path reuses one
+    module-level compiled program across calls. A custom ``sample_fn``
+    (the tiled DEFAULT layout and weighted samplers — caps MUST be
+    calibrated under the distribution they will serve) closes over its own
+    graph arrays, so its scan cannot live in the module-level cache; pass
+    ``cache`` (any dict owned by the caller, keyed here by ``sizes``) to
+    reuse the traced scan across calls — `GraphSageSampler.calibrate_caps`
+    passes a per-sampler dict, which is sound because a sampler's layout /
+    weighting / graph (everything ``sample_fn`` closes over) is fixed at
+    construction. Without ``cache``, each call retraces.
     """
     seeds_all = jnp.asarray(seeds_all)
     if sample_fn is None:
@@ -410,19 +417,26 @@ def probe_hop_counts(
             _probe_hop_counts_scan(indptr, indices, key, seeds_all, tuple(sizes))
         )
 
-    @jax.jit
-    def run(key0, batches):
-        def body(_, i):
-            ds = sample_dense_pure(
-                None, None, jax.random.fold_in(key0, i), batches[i],
-                tuple(sizes), sample_fn=sample_fn,
-            )
-            return None, jnp.stack([a.n_src for a in ds.adjs[::-1]])
+    sizes_t = tuple(sizes)
+    run = cache.get(sizes_t) if cache is not None else None
+    if run is None:
 
-        _, counts = jax.lax.scan(
-            body, None, jnp.arange(batches.shape[0], dtype=jnp.int32)
-        )
-        return counts
+        @jax.jit
+        def run(key0, batches):
+            def body(_, i):
+                ds = sample_dense_pure(
+                    None, None, jax.random.fold_in(key0, i), batches[i],
+                    sizes_t, sample_fn=sample_fn,
+                )
+                return None, jnp.stack([a.n_src for a in ds.adjs[::-1]])
+
+            _, counts = jax.lax.scan(
+                body, None, jnp.arange(batches.shape[0], dtype=jnp.int32)
+            )
+            return counts
+
+        if cache is not None:
+            cache[sizes_t] = run
 
     return np.asarray(run(key, seeds_all))
 
@@ -540,6 +554,11 @@ class GraphSageSampler:
         self._dev_arrays = None
         self._dev_tiled = None
         self._w_dev = None
+        # per-sampler probe-scan cache: under the default layout='tiled'
+        # (and for weighted samplers) _engine() hands probe_hop_counts a
+        # fresh sample_fn closure per call, so without this the jitted
+        # probe scan would retrace on EVERY calibrate_caps call
+        self._probe_scan_cache: dict = {}
         if mode == "TPU":
             self.lazy_init_quiver()
         self._host_engine = None
@@ -802,7 +821,7 @@ class GraphSageSampler:
             counts = probe_hop_counts(
                 indptr, indices, self._next_key(),
                 jnp.asarray(batches.astype(np.dtype(id_dtype))), self.sizes,
-                sample_fn=sample_fn,
+                sample_fn=sample_fn, cache=self._probe_scan_cache,
             )
         else:
             rows = []

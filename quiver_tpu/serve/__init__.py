@@ -2,11 +2,15 @@
 
 Turns individual node-prediction requests into efficient fixed-shape device
 work: dynamic micro-batching (bucketed pad-to-fixed shapes, one compiled
-program per bucket), cross-request coalescing (identical seeds within a
-flush window share one sample/gather/forward), and a params-versioned
-embedding cache (hot nodes served from host memory; `update_params`
-invalidates). See `engine.py` for the design and docs/api.md "Online
-serving" for the contract.
+program per bucket, pre-traceable via `ServeEngine.warmup`), cross-request
+coalescing (identical seeds within a flush window share one
+sample/gather/forward), a params-versioned embedding cache (hot nodes
+served from host memory; `update_params` fences in-flight work, then
+invalidates), and pipelined dispatch (flushes run as assemble -> dispatch
+-> resolve stages under a bounded `max_in_flight` window; the sampler key
+stream and replay log stay deterministic in dispatch-index order). See
+`engine.py` for the design and docs/api.md "Online serving" for the
+contract.
 """
 
 from .cache import EmbeddingCache

@@ -1,5 +1,5 @@
-"""Online serving engine: dynamic micro-batching, request coalescing, and a
-params-versioned embedding cache.
+"""Online serving engine: dynamic micro-batching, request coalescing, a
+params-versioned embedding cache, and pipelined dispatch.
 
 `inference.sampled_eval` is an OFFLINE loop: it owns its batch composition
 and pays one sample + gather + forward per 1024 seeds. Online traffic
@@ -24,20 +24,50 @@ work with three levers, applied in order of cheapness:
    ``max_batch`` by default). Fixed buckets mean one compiled program per
    bucket serves all traffic — no per-request recompiles, ever.
 
-The device path is `inference.batch_logits` — the exact `sampled_eval`
-inner step (same sampler stream, same pad convention, same lookup, same
-cached jitted apply). That shared path is what makes served logits
-BIT-IDENTICAL to offline eval on the same (sampler state, batch) pair; the
-parity test replays the engine's dispatch log through a fresh sampler and
-compares exactly (tests/test_serve.py).
+The device path is the exact `sampled_eval` inner step split in two
+(`inference.sample_batch` + `inference.forward_logits` ==
+`inference.batch_logits`: same sampler stream, same pad convention, same
+lookup, same cached jitted apply). That shared path is what makes served
+logits BIT-IDENTICAL to offline eval on the same (sampler state, batch)
+pair; the parity test replays the engine's dispatch log through a fresh
+sampler and compares exactly (tests/test_serve.py).
 
-Threading model: any number of client threads `submit`; one flush runs at a
-time (``_dispatch_lock`` serializes device work and keeps the sampler's
-key stream, ``_call`` indexed, deterministic in dispatch order). The engine
-is fully functional without its background thread — `submit` flushes
-inline when a batch fills, and `pump`/`flush` drive the delay policy
-manually, which is how the deterministic tests run it with an injected
-clock. `start()` adds a poller thread for real deployments.
+**Pipelined dispatch (round 9).** A flush runs three stages:
+
+- **assemble** — drain up to ``max_batch`` pending slots, pad to the
+  bucket, append the dispatch log entry, and draw the sampler's next key
+  (`sample_batch`). Serialized under a small sequencing lock and stamped
+  with a monotonic dispatch index, so the sampler's key stream and the
+  replay log are identical IN DISPATCH ORDER no matter how many flushes
+  are in flight (``dispatch_log[i]`` is the i-th assemble and consumed the
+  sampler's i-th call — the determinism contract the parity replay rides).
+- **dispatch** — the device forward (`forward_logits`) + the blocking D2H.
+  Runs OUTSIDE the sequencing lock, so the next flush assembles (and the
+  host batches/coalesces) while the device executes this one.
+- **resolve** — unpad, cache writeback (version-checked), per-flush slot
+  resolution, latency/stat accounting. Completions may land out of
+  dispatch order; each flush resolves only its OWN slots, so ordering
+  never leaks into results.
+
+``ServeConfig.max_in_flight`` bounds how many flushes may sit between
+assemble and resolve at once (a semaphore window). `flush()` itself stays
+fully synchronous — a lone caller thread behaves exactly like the round-8
+serial engine, and ``max_in_flight=1`` reproduces it bit-for-bit even under
+thread races. Overlap comes from CONCURRENT flush callers: submit-filled
+inline flushes on client threads, and `start()`'s ``max_in_flight`` poller
+threads. Per-stage spans land in ``stats.spans``
+(:class:`quiver_tpu.trace.SpanRecorder`), so measured overlap is reported
+the same honest way the tiered training pipeline reports it
+(``overlap_frac`` = fraction of wall with >= 2 stages active).
+
+`update_params` FENCES: it blocks new assembles, drains every in-flight
+flush, then swaps the weights and bumps the version — so no served logit is
+ever computed from a params tree that changed under it mid-flush, and no
+two in-flight flushes ever straddle a version (which also keeps the
+in-flight coalescing map collision-free). `warmup()` pre-traces every
+bucket's compiled program (through a twin sampler when the sampler supports
+cloning, so the serving key stream is untouched) so first-request latency
+doesn't eat a compile.
 """
 
 from __future__ import annotations
@@ -49,8 +79,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..inference import _cached_apply, batch_logits, pad_seed_batch
-from ..trace import HitRateCounter, LatencyHistogram
+from ..inference import _cached_apply, forward_logits, pad_seed_batch, sample_batch
+from ..trace import HitRateCounter, LatencyHistogram, SpanRecorder
 from .cache import EmbeddingCache
 
 
@@ -81,19 +111,29 @@ class ServeConfig:
                      bucket >= its unique-seed count. Default: powers of
                      two up to ``max_batch``. One compiled program per
                      bucket actually used.
+    max_in_flight  : bounded in-flight window — how many flushes may sit
+                     between assemble and resolve at once. 1 reproduces the
+                     round-8 serial engine bit-for-bit; 2 (default) lets
+                     the host assemble/coalesce the next batch while the
+                     device runs the current one. Overlap requires
+                     concurrent flush callers (inline submit flushes,
+                     `start()`'s pollers); `flush()` itself is synchronous.
     cache_entries  : embedding-cache capacity in rows (0 disables caching).
-    clock          : injectable monotonic clock (seconds) — latency metrics
-                     and the delay policy read ONLY this, so tests drive
-                     flush timing deterministically with a fake clock.
+    clock          : injectable monotonic clock (seconds) — latency metrics,
+                     stage spans, and the delay policy read ONLY this, so
+                     tests drive flush timing deterministically with a fake
+                     clock.
     flush_poll_ms  : background flusher poll period (`start()` mode only).
     record_dispatches : keep a log of (padded_batch, n_valid) per dispatch
                      for parity replay/debugging (off by default: it grows
-                     with traffic).
+                     with traffic). Log order == dispatch-index order ==
+                     sampler key-stream order, even with in-flight > 1.
     """
 
     max_batch: int = 64
     max_delay_ms: float = 2.0
     buckets: Optional[Sequence[int]] = None
+    max_in_flight: int = 2
     cache_entries: int = 100_000
     clock: Callable[[], float] = time.monotonic
     flush_poll_ms: float = 0.2
@@ -167,16 +207,23 @@ class ServeStats:
     the subset answered by attaching to an existing pending/in-flight slot;
     the cache's own hit/miss/eviction counters live in ``cache``.
     ``dispatches`` is the number of device batches actually launched —
-    the acceptance metric "dispatch count < N" reads this."""
+    the acceptance metric "dispatch count < N" reads this.
+    ``inflight_peak`` is the largest number of flushes observed between
+    assemble and resolve at once (<= config.max_in_flight; > 1 is direct
+    evidence the window was used). ``spans`` records per-stage
+    (assemble/dispatch/resolve) spans on the engine's clock —
+    ``spans.overlap_summary()`` is the measured-overlap evidence."""
 
     requests: int = 0
     coalesced: int = 0
     dispatches: int = 0
     dispatched_seeds: int = 0   # unique seeds sent to the device
     padded_seeds: int = 0       # bucket slack rows computed and discarded
+    inflight_peak: int = 0
     dispatch_buckets: Dict[int, int] = field(default_factory=dict)
     cache: HitRateCounter = field(default_factory=HitRateCounter)
     latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+    spans: SpanRecorder = field(default_factory=SpanRecorder)
 
     def snapshot(self) -> Dict[str, object]:
         return {
@@ -185,10 +232,30 @@ class ServeStats:
             "dispatches": self.dispatches,
             "dispatched_seeds": self.dispatched_seeds,
             "padded_seeds": self.padded_seeds,
+            "inflight_peak": self.inflight_peak,
             "dispatch_buckets": dict(self.dispatch_buckets),
             "cache": self.cache.snapshot(),
             "latency": self.latency.snapshot(),
+            "overlap": self.spans.overlap_summary(),
         }
+
+
+class _Flush:
+    """Per-flush state between assemble and resolve: the drained slots and
+    the params snapshot the dispatch will run under. Dispatch ORDER is not
+    carried here — it is the log-append/key-draw order the sequencing lock
+    imposes (`ServeEngine._dispatch_index` counts it)."""
+
+    __slots__ = ("keys", "slots", "params", "seeds", "bucket", "ds", "error")
+
+    def __init__(self, keys, slots, params):
+        self.keys = keys
+        self.slots = slots
+        self.params = params
+        self.seeds = None
+        self.bucket = 0
+        self.ds = None
+        self.error: Optional[BaseException] = None
 
 
 class ServeEngine:
@@ -197,7 +264,8 @@ class ServeEngine:
 
         engine = ServeEngine(model, params, sampler, feature,
                              ServeConfig(max_batch=32, max_delay_ms=2.0))
-        with engine:                      # starts the background flusher
+        engine.warmup()                   # pre-trace every bucket shape
+        with engine:                      # starts the background flushers
             logits = engine.predict([node_id])[0]
 
     or fully synchronous (no thread)::
@@ -210,6 +278,8 @@ class ServeEngine:
     def __init__(self, model, params, sampler, feature,
                  config: Optional[ServeConfig] = None):
         self.config = config or ServeConfig()
+        if self.config.max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
         self._buckets = self.config.resolved_buckets()
         self._apply = _cached_apply(model)
         self._params = params
@@ -226,9 +296,20 @@ class ServeEngine:
         self._pending: "Dict[int, _Slot]" = {}
         self._inflight: Dict[int, _Slot] = {}
         self._lock = threading.Lock()          # queue + cache-version state
-        self._dispatch_lock = threading.Lock() # serializes device work
+        # fence condition over _lock: update_params waits here for every
+        # in-flight flush to resolve before swapping the weights
+        self._fence = threading.Condition(self._lock)
+        # sequencing lock: orders queue drain + dispatch-index assignment +
+        # dispatch-log append + the sampler's key draw, so the key stream
+        # and the replay log stay deterministic in dispatch order
+        self._seq = threading.Lock()
+        # bounded in-flight window: at most max_in_flight flushes between
+        # assemble and resolve (blocking acquire = backpressure on callers)
+        self._window = threading.BoundedSemaphore(self.config.max_in_flight)
+        self._inflight_flushes = 0             # guarded by _lock
+        self._dispatch_index = 0               # guarded by _seq
         self._seed_bufs: Dict[Tuple[int, object], np.ndarray] = {}
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self._running = False
 
     # -- request path -----------------------------------------------------
@@ -285,67 +366,131 @@ class ServeEngine:
         """Apply the flush policy once: flush iff ``max_batch`` or
         ``max_delay_ms`` demands it. Returns seeds dispatched (0 if the
         policy held). This is the deterministic-test / external-event-loop
-        surface; the background thread just calls it on a poll timer."""
+        surface; the background threads just call it on a poll timer."""
         return self.flush() if self.should_flush() else 0
+
+    # -- the three flush stages -------------------------------------------
+
+    def _assemble(self) -> Optional[_Flush]:
+        """Stage 1 (caller must hold a window permit and ``_seq``): drain
+        up to ``max_batch`` pending slots, pad to the bucket, log the
+        dispatch, and draw the sampler's next key. Everything that must be
+        ordered by dispatch index happens here."""
+        with self._lock:
+            if not self._pending:
+                return None
+            keys = list(self._pending)[: self.config.max_batch]
+            slots = [self._pending.pop(k) for k in keys]
+            self._inflight.update(zip(keys, slots))
+            # params snapshot: the fence in update_params guarantees no
+            # swap lands while this flush is in flight, so the snapshot and
+            # every drained slot's version agree
+            fl = _Flush(keys, slots, self._params)
+            self._inflight_flushes += 1
+            self.stats.inflight_peak = max(
+                self.stats.inflight_peak, self._inflight_flushes
+            )
+        self._dispatch_index += 1
+        try:
+            fl.seeds = np.asarray(keys, dtype=np.int64)
+            fl.bucket = self._bucket_for(len(keys))
+            if self.config.max_in_flight == 1:
+                # serial mode: reuse one pad buffer per bucket (round-8
+                # behavior); with in-flight > 1 each flush owns its buffer
+                buf = self._seed_bufs.get((fl.bucket, fl.seeds.dtype.str))
+                padded = pad_seed_batch(fl.seeds, fl.bucket, out=buf)
+                self._seed_bufs[(fl.bucket, fl.seeds.dtype.str)] = padded
+            else:
+                padded = pad_seed_batch(fl.seeds, fl.bucket)
+            if self.config.record_dispatches:
+                self.dispatch_log.append((padded.copy(), len(keys)))
+            fl.ds = sample_batch(self._sampler, padded)
+        except BaseException as exc:  # resolved (with the error) by stage 3
+            fl.error = exc
+        return fl
+
+    def _dispatch(self, fl: _Flush) -> Optional[np.ndarray]:
+        """Stage 2 (no engine lock held): the device forward + blocking
+        D2H. Concurrent across flushes up to the window bound."""
+        logits = np.asarray(
+            forward_logits(self._apply, fl.params, self._feature, fl.ds)
+        )
+        # rows of this array are handed to every waiter AND the cache;
+        # read-only makes an in-place mutation by one caller a loud
+        # ValueError instead of silently corrupting every later cache hit
+        if logits.flags.writeable:
+            logits.setflags(write=False)
+        return logits
+
+    def _resolve(self, fl: _Flush, logits: Optional[np.ndarray]) -> None:
+        """Stage 3: per-flush slot resolution + cache writeback + stats.
+        Safe out of dispatch order — only this flush's slots are touched.
+        Always decrements the in-flight count and wakes the fence."""
+        with self._lock:
+            # one clock sample taken AFTER the lock is held: as the span
+            # start it keeps lock-wait out of stage-overlap evidence, and
+            # as the latency endpoint it keeps lock-wait IN each waiter's
+            # recorded latency (their events are set after this point)
+            now = t_res0 = self._clock()
+            for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
+                self._inflight.pop(k, None)
+                if fl.error is None:
+                    row = logits[i]
+                    if slot.version == self.params_version:
+                        self.cache.put(k, slot.version, row)
+                    slot.resolve(row)
+                else:
+                    slot.resolve(None, error=fl.error)
+                for t0 in slot.waiters:
+                    self.stats.latency.record_ms((now - t0) * 1e3)
+            if fl.error is None:
+                self.stats.dispatches += 1
+                self.stats.dispatched_seeds += len(fl.keys)
+                self.stats.padded_seeds += fl.bucket - len(fl.keys)
+                self.stats.dispatch_buckets[fl.bucket] = (
+                    self.stats.dispatch_buckets.get(fl.bucket, 0) + 1
+                )
+            self._inflight_flushes -= 1
+            self._fence.notify_all()
+            self.stats.spans.record("resolve", t_res0, self._clock())
 
     def flush(self) -> int:
         """Dispatch up to ``max_batch`` pending unique seeds as one bucket-
         padded device batch NOW (policy bypassed). Returns the number of
-        unique seeds dispatched."""
-        with self._dispatch_lock:
-            with self._lock:
-                if not self._pending:
-                    return 0
-                keys = list(self._pending)[: self.config.max_batch]
-                slots = [self._pending.pop(k) for k in keys]
-                self._inflight.update(zip(keys, slots))
-                # params snapshot only: version checks below deliberately
-                # re-read self.params_version so a concurrent update_params
-                # suppresses caching of the now-stale rows
-                params = self._params
-            try:
-                seeds = np.asarray(keys, dtype=np.int64)
-                bucket = self._bucket_for(len(seeds))
-                buf = self._seed_bufs.get((bucket, seeds.dtype.str))
-                padded = pad_seed_batch(seeds, bucket, out=buf)
-                self._seed_bufs[(bucket, seeds.dtype.str)] = padded
-                if self.config.record_dispatches:
-                    self.dispatch_log.append((padded.copy(), len(seeds)))
-                logits = np.asarray(batch_logits(
-                    self._apply, params, self._sampler, self._feature, padded
-                ))
-                # rows of this array are handed to every waiter AND the
-                # cache; read-only makes an in-place mutation by one caller
-                # a loud ValueError instead of silently corrupting every
-                # later cache hit for the node
-                if logits.flags.writeable:
-                    logits.setflags(write=False)
-                err = None
-            except BaseException as exc:  # resolve waiters, then re-raise
-                logits, err = None, exc
-            now = self._clock()
-            with self._lock:
-                for i, (k, slot) in enumerate(zip(keys, slots)):
-                    self._inflight.pop(k, None)
-                    if err is None:
-                        row = logits[i]
-                        if slot.version == self.params_version:
-                            self.cache.put(k, slot.version, row)
-                        slot.resolve(row)
-                    else:
-                        slot.resolve(None, error=err)
-                    for t0 in slot.waiters:
-                        self.stats.latency.record_ms((now - t0) * 1e3)
-                if err is None:
-                    self.stats.dispatches += 1
-                    self.stats.dispatched_seeds += len(seeds)
-                    self.stats.padded_seeds += bucket - len(seeds)
-                    self.stats.dispatch_buckets[bucket] = (
-                        self.stats.dispatch_buckets.get(bucket, 0) + 1
-                    )
-            if err is not None:
-                raise err
-            return len(seeds)
+        unique seeds dispatched.
+
+        Synchronous: assemble -> dispatch -> resolve run on the calling
+        thread, and any stage error re-raises here (after resolving every
+        drained slot with it). Pipelining comes from concurrent callers —
+        up to ``max_in_flight`` flushes may overlap, with assembles (and
+        the sampler key stream) serialized in dispatch order."""
+        self._window.acquire()
+        fl = None
+        try:
+            with self._seq:
+                # the span opens AFTER _seq is held: a caller blocked
+                # behind another flush's assemble is idle, not working,
+                # and counting the wait would fake stage overlap
+                t0 = self._clock()
+                fl = self._assemble()
+                if fl is not None:
+                    self.stats.spans.record("assemble", t0, self._clock())
+            if fl is None:
+                return 0
+            logits = None
+            if fl.error is None:
+                t0 = self._clock()
+                try:
+                    logits = self._dispatch(fl)
+                except BaseException as exc:
+                    fl.error = exc
+                self.stats.spans.record("dispatch", t0, self._clock())
+            self._resolve(fl, logits)  # records its own post-lock span
+            if fl.error is not None:
+                raise fl.error
+            return len(fl.keys)
+        finally:
+            self._window.release()
 
     def _bucket_for(self, n: int) -> int:
         for b in self._buckets:
@@ -367,42 +512,107 @@ class ServeEngine:
             self.stats = ServeStats()
             self.cache.counters = self.stats.cache
 
+    # -- warmup -----------------------------------------------------------
+
+    def _warmup_sampler(self):
+        """A twin of the serving sampler (same topology/seed/config) for
+        warmup traffic, so pre-tracing consumes the TWIN's key stream and
+        the serving stream + replay log stay untouched. None when the
+        sampler doesn't support the share_ipc/lazy_from_ipc_handle clone
+        protocol."""
+        s = self._sampler
+        try:
+            return type(s).lazy_from_ipc_handle(s.share_ipc())
+        except Exception:
+            return None
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> Dict[int, float]:
+        """Pre-trace the compiled program for every bucket shape so the
+        first REAL request at each bucket doesn't eat a compile. Returns
+        {bucket: seconds} (wall time per warm dispatch — compile time on
+        first call, execution time thereafter).
+
+        Uses a twin sampler when available (key stream untouched);
+        otherwise runs through the serving sampler under the sequencing
+        lock and appends an ``n_valid=0`` entry to the dispatch log, so a
+        parity replay still consumes the same key indices."""
+        buckets = self._buckets if buckets is None else tuple(
+            sorted(int(b) for b in buckets)
+        )
+        twin = self._warmup_sampler()
+        with self._lock:
+            params = self._params
+        times: Dict[int, float] = {}
+        for b in buckets:
+            padded = np.zeros(b, np.int64)
+            t0 = time.perf_counter()
+            if twin is not None:
+                ds = sample_batch(twin, padded)
+            else:
+                with self._seq:
+                    self._dispatch_index += 1
+                    if self.config.record_dispatches:
+                        self.dispatch_log.append((padded.copy(), 0))
+                    ds = sample_batch(self._sampler, padded)
+            np.asarray(forward_logits(self._apply, params, self._feature, ds))
+            times[b] = time.perf_counter() - t0
+        return times
+
     # -- weight updates ---------------------------------------------------
 
     def update_params(self, params) -> None:
-        """Install new weights: bump ``params_version`` and invalidate the
-        embedding cache. Pending (not yet dispatched) slots are re-stamped
-        to the new version — their flush will compute under the new weights.
-        In-flight flushes of the OLD version still resolve their waiters
-        (those requests were accepted under the old weights) but their
-        results are never cached under the new version."""
-        with self._lock:
-            self._params = params
-            self.params_version += 1
-            self.cache.invalidate()
-            for slot in self._pending.values():
-                slot.version = self.params_version
-
-    # -- background flusher -----------------------------------------------
+        """Install new weights behind a FENCE: block new assembles (the
+        sequencing lock), wait for every in-flight flush to resolve, then
+        bump ``params_version`` and invalidate the embedding cache — so no
+        served logit ever crosses a weight update mid-flush. Pending (not
+        yet dispatched) slots are re-stamped to the new version — their
+        flush will compute under the new weights. Requests resolved by the
+        drained in-flight flushes were accepted under the old weights and
+        keep their old-version results (never cached past the bump)."""
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                self._params = params
+                self.params_version += 1
+                self.cache.invalidate()
+                for slot in self._pending.values():
+                    slot.version = self.params_version
+    # -- background flushers ----------------------------------------------
 
     def start(self) -> "ServeEngine":
+        """Start ``max_in_flight`` poller threads, each applying the flush
+        policy on a timer. With a window > 1 the pollers (plus inline
+        submit flushes) are what actually overlap assemble with device
+        execution for single-threaded clients."""
         if self._running:
             return self
         self._running = True
-        self._thread = threading.Thread(
-            target=self._poll_loop, name="quiver-serve-flusher", daemon=True
-        )
-        self._thread.start()
+        self._threads = [
+            threading.Thread(
+                target=self._poll_loop,
+                name=f"quiver-serve-flusher-{i}",
+                daemon=True,
+            )
+            for i in range(self.config.max_in_flight)
+        ]
+        for t in self._threads:
+            t.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
         self._running = False
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        for t in self._threads:
+            t.join()
+        self._threads = []
         if drain:
             while self._drainable():
                 self.flush()
+        # even without drain, leave no flush mid-air: callers expect stats
+        # and handles quiescent after stop()
+        with self._fence:
+            while self._inflight_flushes:
+                self._fence.wait()
 
     def _poll_loop(self) -> None:
         while self._running:

@@ -139,11 +139,105 @@ def gbps(
     return num_rows * feature_dim * bytes_per_elem / max(seconds, 1e-12) / 1e9
 
 
-# -- serving metrics ----------------------------------------------------------
+# -- stage-span overlap evidence ----------------------------------------------
 
 import bisect
 import math
 import threading
+
+
+class SpanRecorder:
+    """Bounded recorder of (stage, t0, t1) monotonic spans + the measured
+    concurrency summary — THE falsifiable overlap evidence for any staged
+    pipeline here (the tiered `TrainPipeline` and the pipelined
+    `ServeEngine` both record into one of these; unlike a seq-minus-pipe
+    subtraction against a separately-timed probe, every span shares one
+    clock over one run).
+
+    Bounded (deque) so a long-running pipeline doesn't accumulate spans
+    forever; the summary then covers the most recent window. Appends are
+    thread-safe (deque.append is atomic); `overlap_summary` snapshots the
+    deque with ``tuple()`` FIRST — stage threads may still be appending,
+    and iterating a deque being mutated raises RuntimeError.
+
+    Iterable/len/bool behave like the underlying span sequence, so callers
+    can keep treating it as a list of (stage, t0, t1) triples.
+    """
+
+    def __init__(self, maxlen: int = 100_000):
+        import collections
+
+        self._spans = collections.deque(maxlen=maxlen)
+
+    def record(self, stage: str, t0: float, t1: float) -> None:
+        self._spans.append((stage, t0, t1))
+
+    def _snapshot(self) -> tuple:
+        # tuple(deque) iterates, and a deque iterator raises RuntimeError if
+        # the deque is appended to mid-iteration — retry; a handful of
+        # attempts always wins because each copy is a single C-level pass
+        for _ in range(64):
+            try:
+                return tuple(self._spans)
+            except RuntimeError:
+                continue
+        return ()
+
+    def __iter__(self):
+        return iter(self._snapshot())
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    def __bool__(self) -> bool:
+        return bool(self._spans)
+
+    def clear(self) -> None:
+        self._spans.clear()
+
+    def overlap_summary(self) -> dict:
+        """Measured concurrency of the recorded spans.
+
+        Returns busy seconds per stage, the union-covered wall, and:
+
+        - ``overlap_frac``: fraction of covered wall during which >= 2
+          stages were active — DIRECT evidence the stages overlap;
+        - ``hidden_frac_measured``: (sum of busy - covered) / sum of
+          busy — the share of total stage work hidden under another
+          stage. 0 = fully serial; (S-1)/S = S stages perfectly stacked.
+        """
+        spans = self._snapshot()  # stages may still be appending
+        if not spans:
+            return {}
+        busy: dict = {}
+        events = []
+        for stage, t0, t1 in spans:
+            busy[stage] = busy.get(stage, 0.0) + (t1 - t0)
+            events.append((t0, 1))
+            events.append((t1, -1))
+        events.sort()
+        covered = multi = 0.0
+        depth = 0
+        prev = events[0][0]
+        for t, d in events:
+            if depth >= 1:
+                covered += t - prev
+            if depth >= 2:
+                multi += t - prev
+            depth += d
+            prev = t
+        total_busy = sum(busy.values())
+        return {
+            "busy_s": {k: round(v, 4) for k, v in busy.items()},
+            "covered_wall_s": round(covered, 4),
+            "overlap_frac": round(multi / covered, 4) if covered else 0.0,
+            "hidden_frac_measured": (
+                round((total_busy - covered) / total_busy, 4) if total_busy else 0.0
+            ),
+        }
+
+
+# -- serving metrics ----------------------------------------------------------
 
 
 class LatencyHistogram:

@@ -24,19 +24,38 @@ def main():
     ap.add_argument("--ici-gbps", type=float, default=90.0)
     ap.add_argument("--dcn-gbps", type=float, default=25.0)
     ap.add_argument("--steps-per-epoch", type=int, default=193)
+    # eval-shaped serve dispatch cost (NEXT.md follow-up b): sample +
+    # forward at --serve-ref-batch, measured by bench.py's serve section
+    # (context serve_sample_s/serve_forward_s) or passed directly. When
+    # present, serve_table prices QPS from THESE instead of the
+    # pessimistic train-step bound.
+    ap.add_argument("--serve-sample-ms", type=float, default=None)
+    ap.add_argument("--serve-forward-ms", type=float, default=None)
+    ap.add_argument("--serve-ref-batch", type=int, default=64)
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
 
     step_s = (args.step_ms or 0) / 1e3
     source = f"--step-ms {args.step_ms}"
-    if not step_s and args.bench:
+    serve_sample_s = (args.serve_sample_ms or 0) / 1e3
+    serve_forward_s = (args.serve_forward_ms or 0) / 1e3
+    serve_ref_batch = args.serve_ref_batch
+    serve_source = "--serve-sample-ms/--serve-forward-ms"
+    if args.bench:
         with open(args.bench) as fh:
             data = json.load(fh)
         ctx = (data.get("parsed") or data).get("context", {})
-        epoch = ctx.get("e2e_fused_epoch_s")
-        if epoch:
-            step_s = epoch / args.steps_per_epoch
-            source = f"{args.bench} e2e_fused_epoch_s={epoch}"
+        if not step_s:
+            epoch = ctx.get("e2e_fused_epoch_s")
+            if epoch:
+                step_s = epoch / args.steps_per_epoch
+                source = f"{args.bench} e2e_fused_epoch_s={epoch}"
+        if not (serve_sample_s or serve_forward_s):
+            if ctx.get("serve_sample_s") or ctx.get("serve_forward_s"):
+                serve_sample_s = ctx.get("serve_sample_s", 0.0)
+                serve_forward_s = ctx.get("serve_forward_s", 0.0)
+                serve_ref_batch = ctx.get("serve_eval_ref_batch", serve_ref_batch)
+                serve_source = f"{args.bench} serve_sample_s/serve_forward_s"
     if not step_s:
         step_s = 0.0415  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
         source = "PERF_NOTES.md round-4 default 41.5 ms"
@@ -77,25 +96,48 @@ def main():
         "## Quantized feature store: per-codec capacity / byte table "
         "(products config, D=100)\n\n" + format_quant_markdown(quant_rows)
     )
-    # online-serving QPS model from the SAME single-chip step time. Two
-    # opposing biases, called out per row: feeding the TRAIN step cost is
-    # pessimistic at the reference batch (a serve dispatch skips backward +
-    # update), but the linear down-scaling to small buckets omits fixed
-    # per-dispatch overhead and is optimistic there (serve_table docstring)
-    serve_rows = serve_table(
-        step_s, 0.0, 0.0, ref_batch=1024, buckets=(64, 256, 1024),
-        hit_rates=(0.0, 0.5, 0.9), unique_frac=0.8, max_delay_ms=2.0,
-    )
+    # online-serving QPS model. Preferred cost input: the EVAL-SHAPED
+    # dispatch split (sample_batch + forward_logits, measured by bench.py's
+    # serve section / serve_probe.py) — a serve dispatch IS that step.
+    # Fallback when no split is available: the train step, pessimistic at
+    # the reference batch (it additionally pays backward + update). Either
+    # way the linear down-scaling to small buckets omits fixed per-dispatch
+    # overhead and is optimistic there (serve_table docstring).
+    if serve_sample_s or serve_forward_s:
+        serve_rows = serve_table(
+            serve_sample_s, 0.0, serve_forward_s, ref_batch=serve_ref_batch,
+            buckets=(64, 256, 1024), hit_rates=(0.0, 0.5, 0.9),
+            unique_frac=0.8, max_delay_ms=2.0,
+        )
+        serve_cost_note = (
+            "Device cost per dispatch is the MEASURED eval-shaped split "
+            f"(sample {serve_sample_s*1e3:.2f} ms +\nforward "
+            f"{serve_forward_s*1e3:.2f} ms at batch {serve_ref_batch}; "
+            f"source: {serve_source}) — the exact\nsample_batch + "
+            "forward_logits stages a serve dispatch runs, no train-step "
+            "proxy."
+        )
+    else:
+        serve_rows = serve_table(
+            step_s, 0.0, 0.0, ref_batch=1024, buckets=(64, 256, 1024),
+            hit_rates=(0.0, 0.5, 0.9), unique_frac=0.8, max_delay_ms=2.0,
+        )
+        serve_cost_note = (
+            "Device cost per dispatch is the measured TRAIN step at batch "
+            "1024 (pessimistic: a serve\ndispatch runs the same sample + "
+            "gather + forward but no backward/update — pass the\nmeasured "
+            "split via --serve-sample-ms/--serve-forward-ms or a bench "
+            "artifact with\nserve_sample_s to drop the proxy)."
+        )
     serve_md = (
         "## Online serving: predicted QPS vs bucket / cache hit "
         "rate (quiver_tpu.serve)\n\n"
-        "Device cost per dispatch is the measured TRAIN step at batch 1024 "
-        "(pessimistic: a serve\ndispatch runs the same sample + gather + "
-        "forward but no backward/update), scaled\nlinearly to each bucket "
-        "(OPTIMISTIC at small buckets: fixed per-dispatch overhead is\n"
-        "omitted — see the serve_table docstring). Bucket-1024 rows are "
-        "floors; bucket-64 rows\nare not. The measured counterpart with the "
-        "real engine is scripts/serve_probe.py ->\nSERVE_r01.json.\n\n"
+        + serve_cost_note
+        + " Scaled linearly to each bucket (OPTIMISTIC at small\nbuckets: "
+        "fixed per-dispatch overhead is omitted — see the serve_table "
+        "docstring).\nThe measured counterpart with the real engine is "
+        "scripts/serve_probe.py ->\nSERVE_r02.json (pipelined window sweep) "
+        "and SERVE_r01.json (cache/skew sweep).\n\n"
         + format_serve_markdown(serve_rows)
     )
     print(md, file=sys.stderr)
@@ -120,6 +162,12 @@ def main():
     print(json.dumps({
         "step_s_1chip": step_s,
         "source": source,
+        "serve_cost_source": (
+            serve_source if (serve_sample_s or serve_forward_s)
+            else "train-step proxy"
+        ),
+        "serve_sample_s": serve_sample_s,
+        "serve_forward_s": serve_forward_s,
         "rows": [r._asdict() for r in rows],
         "sharded_fetch": [r._asdict() for r in fetch_rows],
         "quant_fetch": [r._asdict() for r in quant_rows],

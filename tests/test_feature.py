@@ -289,3 +289,28 @@ def test_gather_rows_fallback_same_contract():
         else:
             # -1/-3 must be ZERO rows (not wrap to table[9]/table[7])
             assert (got[i] == 0).all()
+
+
+def test_gather_rows_zero_row_table_both_paths():
+    """Degenerate zero-row table (e.g. an empty cold tier): every id is out
+    of range, so the contract demands all-zero rows on EVERY path. The
+    numpy fallback used to IndexError here — its np.where(ok, ids, 0)
+    rewrite still indexes row 0 of an empty table (ADVICE.md round 5)."""
+    from quiver_tpu.ops import cpu_kernels
+    from quiver_tpu.ops.cpu_kernels import gather_rows
+
+    ids = np.array([0, 3, -1], np.int64)
+    for dtype in (np.float32, np.int32):
+        empty = np.zeros((0, 5), dtype)
+        # whatever engine is loaded (native or fallback)
+        got = gather_rows(empty, ids)
+        assert got.shape == (3, 5) and got.dtype == dtype and (got == 0).all()
+        # the numpy fallback explicitly (a C-contiguous zero-row table
+        # would otherwise ride the native path when the .so is present)
+        saved = cpu_kernels._LIB, cpu_kernels._LIB_TRIED
+        cpu_kernels._LIB, cpu_kernels._LIB_TRIED = None, True
+        try:
+            got = gather_rows(empty, ids)
+        finally:
+            cpu_kernels._LIB, cpu_kernels._LIB_TRIED = saved
+        assert got.shape == (3, 5) and got.dtype == dtype and (got == 0).all()

@@ -300,6 +300,25 @@ def test_calibrate_caps_host_mode_matches_tpu(graph):
         assert abs(a - b) <= 32, (caps_t, caps_h)
 
 
+def test_calibrate_caps_reuses_traced_probe_scan(graph):
+    """ADVICE.md round 5: under the default layout='tiled', _engine() hands
+    probe_hop_counts a fresh sample_fn closure per call, so the jitted
+    probe scan used to retrace on EVERY calibrate_caps call. The traced run
+    is now memoized per (sampler, sizes) — a second calibration reuses the
+    same jitted callable with no new trace."""
+    sampler = GraphSageSampler(graph, sizes=[4, 3], mode="TPU", seed=0)
+    assert sampler.layout == "tiled"  # the default config the cache is for
+    rng = np.random.default_rng(7)
+    probes = rng.integers(0, graph.node_count, (4, 16))
+    sampler.calibrate_caps(probes, granule=16, set_caps=False)
+    cache = sampler._probe_scan_cache
+    assert set(cache) == {(4, 3)}
+    run = cache[(4, 3)]
+    assert run._cache_size() == 1            # traced exactly once
+    sampler.calibrate_caps(probes, granule=16, set_caps=False)
+    assert cache[(4, 3)] is run and run._cache_size() == 1  # no retrace
+
+
 def _pl_inclusion_probs(weights, k):
     """Exact inclusion probabilities of successive (Plackett-Luce)
     weighted sampling WITHOUT replacement — the reference weight_sample

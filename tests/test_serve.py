@@ -334,6 +334,214 @@ def test_served_rows_are_read_only_and_reset_stats_repoints_counters(setup):
     assert eng.stats.dispatches == 0
 
 
+# -- pipelined dispatch (bounded in-flight window, round 9) -------------------
+
+import time as _time
+
+
+class _GateFeature:
+    """Raw-table lookalike whose gather can be slowed per dispatch — the
+    lever the pipelining tests use to hold one flush in its DISPATCH stage
+    while another assembles and resolves. Value-identical to the plain
+    table, so replay parity against the real `feat` still holds."""
+
+    def __init__(self, table):
+        self.table = table
+        self.delays = []           # seconds per dispatch, consumed FIFO
+        self.started = threading.Event()  # set when a dispatch enters
+        self._lock = threading.Lock()
+
+    def __getitem__(self, n_id):
+        with self._lock:
+            delay = self.delays.pop(0) if self.delays else 0.0
+        self.started.set()
+        if delay:
+            _time.sleep(delay)
+        ids = np.clip(np.asarray(n_id), 0, self.table.shape[0] - 1)
+        return jnp.asarray(self.table[ids])
+
+
+def make_gated_engine(setup, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("record_dispatches", True)
+    gate = _GateFeature(feat)
+    eng = ServeEngine(model, params, make_sampler(), gate, ServeConfig(**cfg_kw))
+    return eng, gate
+
+
+def test_pipelined_out_of_order_resolution_and_replay_parity(setup):
+    """The acceptance pin for the bounded in-flight window: flush B
+    assembles + dispatches + RESOLVES while flush A is still in its
+    dispatch stage, the dispatch log stays in assemble (dispatch-index)
+    order, and every served row still replays bit-identical through the
+    offline path — out-of-order completion never leaks into results."""
+    eng, gate = make_gated_engine(
+        setup, max_batch=4, max_delay_ms=1e9, max_in_flight=2, cache_entries=512,
+    )
+    eng.warmup()                 # compiles off the race-sensitive window
+    gate.delays = [3.0]          # first REAL dispatch stalls mid-flight
+    gate.started.clear()
+    h1 = [eng.submit(i) for i in (0, 1, 2)]
+    t_a = threading.Thread(target=eng.flush)
+    t_a.start()
+    assert gate.started.wait(30)            # flush A is in its dispatch stage
+    h2 = [eng.submit(i) for i in (10, 11, 12)]
+    eng.flush()                             # flush B: full trip under A
+    # B resolved while A is still dispatching: out-of-order completion
+    assert all(h.done() for h in h2)
+    assert not any(h.done() for h in h1)
+    assert eng.stats.inflight_peak == 2     # the window was actually used
+    t_a.join()
+    assert all(h.done() for h in h1)
+    # the dispatch log is in ASSEMBLE order (A first), not completion order
+    assert [list(p[:n]) for p, n in eng.dispatch_log] == [[0, 1, 2], [10, 11, 12]]
+    # and replays bit-identical through the offline batch_logits path
+    oracle = replay_oracle(setup, eng)
+    for nid, h in zip((0, 1, 2, 10, 11, 12), h1 + h2):
+        assert np.array_equal(h.result(timeout=30), oracle[nid])
+    assert eng.stats.dispatches == 2 and eng.stats.dispatched_seeds == 6
+    # measured stage spans exist for all three stages
+    stages = {s for s, _, _ in eng.stats.spans}
+    assert stages == {"assemble", "dispatch", "resolve"}
+    ov = eng.stats.spans.overlap_summary()
+    assert ov and 0.0 <= ov["overlap_frac"] <= 1.0
+
+
+def test_serial_and_pipelined_configs_bit_equal_single_threaded(setup):
+    """``max_in_flight=1`` reproduces the round-8 serial engine; and for a
+    single-threaded caller the window size must not change behavior at all:
+    same dispatch log, same served logits, bit for bit."""
+    trace = zipfian_trace(N_NODES, 60, alpha=0.9, seed=5)
+    outs, logs = [], []
+    for mif in (1, 2, 4):
+        eng = make_engine(
+            setup, max_batch=8, max_delay_ms=1e9, cache_entries=512,
+            max_in_flight=mif,
+        )
+        outs.append(eng.predict(trace))
+        logs.append(eng.dispatch_log)
+    for out, log in zip(outs[1:], logs[1:]):
+        assert np.array_equal(outs[0], out)
+        assert len(logs[0]) == len(log)
+        for (p0, n0), (p1, n1) in zip(logs[0], log):
+            assert n0 == n1 and np.array_equal(p0, p1)
+
+
+def test_update_params_fences_inflight_dispatch(setup):
+    """`update_params` must drain in-flight work before swapping weights:
+    it blocks until the stalled flush resolves, the old-version rows are
+    never served from cache after the bump, and the post-update predict
+    recomputes under the new weights."""
+    model, params, feat = setup
+    eng, gate = make_gated_engine(
+        setup, max_batch=4, max_delay_ms=1e9, max_in_flight=2, cache_entries=512,
+    )
+    eng.warmup()
+    gate.delays = [1.5]
+    gate.started.clear()
+    h = eng.submit(7)
+    t_a = threading.Thread(target=eng.flush)
+    t_a.start()
+    assert gate.started.wait(30)           # flush in its dispatch stage
+    params2 = jax.tree_util.tree_map(lambda a: a + 0.25, params)
+    eng.update_params(params2)             # must FENCE: wait for the flush
+    assert h.done()                        # drained before the swap landed
+    assert eng.params_version == 1 and len(eng.cache) == 0
+    t_a.join()
+    out_v0 = h.result()
+    d = eng.stats.dispatches
+    out_v1 = eng.predict([7])[0]
+    assert eng.stats.dispatches == d + 1   # recomputed under new weights
+    assert not np.array_equal(out_v0, out_v1)
+
+
+def test_threaded_clients_racing_update_params(setup):
+    """Clients hammering `predict` while the trainer thread swaps weights
+    repeatedly: no deadlock, no crash, every handle resolves, and the
+    engine lands quiescent at the final version with nothing in flight."""
+    model, params, feat = setup
+    eng = make_engine(
+        setup, max_batch=8, max_delay_ms=1.0, flush_poll_ms=0.5,
+        cache_entries=512, max_in_flight=2,
+    )
+    trace = zipfian_trace(N_NODES, 64, alpha=1.1, seed=23)
+    errors = []
+
+    def client(tid):
+        try:
+            out = eng.predict(trace[tid * 8 : (tid + 1) * 8], timeout=60)
+            assert np.isfinite(out).all()
+        except Exception as exc:
+            errors.append(exc)
+
+    with eng:
+        threads = [threading.Thread(target=client, args=(t,)) for t in range(8)]
+        [t.start() for t in threads]
+        for v in range(3):
+            _time.sleep(0.05)
+            eng.update_params(
+                jax.tree_util.tree_map(lambda a: a * 1.01, params)
+            )
+        [t.join() for t in threads]
+    assert not errors
+    assert eng.params_version == 3
+    assert eng._inflight_flushes == 0 and not eng._inflight
+    assert eng.stats.requests == 64
+
+
+def test_dispatch_index_order_pinned_under_deterministic_clock(setup):
+    """Dispatch-index ordering under an injected clock: the dispatch log is
+    exactly the assemble sequence the flush policy produced, and the stage
+    spans read ONLY the injected clock."""
+    t = [0.0]
+    eng = make_engine(
+        setup, max_batch=4, max_delay_ms=5.0, max_in_flight=2,
+        clock=lambda: t[0],
+    )
+    eng.submit(1)
+    eng.submit(2)
+    assert eng.pump() == 0                 # young + underfull: policy holds
+    t[0] += 0.006
+    assert eng.pump() == 2                 # aged out: dispatch index 0
+    eng.submit(3)
+    t[0] += 0.006
+    assert eng.pump() == 1                 # dispatch index 1
+    for i in (4, 5, 6, 7):                 # 4th submit fills max_batch:
+        eng.submit(i)                      # inline flush, dispatch index 2
+    assert [list(p[:n]) for p, n in eng.dispatch_log] == [[1, 2], [3], [4, 5, 6, 7]]
+    assert eng._dispatch_index == 3
+    assert eng.stats.dispatch_buckets == {2: 1, 1: 1, 4: 1}
+    # spans carry injected-clock timestamps only (all within [0, t])
+    assert len(eng.stats.spans) == 9       # 3 flushes x 3 stages
+    for _, t0, t1 in eng.stats.spans:
+        assert 0.0 <= t0 <= t1 <= t[0]
+
+
+def test_warmup_pretraces_buckets_without_touching_key_stream(setup):
+    """`warmup()` compiles every bucket's program up front (no compile on
+    the first real request) and — when the sampler supports cloning — does
+    NOT consume the serving sampler's key stream: the replay parity that
+    defines the engine's determinism contract still holds afterwards."""
+    eng = make_engine(setup, max_batch=8, max_delay_ms=1e9, cache_entries=512)
+    times = eng.warmup()
+    assert set(times) == {1, 2, 4, 8}
+    assert all(v > 0 for v in times.values())
+    assert eng.dispatch_log == []          # twin sampler: log untouched
+    if hasattr(eng._apply, "_cache_size"):
+        before = eng._apply._cache_size()
+    next_id = iter(range(N_NODES))
+    handles = []
+    for n in (3, 8, 2):                    # buckets 4, 8, 2 — all pre-warmed
+        ids = [next(next_id) for _ in range(n)]
+        handles += [(i, eng.submit(i)) for i in ids]
+        eng.flush()
+    if hasattr(eng._apply, "_cache_size"):
+        assert eng._apply._cache_size() == before   # no post-warmup compile
+    oracle = replay_oracle(setup, eng)     # key stream unperturbed by warmup
+    for nid, h in handles:
+        assert np.array_equal(h.result(), oracle[nid])
+
+
 # -- error propagation --------------------------------------------------------
 
 def test_flush_error_resolves_waiters(setup):
