@@ -931,6 +931,64 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
                 + (f", overlap {ov.get('overlap_frac', 0.0):.0%}" if mif > 1 else "")
             )
 
+    # distributed serving (round 10): seed-ownership routed engine at
+    # hosts=2 over the SAME graph, exchange='host' (one chip — the hops
+    # are host-side here; the collective leg is covered by the CPU-tier
+    # probe and the 2-process harness). The hardware-true signal on this
+    # box is the per-shard sub-batch width (~half the router flush), the
+    # shard edge fraction (halo included, honestly), and in-run replay
+    # parity; QPS shares one chip so it is a routing-overhead floor, not
+    # a scaling number.
+    try:
+        from quiver_tpu.serve import (
+            DistServeConfig, DistServeEngine, replay_shard_oracle,
+        )
+
+        dist = DistServeEngine.build(
+            model, params, topo, table, [15, 10, 5], hosts=2,
+            config=DistServeConfig(
+                hosts=2, max_batch=64, max_delay_ms=2.0, exchange="host",
+                record_dispatches=True,
+                shard_config=ServeConfig(
+                    max_batch=64, buckets=(64,), max_delay_ms=2.0,
+                    record_dispatches=True,
+                ),
+            ),
+            sampler_seed=11, sampler_kw={"caps": caps},
+        )
+        dist.warmup()
+        dist.reset_stats()
+        n_dist = min(n_requests, 96)
+        trace = zipfian_trace(n_nodes, n_dist, alpha=0.99, seed=19)
+        t0 = time.time()
+        out = dist.predict(trace)
+        wall = time.time() - t0
+        oracle = replay_shard_oracle(dist, model, params, make_sampler, table)
+        parity = all(
+            np.array_equal(out[i], oracle[int(nid)]) for i, nid in enumerate(trace)
+        )
+        sd = dist.stats
+        context["serve_dist2_qps"] = round(n_dist / wall, 1)
+        context["serve_dist2_parity"] = parity
+        context["serve_dist2_router_dispatches"] = sd.router_dispatches
+        context["serve_dist2_mean_sub_batch_width"] = {
+            str(h): round(w, 2) for h, w in sd.mean_sub_batch_width().items()
+        }
+        context["serve_dist2_edge_frac"] = {
+            str(h): round(st["edge_frac"], 4)
+            for h, st in dist.shard_topo_stats.items()
+        }
+        log(
+            f"serve dist hosts=2: {n_dist / wall:.0f} QPS (1-chip floor), "
+            f"widths {context['serve_dist2_mean_sub_batch_width']}, "
+            f"edge frac {context['serve_dist2_edge_frac']}, parity={parity}"
+        )
+        if not parity:
+            log("serve dist PARITY VIOLATION — investigate before trusting r10")
+    except Exception as exc:
+        context["serve_dist2_error"] = repr(exc)
+        log(f"serve dist bench failed: {exc}")
+
 
 def wait_for_backend(max_wait_s=None):
     """The axon tunnel can be down for stretches (observed: hours). Probe

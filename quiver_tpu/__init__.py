@@ -30,7 +30,7 @@ from . import comm, pyg, trace
 from . import quant
 from . import serve
 from .quant import QuantizedFeature
-from .serve import ServeConfig, ServeEngine
+from .serve import DistServeConfig, DistServeEngine, ServeConfig, ServeEngine
 from .comm import HostRankTable, NcclComm, TpuComm, getNcclId
 from .pipeline import (
     TieredBatch,
@@ -69,6 +69,8 @@ __all__ = [
     "quant",
     "QuantizedFeature",
     "serve",
+    "DistServeConfig",
+    "DistServeEngine",
     "ServeConfig",
     "ServeEngine",
     "inference",

@@ -26,6 +26,7 @@ collective runs hermetically on an N-device CPU mesh (tests/test_comm.py).
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -36,6 +37,20 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ID_PAD = np.int64(-1)
+
+# Collective launches from one process must be SERIALIZED: XLA's CPU
+# collectives rendezvous participants by (run_id, op_id), and two threads
+# launching multi-device programs concurrently can interleave participants
+# from different runs into one rendezvous — a hard deadlock (observed with
+# two in-flight serve flushes both reaching the feature exchange). This is
+# not a test-only quirk: on a real pod, collective ISSUE ORDER must be
+# identical across hosts anyway, so concurrent unordered collective calls
+# are a bug in any mode; this lock enforces the within-process ordering in
+# BOTH the single-controller and multi-process paths (cross-process order
+# is the caller's collective contract, e.g. the router's sequencing).
+# Re-entrant because the serve exchange's owner callbacks may themselves
+# exchange (feature halo fetches) on the same thread.
+_SC_COLLECTIVE_LOCK = threading.RLock()
 
 
 def _ids_to_int32(arr: np.ndarray) -> np.ndarray:
@@ -155,6 +170,91 @@ def _exchange_jit(requests, tables, *, mesh, axis):
     )(requests, tables)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _a2a_ids_jit(requests, *, mesh, axis):
+    """First half of the serve-shaped exchange: ship request ids to their
+    owners. ``requests`` [H, H, L] (req[i, j] = ids host i asks of host j,
+    -1-padded); returns [H, H, L] where ``out[i, j]`` are the ids host j
+    asked of host i — requester-major, the shape an answering host's local
+    serve engine consumes. Exactly the id leg of :func:`_exchange_jit`,
+    split out so a HOST-side compute (the owner's serve engine) can sit
+    between the two collectives instead of a device-side table gather."""
+
+    def body(req_local):
+        recv = lax.all_to_all(req_local[0], axis, split_axis=0, concat_axis=0)
+        return recv[None]
+
+    from .utils import shard_map_compat as shard_map
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis), check_vma=False
+    )(requests)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _a2a_rows_jit(rows, *, mesh, axis):
+    """Second half of the serve-shaped exchange: return computed rows to
+    their requesters. ``rows`` [H, H, L, C] (rows[i, j] = host i's answers
+    for requester j); returns [H, H, L, C] where ``out[i, j]`` are the rows
+    host i gets back from host j — the answer leg of :func:`_exchange_jit`
+    carrying LOGITS (or any computed payload) instead of feature rows."""
+
+    def body(rows_local):
+        resp = lax.all_to_all(rows_local[0], axis, split_axis=0, concat_axis=0)
+        return resp[None]
+
+    from .utils import shard_map_compat as shard_map
+
+    return shard_map(
+        body, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis), check_vma=False
+    )(rows)
+
+
+def exchange_serve_all(
+    mesh: Mesh,
+    axis: str,
+    requests: np.ndarray,
+    answer_fn,
+    out_dim: int,
+) -> np.ndarray:
+    """Serve-shaped exchange, single-controller surface: ship SEED IDS to
+    their owners, run each owner's host-side compute, ship LOGITS back.
+
+    This is `exchange_all` with the device-side table gather replaced by a
+    host callback — the owner-compute-then-exchange shape the distributed
+    serve engine rides (move the request to the data, not the rows to the
+    request): collective #1 routes ``requests[i, j]`` (the -1-padded ids
+    host i asks of host j) to owners; ``answer_fn(host, recv_ids)`` — with
+    ``recv_ids`` [H, L] requester-major — computes ``[H, L, out_dim]``
+    float32 answers for every valid lane (invalid lanes must come back
+    zero-filled); collective #2 returns them. Returns [H, H, L, out_dim]
+    where ``out[i, j]`` are the rows host i got back from host j.
+
+    Both collectives are the exact halves of the `_exchange_jit` program,
+    so the wire bytes `scaling.serve_table(hosts=...)` prices are the bytes
+    this actually moves: ``H*H*L*4`` ids out, ``H*H*L*out_dim*4`` back.
+    """
+    h = mesh.shape[axis]
+    with _SC_COLLECTIVE_LOCK:
+        req = jax.device_put(
+            jnp.asarray(_ids_to_int32(requests)), NamedSharding(mesh, P(axis))
+        )
+        assert req.shape[0] == h
+        recv = np.asarray(_a2a_ids_jit(req, mesh=mesh, axis=axis))
+        L = recv.shape[2]
+        rows = np.zeros((h, h, L, out_dim), np.float32)
+        for host in range(h):
+            ans = np.asarray(answer_fn(host, recv[host]), np.float32)
+            if ans.shape != (h, L, out_dim):
+                raise ValueError(
+                    f"answer_fn(host={host}) returned {ans.shape}, "
+                    f"expected {(h, L, out_dim)}"
+                )
+            rows[host] = ans
+        resp = jax.device_put(jnp.asarray(rows), NamedSharding(mesh, P(axis)))
+        return np.asarray(_a2a_rows_jit(resp, mesh=mesh, axis=axis))
+
+
 def exchange_all(
     mesh: Mesh,
     axis: str,
@@ -258,8 +358,9 @@ class TpuComm:
         else:
             req = np.full((h, h, budget), ID_PAD, np.int64)
             req[self.host] = req_mine[0]
-            tables = self._tables_for_exchange(h)
-            out = exchange_all(self.mesh, self.axis, req, tables)
+            with _SC_COLLECTIVE_LOCK:  # see the lock's comment
+                tables = self._tables_for_exchange(h)
+                out = exchange_all(self.mesh, self.axis, req, tables)
         mine = self._my_rows(out)  # [H, L, D]: answers addressed to this host
         res: List[Optional[jax.Array]] = []
         for j, ids in enumerate(host2ids):
@@ -278,19 +379,22 @@ class TpuComm:
                 "register_local_table(self.host, rows) must be called before "
                 "a multi-process exchange"
             )
-        sharding = NamedSharding(self.mesh, P(self.axis))
-        req = jax.make_array_from_process_local_data(
-            sharding, _ids_to_int32(req_mine)
-        )
-        # the table is invariant across exchanges: shard it onto the mesh
-        # ONCE (mirrors the single-controller _tables_for_exchange cache;
-        # invalidated by register_local_table)
-        if getattr(self, "_table_stack_dev", None) is None:
-            mine = blocks[self.host]
-            self._table_stack_dev = jax.make_array_from_process_local_data(
-                sharding, np.asarray(mine, np.float32)[None]
+        with _SC_COLLECTIVE_LOCK:  # within-process launch order, see above
+            sharding = NamedSharding(self.mesh, P(self.axis))
+            req = jax.make_array_from_process_local_data(
+                sharding, _ids_to_int32(req_mine)
             )
-        return _exchange_jit(req, self._table_stack_dev, mesh=self.mesh, axis=self.axis)
+            # the table is invariant across exchanges: shard it onto the mesh
+            # ONCE (mirrors the single-controller _tables_for_exchange cache;
+            # invalidated by register_local_table)
+            if getattr(self, "_table_stack_dev", None) is None:
+                mine = blocks[self.host]
+                self._table_stack_dev = jax.make_array_from_process_local_data(
+                    sharding, np.asarray(mine, np.float32)[None]
+                )
+            return _exchange_jit(
+                req, self._table_stack_dev, mesh=self.mesh, axis=self.axis
+            )
 
     def _my_rows(self, out: jax.Array):
         """This host's slice of the [H, H, L, D] exchange result. On a real
@@ -336,6 +440,102 @@ class TpuComm:
             self._local_tables = {}
         self._local_tables[host] = np.asarray(rows, np.float32)
         self._table_stack_dev = None
+
+    # -- serve-shaped exchange (seed ids out, logits back) -----------------
+
+    def register_serve_answerer(self, host: int, fn) -> None:
+        """Install ``host``'s answer callback for :meth:`exchange_serve`:
+        ``fn(recv_ids [H, L] int32, -1-padded, requester-major) ->
+        [H, L, C] float32``. In multi-process mode each process registers
+        ONLY its own host; the single-controller/hermetic mode (one process
+        simulating the pod) registers every host's, the same way
+        `register_local_table` holds every block there."""
+        if not hasattr(self, "_serve_answerers"):
+            self._serve_answerers = {}
+        self._serve_answerers[host] = fn
+
+    def exchange_serve(
+        self,
+        host2ids: Sequence[np.ndarray],
+        out_dim: int,
+        budget: Optional[int] = None,
+    ) -> List[Optional[np.ndarray]]:
+        """Serve-shaped collective: ship per-owner SEED-ID lists out, run
+        each owner's registered answerer (its local serve engine), get
+        LOGITS rows back — `exchange` with the device table gather replaced
+        by host-side owner compute (the distributed serve engine's transport,
+        see `quiver_tpu.serve.dist`). Same collective contract as
+        `exchange`: in multi-process mode every host must call together with
+        the same ``budget``/``out_dim``; seed ids ship int32.
+
+        Returns one ``[len(ids), out_dim]`` float32 array per owner (None
+        where no ids were requested), aligned with ``host2ids`` order.
+        """
+        if budget is None:
+            budget = self.static_budget
+            if budget is None:
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "multi-process exchange_serve needs a budget every "
+                        "process agrees on: set comm.static_budget or pass "
+                        "budget="
+                    )
+                budget = round_up_pow2(max((len(i) for i in host2ids), default=1))
+        h = self.table.hosts
+        req_mine = np.full((1, h, budget), ID_PAD, np.int64)
+        for j, ids in enumerate(host2ids):
+            ids = np.asarray(ids, np.int64)
+            if ids.shape[0] > budget:
+                raise ValueError(
+                    f"serve request to host {j} ({ids.shape[0]} ids) exceeds "
+                    f"the exchange budget {budget}; raise static_budget"
+                )
+            req_mine[0, j, : ids.shape[0]] = ids
+        answerers = getattr(self, "_serve_answerers", None) or {}
+        if jax.process_count() > 1:
+            if self.host not in answerers:
+                raise RuntimeError(
+                    "register_serve_answerer(self.host, fn) must be called "
+                    "before a multi-process exchange_serve"
+                )
+            with _SC_COLLECTIVE_LOCK:  # within-process launch order
+                sharding = NamedSharding(self.mesh, P(self.axis))
+                req = jax.make_array_from_process_local_data(
+                    sharding, _ids_to_int32(req_mine)
+                )
+                recv = _a2a_ids_jit(req, mesh=self.mesh, axis=self.axis)
+                recv_mine = np.asarray(self._my_rows(recv))  # [H, L]: ids asked of me
+                rows_mine = np.asarray(
+                    answerers[self.host](recv_mine), np.float32
+                )[None]  # [1, H, L, C]
+                if rows_mine.shape != (1, h, budget, out_dim):
+                    raise ValueError(
+                        f"serve answerer returned {rows_mine.shape[1:]}, "
+                        f"expected {(h, budget, out_dim)}"
+                    )
+                rows = jax.make_array_from_process_local_data(sharding, rows_mine)
+                resp = _a2a_rows_jit(rows, mesh=self.mesh, axis=self.axis)
+                mine = np.asarray(self._my_rows(resp))  # [H, L, C]
+        else:
+            missing = [j for j in range(h) if j not in answerers]
+            if missing:
+                raise RuntimeError(
+                    "single-controller exchange_serve needs every host's "
+                    f"answerer registered (missing {missing}); call "
+                    "register_serve_answerer per host"
+                )
+            req = np.full((h, h, budget), ID_PAD, np.int64)
+            req[self.host] = req_mine[0]
+            out = exchange_serve_all(
+                self.mesh, self.axis, req,
+                lambda host, recv_ids: answerers[host](recv_ids), out_dim,
+            )
+            mine = out[self.host]
+        res: List[Optional[np.ndarray]] = []
+        for j, ids in enumerate(host2ids):
+            n = len(ids)
+            res.append(np.asarray(mine[j, :n]) if n else None)
+        return res
 
     # reference-compatible raw verbs (comm.py send/recv/allreduce) expressed
     # as collectives; useful for ported scripts that used them directly

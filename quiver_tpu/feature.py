@@ -453,7 +453,16 @@ class DistFeature:
         # owners answer in their local row space (reference set_local_order
         # remap, feature.py:283-294 + comm.py:165-168 local gather)
         per_host_local = [self.info.global2local[h_ids] for h_ids in per_host]
-        remote_feats = self.comm.exchange(per_host_local)
+        if jax.process_count() == 1 and not any(len(h) for h in per_host_local):
+            # fully shard-local lookup: nothing to exchange, skip the
+            # collective. Single-controller ONLY — in multi-process mode
+            # every host must enter the collective together, so a
+            # data-dependent skip would desync it (the serve engines hit
+            # this path on every flush when the partition is k-hop closed,
+            # e.g. community-partitioned serving shards)
+            remote_feats: List[Optional[jax.Array]] = [None] * self.info.hosts
+        else:
+            remote_feats = self.comm.exchange(per_host_local)
         out = np.zeros((ids.shape[0], self.feature.dim), np.float32)
         if local_ids.size:
             # a Feature with set_local_order applied remaps global ids itself

@@ -197,7 +197,16 @@ def weighted_sample_layer(
     lanes = jnp.clip(lanes, 0, indices.shape[0] - 1)
     w_rows = jnp.take(weights, lanes)
     pos, valid = gumbel_topk_positions(key, deg, k, w_rows)
-    flat = jnp.take_along_axis(lanes, pos.astype(ptr.dtype), axis=1)
+    # NOT take_along_axis (a [B, k] per-row dynamic lane read lowers to a
+    # B*k-descriptor gather — the round-5 trap, PERF_NOTES.md grep rule) and
+    # not even the one-hot compare+sum: the lane window is AFFINE in the
+    # drawn position (lanes[b, p] == clip(ptr[b] + p)), so the select is
+    # plain address arithmetic — zero descriptors, bit-identical flat ids
+    flat = jnp.clip(
+        ptr[:, None] + pos.astype(ptr.dtype),
+        0,
+        jnp.asarray(indices.shape[0] - 1, ptr.dtype),
+    )
     nbrs = jnp.take(indices, flat)
     return nbrs, valid
 

@@ -485,14 +485,20 @@ def format_quant_markdown(rows: Sequence[QuantPrediction]) -> str:
 
 
 class ServePrediction(NamedTuple):
-    bucket: int            # dispatched batch shape
+    bucket: int            # dispatched batch shape (GLOBAL, pre-split)
     hit_rate: float        # embedding-cache hit rate
     unique_frac: float     # unique seeds / requests among cache misses
-    dispatch_s: float      # sample + gather + forward per bucket dispatch
+    dispatch_s: float      # per-shard sample + gather + forward (shard_bucket wide)
     requests_per_dispatch: float
-    qps: float             # sustainable device-bound throughput
+    qps: float             # sustainable device-bound AGGREGATE throughput
     device_us_per_request: float
-    floor_p50_ms: float    # latency floor: half the flush window + dispatch
+    floor_p50_ms: float    # latency floor: half the flush window + dispatch (+ exchange)
+    # -- H-host fields (defaults keep the hosts=1 rows and older callers
+    # byte-identical to the round-9 model) --
+    hosts: int = 1
+    shard_bucket: int = 0          # per-shard batch width, ceil(bucket/H)
+    exchange_bytes: float = 0.0    # router exchange bytes per routed dispatch
+    exchange_s: float = 0.0        # that payload over the DCN link
 
 
 def serve_table(
@@ -504,6 +510,9 @@ def serve_table(
     hit_rates: Sequence[float] = (0.0, 0.5, 0.9),
     unique_frac: float = 0.8,
     max_delay_ms: float = 2.0,
+    hosts: int = 1,
+    out_dim: int = 47,
+    bandwidths: Optional[Dict[str, float]] = None,
 ) -> List[ServePrediction]:
     """Analytic QPS model for the online serving engine
     (`quiver_tpu.serve.ServeEngine`) from MEASURED per-batch costs.
@@ -528,15 +537,49 @@ def serve_table(
     Sustainable QPS is that over the dispatch time; the p50 latency floor
     is half the flush window plus one dispatch (a request arrives mid-
     window on average, then rides the next flush).
+
+    ``hosts > 1`` prices the distributed engine
+    (`quiver_tpu.serve.DistServeEngine`): the router splits each bucket-B
+    flush by seed ownership, so every shard samples/forwards a
+    ``ceil(B/hosts)``-wide sub-batch (the 1/H width shrink the serve probe
+    measures) and the shards run CONCURRENTLY — one routed dispatch takes
+    one shard-width dispatch plus the exchange hop. Exchange bytes per
+    routed flush are the serve-shaped collective's actual payloads
+    (`comm.exchange_serve_all`): ``H*H*L`` int32 seed ids out plus
+    ``H*H*L*out_dim`` float32 logits back, with ``L`` the STATIC per-owner
+    lane budget ``round_up_pow2(bucket)`` — the engine's default, sized
+    for worst-case skew (a whole flush owned by one host), so these rows
+    match the engine's measured ``exchange_id_bytes``/
+    ``exchange_logit_bytes`` counters byte for byte — priced against
+    ``dcn_bytes_per_s`` exactly like `sampling_comm_bytes` prices the
+    training-side exchange. Aggregate QPS then scales ~H-fold until the
+    exchange term catches the shrinking dispatch — the crossover this
+    table exists to locate before hardware does.
     """
+    bw = dict(DEFAULT_BANDWIDTHS)
+    if bandwidths:
+        bw.update(bandwidths)
+    if hosts < 1:
+        raise ValueError("hosts must be >= 1")
     rows: List[ServePrediction] = []
     per_seed = (t_sample_s + t_gather_s + t_forward_s) / max(ref_batch, 1)
     for b in buckets:
-        t_dispatch = per_seed * b
+        shard_b = -(-b // hosts)
+        t_dispatch = per_seed * shard_b
+        if hosts > 1:
+            from ..comm import round_up_pow2
+
+            lanes = round_up_pow2(b)  # the engine's default static budget
+            xbytes = hosts * hosts * lanes * (4 + 4 * out_dim)
+            x_s = xbytes / bw["dcn_bytes_per_s"]
+        else:
+            xbytes = 0.0
+            x_s = 0.0
+        t_routed = t_dispatch + x_s
         for h in hit_rates:
             miss = (1.0 - h) * unique_frac
             rpd = b / miss if miss > 0 else math.inf
-            qps = rpd / t_dispatch
+            qps = rpd / t_routed
             rows.append(
                 ServePrediction(
                     bucket=b,
@@ -548,33 +591,61 @@ def serve_table(
                     device_us_per_request=(
                         0.0 if math.isinf(rpd) else t_dispatch / rpd * 1e6
                     ),
-                    floor_p50_ms=max_delay_ms / 2 + t_dispatch * 1e3,
+                    floor_p50_ms=max_delay_ms / 2 + t_routed * 1e3,
+                    hosts=hosts,
+                    shard_bucket=shard_b,
+                    exchange_bytes=xbytes,
+                    exchange_s=x_s,
                 )
             )
     return rows
 
 
 def format_serve_markdown(rows: Sequence[ServePrediction]) -> str:
-    lines = [
-        "| bucket | cache hit | req/dispatch | dispatch ms | QPS | device us/req | p50 floor ms |",
-        "|---|---|---|---|---|---|---|",
-    ]
+    multi = any(getattr(r, "hosts", 1) > 1 for r in rows)
+    if multi:
+        lines = [
+            "| bucket | hosts | shard bucket | cache hit | req/dispatch | shard dispatch ms | exchange KB | exchange ms | agg QPS | p50 floor ms |",
+            "|---|---|---|---|---|---|---|---|---|---|",
+        ]
+    else:
+        lines = [
+            "| bucket | cache hit | req/dispatch | dispatch ms | QPS | device us/req | p50 floor ms |",
+            "|---|---|---|---|---|---|---|",
+        ]
     for r in rows:
         rpd = "inf" if math.isinf(r.requests_per_dispatch) else f"{r.requests_per_dispatch:.0f}"
         qps = "inf" if math.isinf(r.qps) else f"{r.qps:.0f}"
-        lines.append(
-            f"| {r.bucket} | {r.hit_rate:.0%} | {rpd} "
-            f"| {r.dispatch_s*1e3:.2f} | {qps} "
-            f"| {r.device_us_per_request:.1f} | {r.floor_p50_ms:.2f} |"
-        )
+        if multi:
+            lines.append(
+                f"| {r.bucket} | {r.hosts} | {r.shard_bucket} | {r.hit_rate:.0%} "
+                f"| {rpd} | {r.dispatch_s*1e3:.2f} | {r.exchange_bytes/1e3:.1f} "
+                f"| {r.exchange_s*1e3:.3f} | {qps} | {r.floor_p50_ms:.2f} |"
+            )
+        else:
+            lines.append(
+                f"| {r.bucket} | {r.hit_rate:.0%} | {rpd} "
+                f"| {r.dispatch_s*1e3:.2f} | {qps} "
+                f"| {r.device_us_per_request:.1f} | {r.floor_p50_ms:.2f} |"
+            )
     lines.append("")
-    lines.append(
-        "QPS = bucket / ((1-hit)*unique_frac) / dispatch_s — device-bound "
-        "ceiling, ignores host queueing; p50 floor = max_delay_ms/2 + one "
-        "dispatch. Costs scale linearly from the measured reference batch "
-        "(row-count-bound regime, PERF_NOTES.md); the serving engine's "
-        "measured counterpart is scripts/serve_probe.py / bench.py serve."
-    )
+    if multi:
+        lines.append(
+            "Aggregate QPS = bucket / ((1-hit)*unique_frac) / (shard "
+            "dispatch + exchange): the router splits each flush by seed "
+            "owner, shards run ~bucket/H-wide dispatches concurrently, and "
+            "the exchange ships H*H*L ids out + H*H*L*out_dim f32 logits "
+            "back over DCN (comm.exchange_serve payloads). Measured "
+            "counterpart: scripts/serve_probe.py --hosts."
+        )
+    else:
+        lines.append(
+            "QPS = bucket / ((1-hit)*unique_frac) / dispatch_s — device-bound "
+            "ceiling, ignores host queueing; p50 floor = max_delay_ms/2 + one "
+            "dispatch. Costs scale linearly from the measured reference batch "
+            "(row-count-bound regime, PERF_NOTES.md); the serving engine's "
+            "measured counterpart is scripts/serve_probe.py / bench.py serve."
+        )
     return "\n".join(lines)
 
 

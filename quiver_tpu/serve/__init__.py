@@ -11,9 +11,22 @@ invalidates), and pipelined dispatch (flushes run as assemble -> dispatch
 stream and replay log stay deterministic in dispatch-index order). See
 `engine.py` for the design and docs/api.md "Online serving" for the
 contract.
+
+`dist.py` scales the engine past one host: `DistServeEngine` routes
+requests by seed ownership over the `HostRankTable` exchange (seed ids
+out, logits back) to per-owner `ServeEngine`s serving from ~1/H topology
++ feature shards — docs/api.md "Distributed serving".
 """
 
 from .cache import EmbeddingCache
+from .dist import (
+    DistServeConfig,
+    DistServeEngine,
+    DistServeStats,
+    contiguous_partition,
+    replay_shard_oracle,
+    shard_topology_by_owner,
+)
 from .engine import (
     ServeConfig,
     ServeEngine,
@@ -24,13 +37,19 @@ from .engine import (
 from .trace_gen import poisson_arrivals, trace_skew_stats, zipfian_trace
 
 __all__ = [
+    "DistServeConfig",
+    "DistServeEngine",
+    "DistServeStats",
     "EmbeddingCache",
     "ServeConfig",
     "ServeEngine",
     "ServeResult",
     "ServeStats",
+    "contiguous_partition",
     "default_buckets",
     "poisson_arrivals",
+    "replay_shard_oracle",
+    "shard_topology_by_owner",
     "trace_skew_stats",
     "zipfian_trace",
 ]

@@ -1,0 +1,843 @@
+"""Cross-host sharded serving: seed-ownership routing over the
+`HostRankTable` exchange.
+
+The single-host `ServeEngine` (rounds 8-9) turns a request stream into
+efficient fixed-shape device work, but its QPS ceiling is one chip's
+sample+forward throughput and one host's feature tier. The training side
+already scales past one host by PARTITIONING the data and moving requests
+to their owners (`HostRankTable` / `DistFeature` / `TpuComm.exchange` —
+the reference's ``PartitionInfo``+``DistFeature`` multi-host layer); this
+module applies the same owner-compute-then-exchange shape to serving, the
+pattern the PyTorch-Direct / GPU-initiated-access line uses to keep
+feature fetch off the slow path: **move the request to the data, not the
+rows to the request.**
+
+Topology of a request:
+
+1. A front-end **router** (`DistServeEngine`) accepts single-node
+   requests, dedupes/coalesces them within a flush window, and applies the
+   same max_batch / max_delay_ms flush policy as the single-host engine.
+2. Each router flush **splits its (deduped) seed batch by owner**
+   (``global2host[seed]``, `HostRankTable` host ids) and forwards the
+   per-owner sub-batches through the serve-shaped exchange
+   (`TpuComm.exchange_serve`: seed ids ship out over the same all_to_all
+   the feature exchange rides; LOGITS rows come back instead of feature
+   rows).
+3. Each **owner** runs its local pipelined `ServeEngine` — micro-batching,
+   bucketed shapes, embedding cache, bounded ``max_in_flight`` window —
+   against only its shard of topology + features. Aggregate QPS scales
+   with hosts because each shard samples/forwards a batch ~1/H as wide,
+   and per-host HBM holds ~1/H of the tables (exact 1/H when the
+   partition is k-hop closed, e.g. community partitions; the halo the
+   closure adds on other partitions is reported, never hidden — see
+   `shard_topology_by_owner`).
+4. Results **scatter back by request id** and re-interleave into the
+   router's dispatch-log order.
+
+Bit-parity contract (the round-8/9 contract, extended): every served
+logits row is bit-identical to the offline `inference.batch_logits` replay
+of the OWNING shard's dispatch log — through a sampler over the FULL graph
+(`replay_shard_oracle`), because a shard's halo-closed topology produces
+draws bit-equal to the full graph's for owned seeds. At ``hosts=1`` the
+engine degenerates to the single-host `ServeEngine` bit-for-bit (same
+dispatch log, same key stream, same logits) at any ``max_in_flight``.
+
+Execution modes:
+
+- ``exchange="collective"``: sub-batches and logits ride the real
+  `_a2a_ids_jit`/`_a2a_rows_jit` collectives over an H-device mesh (the
+  hermetic CPU-mesh simulation of an H-host pod; on a real pod each
+  process drives its own shard — `TpuComm.exchange_serve` multi-process
+  mode, exercised by tests/dist_worker.py's lockstep serve mode).
+- ``exchange="host"``: the router calls owner engines directly (and the
+  shard features exchange through a host-side loopback). Value-identical;
+  for environments without H devices.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..comm import HostRankTable, TpuComm, round_up_pow2
+from ..feature import DistFeature, Feature, PartitionInfo
+from ..trace import HitRateCounter, LatencyHistogram, SpanRecorder
+from ..utils import CSRTopo
+from .cache import EmbeddingCache
+from .engine import ServeConfig, ServeEngine, ServeResult, ServeStats, _Slot
+
+
+def contiguous_partition(n_nodes: int, hosts: int) -> np.ndarray:
+    """Balanced contiguous ``global2host`` map: host h owns rows
+    ``[h*ceil(N/H), ...)`` (the same contiguous-range convention the
+    row-sharded topology uses). int32 [N]."""
+    if hosts < 1 or n_nodes < 1:
+        raise ValueError("need hosts >= 1 and n_nodes >= 1")
+    per = -(-n_nodes // hosts)
+    return np.minimum(np.arange(n_nodes, dtype=np.int64) // per, hosts - 1).astype(
+        np.int32
+    )
+
+
+def shard_topology_by_owner(
+    csr_topo: CSRTopo,
+    global2host: np.ndarray,
+    host: int,
+    hops: int,
+) -> Tuple[CSRTopo, Dict[str, float]]:
+    """Host ``host``'s serving topology shard: the full-id-space CSR with
+    adjacency kept ONLY for the ``hops``-hop closure of its owned nodes
+    (every other row reads degree 0).
+
+    ``hops`` is the number of EXPANSION hops whose adjacency the shard's
+    sampler reads — ``len(sizes) - 1`` for an L-layer sampler, because the
+    final hop's frontier is feature-gathered but never expanded. Keeping
+    the closure rows bit-identical to the full graph is what makes a shard
+    engine's draws for owned seeds bit-equal to a full-graph sampler on
+    the same key stream (the parity contract `replay_shard_oracle` tests);
+    rows outside the closure are unreachable from owned seeds, so zeroing
+    them changes nothing.
+
+    The id space stays GLOBAL (indptr keeps all N+1 rows — ~8 bytes/node,
+    small next to edges and features); only the EDGE table shrinks. On a
+    k-hop-closed partition (e.g. community partitions, where serving
+    shards naturally align with communities) the closure adds nothing and
+    each shard holds exactly its 1/H of the edges; on other partitions the
+    halo is real replication and ``edge_frac`` reports it honestly.
+
+    Returns ``(shard_topo, stats)`` with stats keys ``owned_nodes``,
+    ``closure_nodes``, ``edges_kept``, ``edges_total``, ``edge_frac``.
+    """
+    indptr = np.asarray(csr_topo.indptr, np.int64)
+    indices = np.asarray(csr_topo.indices, np.int64)
+    g2h = np.asarray(global2host)
+    n = indptr.shape[0] - 1
+    if g2h.shape[0] != n:
+        raise ValueError(f"global2host has {g2h.shape[0]} rows, graph has {n}")
+    owned = np.nonzero(g2h == host)[0]
+    closure = np.zeros(n, bool)
+    closure[owned] = True
+    # edge-parallel BFS (vectorized — a per-frontier-node python loop is
+    # O(minutes) at products scale): src id per CSR slot built once, each
+    # hop masks the frontier's edges and uniques their endpoints
+    src_per_edge = np.repeat(
+        np.arange(n, dtype=np.int64), (indptr[1:] - indptr[:-1])
+    )
+    frontier_mask = closure.copy()
+    for _ in range(max(int(hops), 0)):
+        if not frontier_mask.any():
+            break
+        nxt = np.unique(indices[frontier_mask[src_per_edge]])
+        nxt = nxt[~closure[nxt]]
+        if nxt.size == 0:
+            break
+        closure[nxt] = True
+        frontier_mask = np.zeros(n, bool)
+        frontier_mask[nxt] = True
+    deg = np.where(closure, indptr[1:] - indptr[:-1], 0)
+    new_indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=new_indptr[1:])
+    keep_edge = closure[src_per_edge]
+    new_indices = indices[keep_edge]
+    new_weights = (
+        None
+        if csr_topo.edge_weights is None
+        else np.asarray(csr_topo.edge_weights, np.float32)[keep_edge]
+    )
+    shard = CSRTopo(indptr=new_indptr, indices=new_indices, edge_weights=new_weights)
+    stats = {
+        "owned_nodes": int(owned.shape[0]),
+        "closure_nodes": int(closure.sum()),
+        "edges_kept": int(new_indices.shape[0]),
+        "edges_total": int(indices.shape[0]),
+        "edge_frac": (
+            float(new_indices.shape[0]) / float(max(indices.shape[0], 1))
+        ),
+    }
+    return shard, stats
+
+
+class LoopbackComm:
+    """Host-side stand-in for `TpuComm` in ``exchange="host"`` mode: the
+    same `register_local_table` / `exchange` surface, answered by direct
+    numpy indexing instead of collectives. Value-identical to the wire
+    path (the collectives move bytes, they never transform them), so shard
+    features built over it serve bit-identical rows — it just measures
+    nothing about the interconnect."""
+
+    def __init__(self, hosts: int):
+        self.table = HostRankTable(hosts, 1)
+        self._blocks: Dict[int, np.ndarray] = {}
+
+    def register_local_table(self, host: int, rows: np.ndarray) -> None:
+        self._blocks[host] = np.asarray(rows, np.float32)
+
+    def exchange(self, host2ids, budget=None):
+        res = []
+        for j, ids in enumerate(host2ids):
+            ids = np.asarray(ids, np.int64)
+            res.append(self._blocks[j][ids] if ids.size else None)
+        return res
+
+
+class _ShardFeature:
+    """The shard engine's feature view: clip global ids like the raw-table
+    `inference.lookup_features` path (sampled ``n_id`` may carry padding
+    lanes), then answer owned rows from the local 1/H block and halo rows
+    through the feature exchange (`DistFeature`). The clip is what keeps a
+    shard engine's forward bit-identical to a raw-full-table engine's on
+    the same sample."""
+
+    def __init__(self, dist: DistFeature, n_nodes: int):
+        self._dist = dist
+        self._n = n_nodes
+
+    @property
+    def shape(self):
+        return (self._n, self._dist.feature.dim)
+
+    @property
+    def dim(self) -> int:
+        return self._dist.feature.dim
+
+    def __getitem__(self, n_id):
+        ids = np.clip(np.asarray(n_id), 0, self._n - 1)
+        return self._dist[ids]
+
+
+@dataclass
+class DistServeConfig:
+    """Router knobs (per-shard engine knobs ride ``shard_config``).
+
+    hosts          : number of serving shards (HostRankTable hosts).
+    max_batch      : router flush width — unique seeds drained per flush,
+                     BEFORE the owner split (per-shard sub-batches are
+                     ~max_batch/hosts on uniform traffic; the probe's
+                     width-shrink acceptance reads this).
+    max_delay_ms   : router flush-age policy, same semantics as
+                     `ServeConfig.max_delay_ms`.
+    max_in_flight  : router in-flight window (concurrent routed flushes).
+    exchange       : "collective" (ids/logits ride the mesh all_to_all),
+                     "host" (direct owner calls + loopback feature
+                     exchange), or "auto" (collective when the backend has
+                     >= hosts devices).
+    budget         : per-owner seed-id budget of the serve exchange (static
+                     collective shape); default pow2(max_batch) — a whole
+                     router flush to one owner always fits.
+    shard_config   : template `ServeConfig` for the per-shard engines
+                     (default: the router's max_batch/max_in_flight with
+                     the delay policy irrelevant — the router drives shard
+                     flushes synchronously). ``record_dispatches`` on the
+                     shard engines is what the parity replay reads.
+    cache_entries  : per-shard embedding-cache rows at the OWNERS (so the
+                     backing cache splits by ownership).
+    router_cache_entries : front-end result-cache rows (default: same as
+                     ``cache_entries``; 0 disables). Repeat requests for a
+                     node already served under the current params version
+                     are answered AT THE ROUTER — no routing, no exchange
+                     bytes, no owner work. Same get-at-submit /
+                     put-at-resolve / invalidate-on-update sequencing as
+                     `ServeEngine`'s cache, which is what makes the
+                     ``hosts=1`` engine bit-identical to the single-host
+                     engine INCLUDING cache behavior (identical LRU
+                     evolution -> identical flush composition -> identical
+                     key stream) — PROVIDED the cache never evicts (working
+                     set <= capacity). Under eviction pressure the router
+                     and owner caches can diverge in LRU state (the owner
+                     cache only sees router misses), so an owner may answer
+                     a router-missed repeat from ITS cache where the
+                     single-host engine would re-dispatch — flush
+                     composition then differs. Served rows stay bit-equal
+                     to the owning shard's replay oracle either way (a
+                     cached row was computed by a logged dispatch).
+    clock          : injectable monotonic clock shared with shard engines.
+    record_dispatches : keep the router's (seeds, per-owner split) log.
+    """
+
+    hosts: int = 2
+    max_batch: int = 64
+    max_delay_ms: float = 2.0
+    max_in_flight: int = 2
+    exchange: str = "auto"
+    budget: Optional[int] = None
+    shard_config: Optional[ServeConfig] = None
+    cache_entries: int = 100_000
+    router_cache_entries: Optional[int] = None
+    clock: Callable[[], float] = time.monotonic
+    flush_poll_ms: float = 0.2
+    record_dispatches: bool = False
+
+    def resolved_shard_config(self) -> ServeConfig:
+        if self.shard_config is not None:
+            return self.shard_config
+        return ServeConfig(
+            max_batch=self.max_batch,
+            max_delay_ms=self.max_delay_ms,
+            max_in_flight=self.max_in_flight,
+            cache_entries=self.cache_entries,
+            clock=self.clock,
+            record_dispatches=self.record_dispatches,
+        )
+
+
+@dataclass
+class DistServeStats:
+    """Router-side counters; `DistServeEngine.aggregate_stats` merges the
+    per-shard `ServeStats` on top (via the ``merge`` family in
+    `quiver_tpu.trace`). ``exchange_id_bytes``/``exchange_logit_bytes``
+    count the GLOBAL collective payloads (H*H*L ids, H*H*L*C logits per
+    routed flush in collective mode) — the wire term
+    `scaling.serve_table(hosts=...)` prices."""
+
+    requests: int = 0
+    coalesced: int = 0
+    router_dispatches: int = 0
+    routed_seeds: int = 0
+    inflight_peak: int = 0
+    sub_batches: Dict[int, int] = field(default_factory=dict)
+    sub_batch_seeds: Dict[int, int] = field(default_factory=dict)
+    exchange_id_bytes: int = 0
+    exchange_logit_bytes: int = 0
+    router_cache: HitRateCounter = field(default_factory=HitRateCounter)
+    latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+    spans: SpanRecorder = field(default_factory=SpanRecorder)
+
+    def mean_sub_batch_width(self) -> Dict[int, float]:
+        return {
+            h: self.sub_batch_seeds[h] / n
+            for h, n in self.sub_batches.items()
+            if n
+        }
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "requests": self.requests,
+            "coalesced": self.coalesced,
+            "router_dispatches": self.router_dispatches,
+            "routed_seeds": self.routed_seeds,
+            "inflight_peak": self.inflight_peak,
+            "sub_batches": dict(self.sub_batches),
+            "mean_sub_batch_width": self.mean_sub_batch_width(),
+            "exchange_id_bytes": self.exchange_id_bytes,
+            "exchange_logit_bytes": self.exchange_logit_bytes,
+            "router_cache": self.router_cache.snapshot(),
+            "latency": self.latency.snapshot(),
+            "overlap": self.spans.overlap_summary(),
+        }
+
+
+class _RoutedFlush:
+    """Per-flush router state between assemble and resolve."""
+
+    __slots__ = ("keys", "slots", "split", "error")
+
+    def __init__(self, keys, slots, split):
+        self.keys = keys
+        self.slots = slots
+        self.split = split  # [(host, ids ndarray, positions ndarray)]
+        self.error: Optional[BaseException] = None
+
+
+class DistServeEngine:
+    """Seed-ownership-sharded serving front end (module docstring has the
+    design; docs/api.md "Distributed serving" the contract). Typical use::
+
+        dist = DistServeEngine.build(
+            model, params, csr_topo, feat, sizes=[8, 8], hosts=2,
+            config=DistServeConfig(max_batch=32),
+        )
+        dist.warmup()
+        out = dist.predict(node_ids)     # routed, owner-served, re-merged
+
+    The constructor takes prebuilt shard engines keyed by host (`build`
+    does the partitioning); multi-process deployments construct with only
+    their own host's engine and a `TpuComm` whose serve answerer is
+    registered, then drive lockstep flushes (tests/dist_worker.py serve
+    mode)."""
+
+    def __init__(
+        self,
+        engines: Dict[int, ServeEngine],
+        global2host: np.ndarray,
+        out_dim: int,
+        config: Optional[DistServeConfig] = None,
+        comm: Optional[TpuComm] = None,
+        shard_topo_stats: Optional[Dict[int, Dict[str, float]]] = None,
+    ):
+        self.config = config or DistServeConfig()
+        if self.config.max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        mode = self.config.exchange
+        if mode not in ("auto", "collective", "host"):
+            raise ValueError(f"unknown exchange mode {mode!r}")
+        if mode == "auto":
+            mode = "collective" if comm is not None else "host"
+        if mode == "collective" and comm is None:
+            raise ValueError("exchange='collective' needs a TpuComm")
+        self.exchange_mode = mode
+        self.engines = dict(engines)
+        self.hosts = self.config.hosts
+        self.global2host = np.asarray(global2host, np.int32)
+        self.out_dim = int(out_dim)
+        self.comm = comm
+        self.shard_topo_stats = shard_topo_stats or {}
+        self._budget = self.config.budget or round_up_pow2(self.config.max_batch)
+        self._clock = self.config.clock
+        self.stats = DistServeStats()
+        rc = self.config.router_cache_entries
+        self.cache = EmbeddingCache(
+            self.config.cache_entries if rc is None else rc,
+            counters=self.stats.router_cache,
+        )
+        self.params_version = 0
+        self.dispatch_log: List[Tuple[np.ndarray, List[Tuple[int, np.ndarray]]]] = []
+        self._pending: Dict[int, _Slot] = {}
+        self._inflight: Dict[int, _Slot] = {}
+        self._lock = threading.Lock()
+        self._fence = threading.Condition(self._lock)
+        self._seq = threading.Lock()
+        self._window = threading.BoundedSemaphore(self.config.max_in_flight)
+        self._inflight_flushes = 0
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        if mode == "collective":
+            # the serve exchange's static shape: every host must agree
+            self.comm.static_budget = self._budget
+            for h, eng in self.engines.items():
+                self.comm.register_serve_answerer(h, self._make_answerer(h))
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(
+        cls,
+        model,
+        params,
+        csr_topo: CSRTopo,
+        feat: np.ndarray,
+        sizes: Sequence[int],
+        *,
+        hosts: int,
+        config: Optional[DistServeConfig] = None,
+        global2host: Optional[np.ndarray] = None,
+        sampler_seed: int = 0,
+        sampler_mode: str = "TPU",
+        sampler_kw: Optional[dict] = None,
+        out_dim: Optional[int] = None,
+        mesh=None,
+    ) -> "DistServeEngine":
+        """Partition ``csr_topo``/``feat`` by seed ownership and assemble
+        the router + H shard engines in one process (the hermetic pod
+        simulation). Every shard sampler is born with the SAME
+        ``sampler_seed`` — each shard's key stream then matches a freshly
+        born single-host sampler's, which is what lets the parity oracle
+        replay any shard's dispatch log through a full-graph sampler."""
+        import jax
+
+        from ..pyg.sage_sampler import GraphSageSampler
+
+        config = config or DistServeConfig(hosts=hosts)
+        if config.hosts != hosts:
+            raise ValueError(f"config.hosts={config.hosts} != hosts={hosts}")
+        feat = np.asarray(feat, np.float32)
+        n = csr_topo.indptr.shape[0] - 1
+        if global2host is None:
+            global2host = contiguous_partition(n, hosts)
+        out_dim = out_dim if out_dim is not None else getattr(model, "out_dim", None)
+        if out_dim is None:
+            raise ValueError("pass out_dim= (model has no out_dim attribute)")
+        mode = config.exchange
+        if mode == "auto":
+            mode = "collective" if len(jax.devices()) >= hosts else "host"
+        comm = None
+        feat_comms: List[object] = []
+        if mode == "collective":
+            if mesh is None:
+                from jax.sharding import Mesh
+
+                devs = jax.devices()
+                if len(devs) < hosts:
+                    raise ValueError(
+                        f"exchange='collective' needs >= {hosts} devices "
+                        f"(got {len(devs)}); use exchange='host'"
+                    )
+                mesh = Mesh(np.array(devs[:hosts]), ("serve_host",))
+            comm = TpuComm(
+                rank=0, world_size=hosts, hosts=hosts, mesh=mesh, axis="serve_host"
+            )
+        # feature-exchange budget: a shard forward gathers up to the final
+        # padded n_id width of the largest bucket, all of which could be
+        # remote in the worst case
+        from ..ops.sample import pad_widths
+
+        shard_cfg = config.resolved_shard_config()
+        kw = dict(sampler_kw or {})
+        widths = pad_widths(
+            max(shard_cfg.resolved_buckets()), sizes, kw.get("caps")
+        )
+        feat_budget = round_up_pow2(widths[-1])
+        engines: Dict[int, ServeEngine] = {}
+        topo_stats: Dict[int, Dict[str, float]] = {}
+        for h in range(hosts):
+            topo_h, st = shard_topology_by_owner(
+                csr_topo, global2host, h, hops=len(sizes) - 1
+            )
+            topo_stats[h] = st
+            sampler = GraphSageSampler(
+                topo_h, sizes=sizes, mode=sampler_mode, seed=sampler_seed, **kw
+            )
+            owned = np.nonzero(global2host == h)[0]
+            f = Feature(rank=0, device_list=[0], device_cache_size=0)
+            f.from_cpu_tensor(feat[owned])
+            f.set_local_order(owned)
+            if mode == "collective":
+                fcomm = TpuComm(
+                    rank=h, world_size=hosts, hosts=hosts, mesh=mesh,
+                    axis="serve_host",
+                )
+                fcomm.static_budget = feat_budget
+            else:
+                fcomm = LoopbackComm(hosts)
+            feat_comms.append(fcomm)
+            info = PartitionInfo(device=0, host=h, hosts=hosts, global2host=global2host)
+            shard_feat = _ShardFeature(DistFeature(f, info, fcomm), n)
+            engines[h] = ServeEngine(model, params, sampler, shard_feat, shard_cfg)
+        # single-controller mode: every feature comm holds every block (a
+        # real pod registers only its own — the 1/H HBM claim is about the
+        # per-process resident set, which IS one block per host there)
+        for h in range(hosts):
+            block = np.asarray(feat[np.nonzero(global2host == h)[0]], np.float32)
+            for fcomm in feat_comms:
+                fcomm.register_local_table(h, block)
+        return cls(
+            engines, global2host, out_dim, config=config, comm=comm,
+            shard_topo_stats=topo_stats,
+        )
+
+    def _make_answerer(self, host: int):
+        """The owner-side hook of the serve exchange: ids arrive
+        requester-major [H, L] (-1-padded), each requester's valid lanes go
+        through the owner engine's FULL local path (cache, coalescing,
+        micro-batching, window), invalid lanes return zeros."""
+
+        def answer(recv_ids: np.ndarray) -> np.ndarray:
+            recv_ids = np.asarray(recv_ids)
+            out = np.zeros(
+                (recv_ids.shape[0], recv_ids.shape[1], self.out_dim), np.float32
+            )
+            for req in range(recv_ids.shape[0]):
+                valid = recv_ids[req] >= 0
+                if valid.any():
+                    ids = recv_ids[req][valid].astype(np.int64)
+                    out[req, valid] = np.asarray(self.engines[host].predict(ids))
+            return out
+
+        return answer
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, node_id: int) -> ServeResult:
+        """Enqueue one request: the front-end result cache answers repeats
+        of already-served nodes outright (no routing, no exchange bytes),
+        then the same dedup/coalesce semantics as `ServeEngine.submit`
+        apply to the rest. KEEP IN LOCKSTEP with `ServeEngine.submit` —
+        the hosts=1 bit-parity contract depends on the two front ends
+        making identical cache/coalesce decisions per request, and
+        `test_shards1_bit_equal_single_host_engine` pins it."""
+        key = int(node_id)
+        if not 0 <= key < self.global2host.shape[0]:
+            raise ValueError(
+                f"node id {key} outside [0, {self.global2host.shape[0]})"
+            )
+        now = self._clock()
+        need_flush = False
+        with self._lock:
+            self.stats.requests += 1
+            cached = self.cache.get(key, self.params_version)
+            if cached is not None:
+                self.stats.latency.record_ms((self._clock() - now) * 1e3)
+                return ServeResult(value=cached)
+            slot = self._pending.get(key) or self._inflight.get(key)
+            if slot is not None and slot.version == self.params_version:
+                self.stats.coalesced += 1
+            else:
+                slot = _Slot(key, self.params_version, now)
+                self._pending[key] = slot
+            slot.waiters.append(now)
+            if len(self._pending) >= self.config.max_batch:
+                need_flush = True
+        if need_flush:
+            self.flush()
+        return ServeResult(slot=slot)
+
+    def predict(self, node_ids, timeout: Optional[float] = None) -> np.ndarray:
+        handles = [self.submit(i) for i in np.asarray(node_ids).reshape(-1)]
+        if not handles:
+            return np.zeros((0, self.out_dim), np.float32)
+        if not self._running:
+            while any(not h.done() for h in handles) and self._drainable():
+                self.flush()
+        return np.stack([h.result(timeout) for h in handles])
+
+    # -- flush policy ------------------------------------------------------
+
+    def should_flush(self) -> bool:
+        with self._lock:
+            if not self._pending:
+                return False
+            if len(self._pending) >= self.config.max_batch:
+                return True
+            oldest = next(iter(self._pending.values())).enqueue_t
+            return (self._clock() - oldest) * 1e3 >= self.config.max_delay_ms
+
+    def pump(self) -> int:
+        return self.flush() if self.should_flush() else 0
+
+    # -- the three router stages ------------------------------------------
+
+    def _assemble(self) -> Optional[_RoutedFlush]:
+        with self._lock:
+            if not self._pending:
+                return None
+            keys = list(self._pending)[: self.config.max_batch]
+            slots = [self._pending.pop(k) for k in keys]
+            self._inflight.update(zip(keys, slots))
+            self._inflight_flushes += 1
+            self.stats.inflight_peak = max(
+                self.stats.inflight_peak, self._inflight_flushes
+            )
+        fl = _RoutedFlush(keys, slots, [])
+        try:
+            arr = np.asarray(keys, np.int64)
+            owners = self.global2host[arr]
+            for h in range(self.hosts):
+                pos = np.nonzero(owners == h)[0]
+                if pos.size:
+                    fl.split.append((h, arr[pos], pos))
+            if self.config.record_dispatches:
+                self.dispatch_log.append(
+                    (arr.copy(), [(h, ids.copy()) for h, ids, _ in fl.split])
+                )
+        except BaseException as exc:
+            fl.error = exc
+        return fl
+
+    def _dispatch(self, fl: _RoutedFlush) -> Optional[np.ndarray]:
+        """Forward the per-owner sub-batches and re-interleave the answers
+        into flush-key order. Collective mode ships ids/logits over the
+        mesh; host mode calls the owner engines directly."""
+        out = np.zeros((len(fl.keys), self.out_dim), np.float32)
+        if self.exchange_mode == "collective":
+            by_host = {h: (ids, pos) for h, ids, pos in fl.split}
+            host2ids = [
+                by_host[h][0] if h in by_host else np.array([], np.int64)
+                for h in range(self.hosts)
+            ]
+            res = self.comm.exchange_serve(
+                host2ids, out_dim=self.out_dim, budget=self._budget
+            )
+            L = self._budget
+            with self._lock:
+                self.stats.exchange_id_bytes += self.hosts * self.hosts * L * 4
+                self.stats.exchange_logit_bytes += (
+                    self.hosts * self.hosts * L * self.out_dim * 4
+                )
+            for h, (ids, pos) in by_host.items():
+                out[pos] = res[h]
+        else:
+            for h, ids, pos in fl.split:
+                out[pos] = np.asarray(self.engines[h].predict(ids))
+        out.setflags(write=False)
+        return out
+
+    def _resolve(self, fl: _RoutedFlush, rows: Optional[np.ndarray]) -> None:
+        with self._lock:
+            now = t_res0 = self._clock()
+            for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
+                self._inflight.pop(k, None)
+                if fl.error is None:
+                    if slot.version == self.params_version:
+                        self.cache.put(k, slot.version, rows[i])
+                    slot.resolve(rows[i])
+                else:
+                    slot.resolve(None, error=fl.error)
+                for t0 in slot.waiters:
+                    self.stats.latency.record_ms((now - t0) * 1e3)
+            if fl.error is None:
+                self.stats.router_dispatches += 1
+                self.stats.routed_seeds += len(fl.keys)
+                for h, ids, _ in fl.split:
+                    self.stats.sub_batches[h] = self.stats.sub_batches.get(h, 0) + 1
+                    self.stats.sub_batch_seeds[h] = (
+                        self.stats.sub_batch_seeds.get(h, 0) + len(ids)
+                    )
+            self._inflight_flushes -= 1
+            self._fence.notify_all()
+            self.stats.spans.record("resolve", t_res0, self._clock())
+
+    def flush(self) -> int:
+        """Route up to ``max_batch`` pending unique seeds NOW. Synchronous
+        on the calling thread; up to ``max_in_flight`` concurrent callers
+        overlap (the router's assemble/split is serialized in dispatch
+        order under ``_seq``, so the router log — and through it every
+        shard's key stream — stays deterministic)."""
+        self._window.acquire()
+        fl = None
+        try:
+            with self._seq:
+                t0 = self._clock()
+                fl = self._assemble()
+                if fl is not None:
+                    self.stats.spans.record("assemble", t0, self._clock())
+            if fl is None:
+                return 0
+            rows = None
+            if fl.error is None:
+                t0 = self._clock()
+                try:
+                    rows = self._dispatch(fl)
+                except BaseException as exc:
+                    fl.error = exc
+                self.stats.spans.record("dispatch", t0, self._clock())
+            self._resolve(fl, rows)
+            if fl.error is not None:
+                raise fl.error
+            return len(fl.keys)
+        finally:
+            self._window.release()
+
+    def _drainable(self) -> bool:
+        with self._lock:
+            return bool(self._pending)
+
+    # -- weight updates / warmup / lifecycle -------------------------------
+
+    def update_params(self, params) -> None:
+        """Fence the ROUTER (no routed flush in the air), then fence every
+        shard engine through its own `update_params` — so no served logit
+        anywhere crosses the weight update, and every shard's embedding
+        cache is invalidated together."""
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                for eng in self.engines.values():
+                    eng.update_params(params)
+                self.params_version += 1
+                self.cache.invalidate()
+                for slot in self._pending.values():
+                    slot.version = self.params_version
+
+    def warmup(self) -> Dict[int, Dict[int, float]]:
+        """Pre-trace every shard engine's bucket programs (twin samplers
+        where supported, so no shard's key stream moves). Returns
+        {host: {bucket: seconds}}."""
+        return {h: eng.warmup() for h, eng in self.engines.items()}
+
+    def aggregate_stats(self) -> Dict[str, object]:
+        """Router snapshot + the per-shard `ServeStats` merged into one
+        view (`ServeStats.merge` -> the `trace` merge family) + per-shard
+        topology shard stats. The merged latency histogram is OWNER-side
+        latency; end-to-end latency (queue + route + owner + return) is the
+        router's own ``stats.latency``."""
+        merged = ServeStats()
+        for h in sorted(self.engines):
+            merged.merge(self.engines[h].stats)
+        return {
+            "router": self.stats.snapshot(),
+            "shards_merged": merged.snapshot(),
+            "per_shard": {
+                h: self.engines[h].stats.snapshot() for h in sorted(self.engines)
+            },
+            "topology": self.shard_topo_stats,
+        }
+
+    def reset_stats(self) -> None:
+        """Zero router counters (re-pointing the router cache's counter at
+        the fresh stats, same contract as `ServeEngine.reset_stats`) and
+        every shard engine's stats. Cache CONTENTS are untouched."""
+        with self._lock:
+            self.stats = DistServeStats()
+            self.cache.counters = self.stats.router_cache
+        for eng in self.engines.values():
+            eng.reset_stats()
+
+    def start(self) -> "DistServeEngine":
+        if self._running:
+            return self
+        self._running = True
+        self._threads = [
+            threading.Thread(
+                target=self._poll_loop,
+                name=f"quiver-dist-serve-flusher-{i}",
+                daemon=True,
+            )
+            for i in range(self.config.max_in_flight)
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        self._running = False
+        for t in self._threads:
+            t.join()
+        self._threads = []
+        if drain:
+            while self._drainable():
+                self.flush()
+        with self._fence:
+            while self._inflight_flushes:
+                self._fence.wait()
+
+    def _poll_loop(self) -> None:
+        while self._running:
+            try:
+                self.pump()
+            except Exception:
+                pass  # the failing flush already resolved its waiters
+            time.sleep(self.config.flush_poll_ms / 1e3)
+
+    def __enter__(self) -> "DistServeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def replay_shard_oracle(
+    dist: DistServeEngine,
+    model,
+    params,
+    full_sampler_factory: Callable[[], object],
+    full_feature,
+) -> Dict[int, np.ndarray]:
+    """THE parity oracle: replay every shard engine's dispatch log through
+    a FRESH sampler over the FULL graph (`full_sampler_factory` must birth
+    it exactly like the shard samplers — same seed — so its key stream
+    matches) and the offline `inference.batch_logits` path over the full
+    feature table. Returns {node_id: logits row} for the first computation
+    of each node per shard.
+
+    That this oracle uses the FULL topology + FULL features is the point:
+    it proves a shard served from 1/H of each table produced logits
+    bit-identical to single-host offline eval. Shard engines must have
+    been built with ``record_dispatches=True`` (`DistServeConfig` default
+    shard config inherits the router's flag)."""
+    from ..inference import _cached_apply, batch_logits
+
+    apply = _cached_apply(model)
+    served: Dict[int, np.ndarray] = {}
+    for h in sorted(dist.engines):
+        sampler = full_sampler_factory()
+        for padded, nvalid in dist.engines[h].dispatch_log:
+            logits = np.asarray(
+                batch_logits(apply, params, sampler, full_feature, padded)
+            )
+            for i in range(nvalid):
+                served.setdefault(int(padded[i]), logits[i])
+    return served

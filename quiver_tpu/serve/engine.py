@@ -225,6 +225,33 @@ class ServeStats:
     latency: LatencyHistogram = field(default_factory=LatencyHistogram)
     spans: SpanRecorder = field(default_factory=SpanRecorder)
 
+    def merge(self, other: "ServeStats") -> "ServeStats":
+        """Fold another engine's stats into this one — the cross-shard
+        aggregation hook the distributed serve engine uses (one merged view
+        over H shard engines: counters add, ``inflight_peak`` is the max
+        across shards, histograms/counters/spans merge via their own
+        `merge` methods in `quiver_tpu.trace`). Merge into a FRESH
+        `ServeStats`, not a live engine's — the source engines keep
+        counting into their own objects. Safe against a LIVE source: the
+        int fields read atomically under the GIL, the bucket dict is
+        snapshotted with the atomic C-level ``.copy()`` (a bare
+        ``.items()`` loop would raise RuntimeError if a flush lands a new
+        bucket mid-iteration), and the histogram/counter/span merges take
+        their own locks — the result is a consistent-enough snapshot, not
+        a fence. Returns self for chaining."""
+        self.requests += other.requests
+        self.coalesced += other.coalesced
+        self.dispatches += other.dispatches
+        self.dispatched_seeds += other.dispatched_seeds
+        self.padded_seeds += other.padded_seeds
+        self.inflight_peak = max(self.inflight_peak, other.inflight_peak)
+        for b, n in other.dispatch_buckets.copy().items():
+            self.dispatch_buckets[b] = self.dispatch_buckets.get(b, 0) + n
+        self.cache.merge(other.cache)
+        self.latency.merge(other.latency)
+        self.spans.merge(other.spans)
+        return self
+
     def snapshot(self) -> Dict[str, object]:
         return {
             "requests": self.requests,
@@ -316,7 +343,10 @@ class ServeEngine:
 
     def submit(self, node_id: int) -> ServeResult:
         """Enqueue one node-prediction request; returns a handle. Fills of
-        ``max_batch`` flush inline on the submitting thread."""
+        ``max_batch`` flush inline on the submitting thread. KEEP IN
+        LOCKSTEP with `DistServeEngine.submit` (serve/dist.py): the
+        distributed router's hosts=1 bit-parity contract rides this exact
+        cache-check/coalesce/flush-at-fill sequence."""
         key = int(node_id)
         now = self._clock()
         need_flush = False

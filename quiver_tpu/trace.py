@@ -195,6 +195,18 @@ class SpanRecorder:
     def clear(self) -> None:
         self._spans.clear()
 
+    def merge(self, other: "SpanRecorder") -> "SpanRecorder":
+        """Append ``other``'s spans into this recorder (cross-shard stats
+        aggregation for the distributed serve engine). Meaningful overlap
+        summaries require the two recorders to share a clock — shard
+        engines driven by one router do (they all read the router's
+        process-wide monotonic clock); spans from different PROCESSES only
+        merge honestly for per-stage busy totals, not overlap_frac.
+        Returns self for chaining."""
+        for span in other._snapshot() if isinstance(other, SpanRecorder) else tuple(other):
+            self._spans.append(span)
+        return self
+
     def overlap_summary(self) -> dict:
         """Measured concurrency of the recorded spans.
 
@@ -307,6 +319,36 @@ class LatencyHistogram:
                     return min(max(mid, self.min_ms), self.max_ms)
             return self.max_ms  # unreachable; guards float drift
 
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Fold ``other``'s samples into this histogram (multi-shard /
+        multi-run aggregation: the distributed serve engine merges per-shard
+        latency into one router-level view, and probe scripts merge repeated
+        runs). Requires identical bucketization — merging histograms with
+        different edges would silently mis-bin ``other``'s counts, so it
+        raises instead. Locks both (self first, then other — call sites must
+        keep that order consistent to stay deadlock-free; the aggregation
+        paths here only ever merge INTO a fresh local histogram). Returns
+        self for chaining."""
+        if not isinstance(other, LatencyHistogram):
+            raise TypeError(f"cannot merge {type(other).__name__}")
+        if self._edges != other._edges:
+            raise ValueError(
+                "LatencyHistogram.merge needs identical bucket edges "
+                f"(self: {len(self._edges)} edges [{self._edges[0]:g}, "
+                f"{self._edges[-1]:g}], other: {len(other._edges)} edges "
+                f"[{other._edges[0]:g}, {other._edges[-1]:g}])"
+            )
+        with self._lock:
+            with other._lock:
+                for i, c in enumerate(other._counts):
+                    self._counts[i] += c
+                self.count += other.count
+                self.sum_ms += other.sum_ms
+                if other.count:
+                    self.min_ms = min(self.min_ms, other.min_ms)
+                    self.max_ms = max(self.max_ms, other.max_ms)
+        return self
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "count": self.count,
@@ -339,6 +381,20 @@ class HitRateCounter:
     def evict(self, n: int = 1) -> None:
         with self._lock:
             self.evictions += n
+
+    def merge(self, other: "HitRateCounter") -> "HitRateCounter":
+        """Fold ``other``'s counts into this counter (cross-shard cache
+        stats for the distributed serve engine; multi-run aggregation for
+        probes). Same lock-order note as `LatencyHistogram.merge`. Returns
+        self for chaining."""
+        if not isinstance(other, HitRateCounter):
+            raise TypeError(f"cannot merge {type(other).__name__}")
+        with self._lock:
+            with other._lock:
+                self.hits += other.hits
+                self.misses += other.misses
+                self.evictions += other.evictions
+        return self
 
     @property
     def total(self) -> int:

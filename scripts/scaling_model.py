@@ -32,6 +32,10 @@ def main():
     ap.add_argument("--serve-sample-ms", type=float, default=None)
     ap.add_argument("--serve-forward-ms", type=float, default=None)
     ap.add_argument("--serve-ref-batch", type=int, default=64)
+    # distributed serving (round 10): H-host rows for the seed-ownership
+    # routed engine — per-shard dispatch + DCN exchange term
+    ap.add_argument("--serve-hosts", default="1,2,4,8")
+    ap.add_argument("--serve-out-dim", type=int, default=47)
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
 
@@ -140,10 +144,42 @@ def main():
         "and SERVE_r01.json (cache/skew sweep).\n\n"
         + format_serve_markdown(serve_rows)
     )
+    # H-host distributed serving rows (quiver_tpu.serve.DistServeEngine):
+    # same cost inputs, bucket split by seed ownership — per-shard width
+    # bucket/H, the serve-shaped exchange priced at the DCN rate like the
+    # training-side sampling exchange
+    serve_cost = (
+        (serve_sample_s, serve_forward_s, serve_ref_batch)
+        if (serve_sample_s or serve_forward_s)
+        else (step_s, 0.0, 1024)
+    )
+    dist_rows = []
+    for hosts in (int(h) for h in args.serve_hosts.split(",")):
+        dist_rows += serve_table(
+            serve_cost[0], 0.0, serve_cost[1], ref_batch=serve_cost[2],
+            buckets=(256,), hit_rates=(0.0, 0.5), unique_frac=0.8,
+            max_delay_ms=2.0, hosts=hosts, out_dim=args.serve_out_dim,
+            bandwidths={"dcn_bytes_per_s": args.dcn_gbps * 1e9},
+        )
+    serve_dist_md = (
+        "## Distributed serving: predicted aggregate QPS vs host count "
+        "(quiver_tpu.serve.dist)\n\n"
+        "Seed-ownership routed engine at global bucket 256: each of H "
+        "shards dispatches a\nbucket/H-wide sub-batch concurrently; one "
+        "routed flush pays one shard dispatch plus\nthe serve-shaped "
+        "exchange (H*H*L int32 ids out + H*H*L*C f32 logits back over "
+        "DCN).\nAggregate QPS scales ~H-fold until the exchange term "
+        "catches the shrinking dispatch.\nMeasured CPU-tier counterpart: "
+        "scripts/serve_probe.py --hosts -> SERVE_r03.json\n(width shrink + "
+        "wire bytes + in-run bit-parity; absolute QPS there shares one "
+        "core).\n\n"
+        + format_serve_markdown(dist_rows)
+    )
     print(md, file=sys.stderr)
     print("\n" + fetch_md, file=sys.stderr)
     print("\n" + quant_md, file=sys.stderr)
     print("\n" + serve_md, file=sys.stderr)
+    print("\n" + serve_dist_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -157,7 +193,7 @@ def main():
         with open(args.out, "w") as fh:
             fh.write(
                 header + md + "\n\n" + fetch_md + "\n\n" + quant_md
-                + "\n\n" + serve_md + "\n"
+                + "\n\n" + serve_md + "\n\n" + serve_dist_md + "\n"
             )
     print(json.dumps({
         "step_s_1chip": step_s,
@@ -172,6 +208,7 @@ def main():
         "sharded_fetch": [r._asdict() for r in fetch_rows],
         "quant_fetch": [r._asdict() for r in quant_rows],
         "serve": [r._asdict() for r in serve_rows],
+        "serve_dist": [r._asdict() for r in dist_rows],
     }))
 
 

@@ -1,30 +1,40 @@
-"""Synthetic online-serving probe: QPS / tail latency / cache hit rate vs
-request skew, and pipelined-dispatch overlap vs the in-flight window.
+"""Synthetic online-serving probe, round 10: cross-host sharded serving —
+aggregate QPS / per-shard batch width / exchange bytes vs host count.
 
-Replays seeded Zipfian request traces through the REAL serving engine
-(`quiver_tpu.serve.ServeEngine` — micro-batching, coalescing, embedding
-cache, bounded in-flight window) over a small community graph, under
-SATURATED load (several closed-loop client threads + the engine's poller
-threads), at 3 skew settings x max_in_flight 1 / 2 / 4, and prints ONE
-json line (written to SERVE_r02.json by the round driver). On this 1-core
-CPU box the absolute QPS is a floor, not a ceiling — the point of the
-artifact is the TRAJECTORY: how hit rate, coalescing, dispatch count, and
-the MEASURED per-stage overlap (`stats.spans.overlap_summary()`, same
-machinery as the tiered training pipeline) move with skew and window size.
+Replays seeded Zipfian request traces through the REAL distributed serving
+engine (`quiver_tpu.serve.DistServeEngine`: front-end router with
+dedup/coalescing + a result cache, seed-ownership split, the serve-shaped
+all_to_all exchange, per-owner pipelined `ServeEngine`s over true 1/H
+topology + feature shards) on a community graph whose contiguous partition
+is k-hop CLOSED — so the shard tables are exactly 1/H with zero halo. Runs
+under saturated load (closed-loop client threads + the router's pollers)
+at 2 skews x hosts 1 / 2 / 4, and prints ONE json line (written to
+SERVE_r03.json by the round driver).
 
-Also measures the serve dispatch cost SPLIT the analytic model wants:
-`inference.sample_batch` vs `inference.forward_logits` timed separately
-(the two stages of `batch_logits`), fed to `scaling.serve_table` — the
-eval-shaped costs NEXT.md follow-up (b) asked for, replacing the
-pessimistic train-step bound.
+On this 1-core CPU box every "host" shares one core, so absolute QPS does
+NOT scale with H here — the hardware-true signal is the TRAJECTORY the
+artifact records: per-shard sub-batch width shrinking as 1/H (the term
+that divides per-host device time on a real pod), the measured exchange
+payload bytes, and BIT-PARITY asserted in-run: every served row is
+compared against the offline `batch_logits` replay of the owning shard's
+dispatch log through a FULL-graph sampler (`replay_shard_oracle`) — the
+acceptance contract that sharding adds nothing numerically.
+
+Also measures the eval-shaped dispatch cost split (`time_eval_split`) and
+emits `scaling.serve_table(hosts=H)` for the same host counts — the
+analytic aggregate-QPS model (per-shard dispatch + DCN exchange term)
+next to the measured trajectory, plus the git revision of the tree that
+produced the artifact (SERVE_r01.json is un-rerunnable without digging
+through CHANGES.md — never again).
 
 Usage: JAX_PLATFORMS=cpu python scripts/serve_probe.py [--requests 400]
-       [--out SERVE_r02.json]
+       [--hosts 1,2,4] [--out SERVE_r03.json]
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -32,6 +42,26 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def git_revision() -> str:
+    """Best-effort `git rev-parse HEAD` of the repo this probe ran from,
+    with a ``-dirty`` suffix when the working tree has uncommitted changes
+    (an artifact stamped with a clean-looking revision it wasn't actually
+    built from would be worse than no stamp)."""
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd,
+            capture_output=True, text=True, timeout=10, check=True,
+        ).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=cwd,
+            capture_output=True, text=True, timeout=10, check=True,
+        ).stdout.strip()
+        return rev + ("-dirty" if dirty else "")
+    except Exception:
+        return "unknown"
 
 
 def community_graph(n_comm=4, per_comm=120, intra=10, dim=32, seed=0):
@@ -49,14 +79,20 @@ def community_graph(n_comm=4, per_comm=120, intra=10, dim=32, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--requests", type=int, default=400)
     ap.add_argument("--max-batch", type=int, default=32)
     ap.add_argument("--clients", type=int, default=4)
-    # cache off by default: SERVE_r01.json already charts hit-rate vs skew;
-    # this round's sweep isolates the DISPATCH path the window pipelines
-    ap.add_argument("--cache-entries", type=int, default=0)
+    ap.add_argument("--hosts", default="1,2,4")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    hosts_sweep = [int(h) for h in args.hosts.split(",")]
+
+    # the collective serve exchange needs one CPU device per simulated
+    # host; must land before jax initializes
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={max(hosts_sweep + [2])}"
+    ).strip()
 
     import jax
     import jax.numpy as jnp
@@ -66,138 +102,175 @@ def main():
     from quiver_tpu.parallel.scaling import format_serve_markdown, serve_table
     from quiver_tpu.pyg.sage_sampler import GraphSageSampler
     from quiver_tpu.serve import (
+        DistServeConfig,
+        DistServeEngine,
         ServeConfig,
-        ServeEngine,
+        replay_shard_oracle,
         trace_skew_stats,
         zipfian_trace,
     )
 
     edge_index, feat, n = community_graph()
-    # heavy enough that the dispatch stage (XLA forward, GIL released) is a
-    # real fraction of a flush — the regime where the in-flight window can
-    # actually hide host batching under device execution on this 1-core box
+    topo = CSRTopo(edge_index=edge_index)
+    SIZES, SEED = [8, 8], 1
     model = GraphSAGE(hidden_dim=64, out_dim=8, num_layers=2, dropout=0.0)
 
-    def make_sampler():
-        return GraphSageSampler(
-            CSRTopo(edge_index=edge_index), sizes=[8, 8], mode="TPU", seed=1
-        )
+    def make_full_sampler():
+        return GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED)
 
-    s0 = make_sampler()
+    s0 = make_full_sampler()
     ds0 = s0.sample_dense(np.arange(args.max_batch, dtype=np.int64))
     params = model.init(
         jax.random.key(0), jnp.zeros((ds0.n_id.shape[0], feat.shape[1])), ds0.adjs
     )
 
-    def run(alpha, max_in_flight):
-        eng = ServeEngine(
-            model, params, make_sampler(), feat,
-            ServeConfig(max_batch=args.max_batch, max_delay_ms=2.0,
-                        cache_entries=args.cache_entries,
-                        max_in_flight=max_in_flight),
+    def run(alpha, hosts):
+        # caches ON (router + owners): parity across repeat requests is
+        # only well-defined when each node is computed once per version —
+        # and a served repeat answered host-side is the production path
+        dist = DistServeEngine.build(
+            model, params, topo, feat, SIZES, hosts=hosts,
+            config=DistServeConfig(
+                hosts=hosts, max_batch=args.max_batch, max_delay_ms=2.0,
+                record_dispatches=True,
+                # a 2-bucket ladder per shard: the full pow2 ladder costs
+                # ~6 compiles x shards x ~4 s on this box, and the sweep's
+                # signal (width shrink, exchange bytes, parity) doesn't
+                # need bucket granularity
+                shard_config=ServeConfig(
+                    max_batch=args.max_batch,
+                    buckets=(8, args.max_batch),
+                    max_delay_ms=2.0,
+                    record_dispatches=True,
+                ),
+            ),
+            sampler_seed=SEED,
         )
-        # every bucket's compile out of the timed window (warmup rides a
-        # twin sampler: the serving key stream is untouched)
-        eng.warmup()
-        eng.cache.invalidate()
-        eng.reset_stats()
+        dist.warmup()
+        dist.reset_stats()
         trace = zipfian_trace(n, args.requests, alpha=alpha, seed=42)
         chunks = np.array_split(trace, args.clients)
-        errors = []
+        results, errors = {}, []
 
-        def client(chunk):
+        def client(tid, chunk):
             try:
-                eng.predict(chunk, timeout=300)
-            except Exception as exc:  # surfaced in the artifact, not lost
+                results[tid] = (chunk, dist.predict(chunk, timeout=300))
+            except Exception as exc:
                 errors.append(repr(exc))
 
         t0 = time.perf_counter()
-        with eng:  # max_in_flight poller threads + inline client flushes
-            threads = [threading.Thread(target=client, args=(c,)) for c in chunks]
+        with dist:
+            threads = [
+                threading.Thread(target=client, args=(i, c))
+                for i, c in enumerate(chunks)
+            ]
             [t.start() for t in threads]
             [t.join() for t in threads]
         wall = time.perf_counter() - t0
-        s = eng.stats
+
+        # IN-RUN PARITY: every served row must bit-match the offline
+        # replay of the owning shard's dispatch log through a FULL-graph
+        # sampler — the probe hard-fails on any mismatch
+        parity_rows = 0
+        if not errors:
+            oracle = replay_shard_oracle(dist, model, params, make_full_sampler, feat)
+            for ids, out in results.values():
+                for nid, row in zip(ids, out):
+                    assert np.array_equal(row, oracle[int(nid)]), (
+                        f"PARITY VIOLATION at node {int(nid)} (hosts={hosts})"
+                    )
+                    parity_rows += 1
+
+        s = dist.stats
+        widths = s.mean_sub_batch_width()
+        router_mean = s.routed_seeds / max(s.router_dispatches, 1)
+        if hosts > 1 and s.router_dispatches:
+            # the 1/H width shrink, asserted in-run (uniform-ish ownership
+            # split of each flush; slack for small final flushes)
+            assert all(w <= router_mean / hosts * 1.6 + 1 for w in widths.values()), (
+                widths, router_mean, hosts,
+            )
         lat = s.latency.snapshot()
-        ov = s.spans.overlap_summary()
         return {
             "alpha": alpha,
-            "max_in_flight": max_in_flight,
+            "hosts": hosts,
+            "exchange_mode": dist.exchange_mode,
             "clients": args.clients,
-            "cache_entries": args.cache_entries,
             "skew": trace_skew_stats(trace),
-            # a timed-out/failed client means NOT all requests were
-            # served: recording requests/wall would fake a QPS — null it
-            # (and the aggregate below skips the window entirely)
             "qps": round(args.requests / wall, 1) if not errors else None,
             "p50_ms": round(lat["p50_ms"], 3),
-            "p95_ms": round(lat["p95_ms"], 3),
             "p99_ms": round(lat["p99_ms"], 3),
-            "dispatches": s.dispatches,
-            "dispatched_seeds": s.dispatched_seeds,
-            "padded_seeds": s.padded_seeds,
+            "router_dispatches": s.router_dispatches,
+            "routed_seeds": s.routed_seeds,
             "coalesced": s.coalesced,
-            "cache_hit_rate": round(s.cache.hit_rate, 4),
-            "inflight_peak": s.inflight_peak,
-            "overlap_frac": ov.get("overlap_frac", 0.0),
-            "hidden_frac_measured": ov.get("hidden_frac_measured", 0.0),
-            "stage_busy_s": ov.get("busy_s", {}),
+            "router_cache_hit_rate": round(s.router_cache.hit_rate, 4),
+            "mean_router_flush_width": round(router_mean, 2),
+            "mean_sub_batch_width": {str(h): round(w, 2) for h, w in widths.items()},
+            "exchange_id_bytes": s.exchange_id_bytes,
+            "exchange_logit_bytes": s.exchange_logit_bytes,
+            "shard_edge_frac": {
+                str(h): round(st["edge_frac"], 4)
+                for h, st in dist.shard_topo_stats.items()
+            },
+            "shards_merged": {
+                k: v
+                for k, v in dist.aggregate_stats()["shards_merged"].items()
+                if k in ("dispatches", "dispatched_seeds", "coalesced")
+            },
+            "parity_rows_checked": parity_rows,
             "errors": errors,
-            "requests_per_dispatch": round(
-                args.requests / max(s.dispatches, 1), 2
-            ),
         }
 
     points = []
-    for alpha in (0.0, 0.99, 1.3):
-        for mif in (1, 2, 4):
-            points.append(run(alpha, mif))
+    for alpha in (0.0, 1.1):
+        for hosts in hosts_sweep:
+            points.append(run(alpha, hosts))
 
-    # the acceptance headline: saturated-load throughput per window size,
-    # aggregated across the three skews (sum of requests / sum of walls).
-    # Per-point QPS at one skew can tie within this 1-core box's noise;
-    # the aggregate is the stable comparison. A window with ANY failed
-    # point gets no aggregate — a partial trace must not inflate it
+    # saturated aggregate per host count (sum of requests / sum of walls
+    # across skews); a host count with ANY failed point gets no aggregate
     saturated = {}
-    for mif in (1, 2, 4):
-        ps = [p for p in points if p["max_in_flight"] == mif]
+    for hosts in hosts_sweep:
+        ps = [p for p in points if p["hosts"] == hosts]
         if any(p["qps"] is None for p in ps):
-            saturated[str(mif)] = None
+            saturated[str(hosts)] = None
             continue
         wall = sum(args.requests / p["qps"] for p in ps)
-        saturated[str(mif)] = round(len(ps) * args.requests / wall, 1)
+        saturated[str(hosts)] = round(len(ps) * args.requests / wall, 1)
 
-    # measured per-batch dispatch cost at max_batch, SPLIT the way the
-    # engine's stages split it: sample_batch (sampler key draw + k-hop
-    # sample) vs forward_logits (gather + jitted apply). The split feeds
-    # serve_table the eval-shaped costs directly — no train-step proxy.
-    # Shared helper with bench.py's serve section: one methodology.
+    # eval-shaped dispatch cost split at max_batch -> the H-host analytic
+    # model (per-shard dispatch + DCN exchange) for the same sweep
     from quiver_tpu.inference import _cached_apply, time_eval_split
 
     apply = _cached_apply(model)
     t_sample, t_forward = time_eval_split(
-        apply, params, make_sampler(), feat,
+        apply, params, make_full_sampler(), feat,
         np.arange(args.max_batch, dtype=np.int64), iters=20,
     )
-    pred = serve_table(
-        t_sample, 0.0, t_forward, ref_batch=args.max_batch,
-        buckets=(args.max_batch,), hit_rates=(0.0, 0.5, 0.9),
-        unique_frac=0.8, max_delay_ms=2.0,
-    )
+    tables = {}
+    for hosts in hosts_sweep:
+        pred = serve_table(
+            t_sample, 0.0, t_forward, ref_batch=args.max_batch,
+            buckets=(args.max_batch,), hit_rates=(0.0, 0.5, 0.9),
+            unique_frac=0.8, max_delay_ms=2.0, hosts=hosts,
+            out_dim=model.out_dim,
+        )
+        tables[str(hosts)] = {
+            "rows": [p._asdict() for p in pred],
+            "md": format_serve_markdown(pred),
+        }
 
     out = {
-        "metric": "serve_probe",
+        "metric": "serve_probe_dist",
+        "git_revision": git_revision(),
         "requests": args.requests,
         "max_batch": args.max_batch,
         "backend": jax.devices()[0].platform,
         "points": points,
-        "saturated_qps_by_mif": saturated,
+        "saturated_qps_by_hosts": saturated,
         "measured_sample_s": round(t_sample, 6),
         "measured_forward_s": round(t_forward, 6),
-        "measured_dispatch_s": round(t_sample + t_forward, 6),
-        "cost_source": "eval_split",  # sample_batch + forward_logits, not a train step
-        "serve_table": [p._asdict() for p in pred],
-        "serve_table_md": format_serve_markdown(pred),
+        "cost_source": "eval_split",
+        "serve_table_by_hosts": tables,
     }
     line = json.dumps(out)
     print(line)

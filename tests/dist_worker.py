@@ -16,6 +16,14 @@ mesh shape, same arithmetic; only the process layout differs).
 mode "train_topo_tiled": same, through `make_sharded_topo_train_step`
 with the TILED row-sharded topology (`TiledShardedTopology`): each
 process ends up holding only its own 128-lane tile block of the CSR.
+mode "serve": the serve-shaped exchange (`TpuComm.exchange_serve`) across
+two REAL processes: each holds only its own seed-ownership shard
+(topology closure + owned feature rows), runs a local pipelined
+`ServeEngine` as the registered answerer, and routes a mixed-ownership
+request batch through the collective — seed ids out, logits back. Each
+worker verifies the REMOTE rows it got back bit-match a local simulation
+of the peer's engine (deterministic build + key stream), i.e. the
+cross-host hop added nothing numerically.
 """
 
 import os
@@ -88,11 +96,133 @@ def train_main(pid: int, port: str, topo_tiled: bool = False) -> None:
     print(f"worker {pid} OK", flush=True)
 
 
+def serve_main(pid: int, port: str) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2 and jax.device_count() == 2
+
+    import numpy as np
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.comm import TpuComm
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+    from quiver_tpu.serve import ServeConfig, ServeEngine, shard_topology_by_owner
+
+    # deterministic 2-community graph: the community partition is k-hop
+    # CLOSED, so each host's topology closure is exactly its own community
+    # (true 1/H shards) and its owned feature rows cover every sampled id
+    rng = np.random.default_rng(7)
+    per, intra, dim, sizes, seed = 40, 6, 8, [4, 4], 5
+    n = 2 * per
+    src, dst = [], []
+    for u in range(n):
+        cu = u // per
+        for v in rng.choice(per, intra, replace=False) + cu * per:
+            src.append(u)
+            dst.append(int(v))
+    edge_index = np.stack([np.array(src), np.array(dst)])
+    feat_full = np.random.default_rng(8).standard_normal((n, dim)).astype(np.float32)
+    global2host = (np.arange(n) // per).astype(np.int32)
+    model = GraphSAGE(hidden_dim=16, out_dim=6, num_layers=2, dropout=0.0)
+    topo = CSRTopo(edge_index=edge_index)
+
+    def build_engine(host):
+        """Any host's engine is deterministically reconstructible (same
+        shard build, same sampler seed) — workers use that to VERIFY the
+        peer's answers without ever serving from its state."""
+        shard_topo, st = shard_topology_by_owner(
+            topo, global2host, host, hops=len(sizes) - 1
+        )
+        assert st["edges_kept"] * 2 == st["edges_total"], st  # true 1/H shard
+        feat = np.zeros_like(feat_full)
+        owned = np.nonzero(global2host == host)[0]
+        feat[owned] = feat_full[owned]  # this host's rows only
+        sampler = GraphSageSampler(shard_topo, sizes=sizes, mode="TPU", seed=seed)
+        return ServeEngine(
+            model, params, sampler, feat,
+            ServeConfig(max_batch=16, max_delay_ms=1e9, record_dispatches=True),
+        )
+
+    s0 = GraphSageSampler(topo, sizes=sizes, mode="TPU", seed=seed)
+    ds0 = s0.sample_dense(np.arange(8, dtype=np.int64))
+    params = model.init(
+        jax.random.key(0), jnp.zeros((ds0.n_id.shape[0], dim)), ds0.adjs
+    )
+
+    engine = build_engine(pid)
+    mesh = Mesh(np.array(jax.devices()), ("host",))
+    comm = TpuComm(rank=pid, world_size=2, mesh=mesh)
+    comm.static_budget = 8
+    out_dim = 6
+
+    def answerer(recv_ids):
+        out = np.zeros((2, comm.static_budget, out_dim), np.float32)
+        for req in range(2):
+            valid = recv_ids[req] >= 0
+            if valid.any():
+                ids = recv_ids[req][valid].astype(np.int64)
+                out[req, valid] = np.asarray(engine.predict(ids))
+        return out
+
+    comm.register_serve_answerer(pid, answerer)
+
+    # each worker's (deterministic) mixed-ownership request batch, split by
+    # owner — both workers know BOTH traces, so each can simulate the
+    # peer's full received batch when verifying
+    traces = {
+        0: np.array([3, per + 5, 7, per + 9], np.int64),
+        1: np.array([per + 1, 2, per + 11, 6], np.int64),
+    }
+    host2ids = [traces[pid][global2host[traces[pid]] == h] for h in range(2)]
+    res = comm.exchange_serve(host2ids, out_dim=out_dim)
+
+    # loopback rows == the local engine's own results
+    own = host2ids[pid]
+    if own.size:
+        np.testing.assert_array_equal(res[pid], np.asarray(engine.predict(own)))
+
+    # remote rows == a local simulation of the peer's engine consuming its
+    # requests in the requester-major order the answerer uses (worker 0's
+    # ids first, then worker 1's)
+    peer = 1 - pid
+    sim = build_engine(peer)
+    sim_out = {}
+    for req in (0, 1):
+        ids = traces[req][global2host[traces[req]] == peer]
+        if ids.size:
+            rows = np.asarray(sim.predict(ids))
+            if req == pid:
+                sim_out = dict(zip(ids.tolist(), rows))
+    want = host2ids[peer]
+    got = np.asarray(res[peer])
+    for i, nid in enumerate(want):
+        np.testing.assert_array_equal(got[i], sim_out[int(nid)])
+
+    print(f"worker {pid} OK", flush=True)
+
+
 def main() -> None:
     pid = int(sys.argv[1])
     port = sys.argv[2]
     if len(sys.argv) > 3 and sys.argv[3] in ("train", "train_topo_tiled"):
         train_main(pid, port, topo_tiled=sys.argv[3] == "train_topo_tiled")
+        return
+    if len(sys.argv) > 3 and sys.argv[3] == "serve":
+        serve_main(pid, port)
         return
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.setdefault("XLA_FLAGS", "")

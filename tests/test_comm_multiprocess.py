@@ -71,8 +71,22 @@ _CPU_MULTIPROCESS_UNSUPPORTED = (
 )
 
 
+pytestmark = pytest.mark.multiprocess  # 2-OS-process tests (see pytest.ini)
+
+
 def test_two_process_exchange():
     _run_workers()
+
+
+def test_two_process_serve_exchange_bit_parity():
+    """`TpuComm.exchange_serve` across two REAL processes: each holds only
+    its seed-ownership shard (community-closed topology + owned feature
+    rows) and answers routed sub-batches through its local pipelined
+    `ServeEngine`; every remote logits row must bit-match a local
+    simulation of the peer's engine. The multi-process leg of the
+    distributed serving tentpole (single-controller coverage lives in
+    tests/test_serve_dist.py)."""
+    _run_workers(mode="serve")
 
 
 def test_two_process_sharded_train_step_matches_single_controller():

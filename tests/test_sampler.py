@@ -382,6 +382,50 @@ def test_weighted_sampling_copy_all_and_zero_weight():
     assert set(r1.tolist()) == {1, 3}
 
 
+def test_weighted_flat_window_select_draw_parity_with_take_along_axis(graph):
+    """Round-10 fix of the last hot-ish `take_along_axis` (PERF_NOTES.md
+    round-5 grep rule): the flat weighted layer's [B, max_deg] window
+    select is now plain address arithmetic (the window is affine in the
+    drawn position). Draw parity pin: bit-identical (nbrs, valid) to the
+    previous take_along_axis formulation on the same key, across degrees
+    (copy-all rows, deg > k rows, truncated-by-max_deg rows, invalid
+    lanes)."""
+    from quiver_tpu.ops.sample import (
+        gumbel_topk_positions, row_windows, weighted_sample_layer,
+    )
+
+    topo = graph
+    rng = np.random.default_rng(3)
+    weights = jnp.asarray(rng.uniform(0.1, 2.0, topo.edge_count).astype(np.float32))
+    indptr, indices = topo.to_device()
+    B, k, max_deg = 64, 4, 8  # max_deg 8 < max degree: truncation exercised
+    seeds = jnp.asarray(rng.integers(0, topo.node_count, B).astype(np.int32))
+    seed_valid = jnp.asarray(rng.random(B) < 0.9)
+    key = jax.random.key(9)
+
+    def reference_take_along_axis(ip, ix, w, s, sv, k, key, max_deg):
+        # the pre-round-10 formulation, verbatim
+        n = ip.shape[0] - 1
+        s = jnp.clip(s, 0, n - 1).astype(ip.dtype)
+        ptr, deg = row_windows(ip, s)
+        deg = jnp.where(sv, jnp.minimum(deg, max_deg), 0)
+        lanes = ptr[:, None] + jnp.arange(max_deg, dtype=ip.dtype)[None, :]
+        lanes = jnp.clip(lanes, 0, ix.shape[0] - 1)
+        w_rows = jnp.take(w, lanes)
+        pos, valid = gumbel_topk_positions(key, deg, k, w_rows)
+        flat = jnp.take_along_axis(lanes, pos.astype(ptr.dtype), axis=1)
+        return jnp.take(ix, flat), valid
+
+    got_n, got_v = weighted_sample_layer(
+        indptr, indices, weights, seeds, seed_valid, k, key, max_deg
+    )
+    ref_n, ref_v = reference_take_along_axis(
+        indptr, indices, weights, seeds, seed_valid, k, key, max_deg
+    )
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
+    np.testing.assert_array_equal(np.asarray(got_n), np.asarray(ref_n))
+
+
 def test_weighted_sampler_end_to_end(graph):
     """weighted=True routes every pipeline through Gumbel top-k; heavier
     edges must be sampled more often."""

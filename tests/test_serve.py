@@ -128,6 +128,56 @@ def test_embedding_cache_lru_and_versioning():
     assert len(z) == 0 and z.get(1, 0) is None
 
 
+def test_embedding_cache_concurrent_readers_during_invalidation():
+    """Readers hammering `get` while a writer thread loops the
+    `update_params` sequence (version bump + `invalidate`) — the race the
+    engine's fence normally narrows but the cache must survive on its own:
+    no exception, no torn state, and NO STALE READ — every value handed
+    back must belong to exactly the version it was requested at (values
+    encode their version, so a cross-version leak is detectable)."""
+    import time as _t
+
+    cache = EmbeddingCache(capacity=64)
+    n_ids = 32
+    version = [0]
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            for v in range(1, 40):
+                version[0] = v
+                cache.invalidate()
+                for i in range(n_ids):
+                    cache.put(i, v, np.full(4, float(v)))
+                _t.sleep(0.001)
+        except Exception as exc:
+            errors.append(exc)
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                v = version[0]
+                got = cache.get(int(np.random.randint(n_ids)), v)
+                # a hit must carry EXACTLY the requested version's value —
+                # a racing writer may make it a miss, never a stale read
+                if got is not None:
+                    assert got[0] == float(v), (got[0], v)
+        except Exception as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    w = threading.Thread(target=writer)
+    [t.start() for t in threads + [w]]
+    [t.join() for t in threads + [w]]
+    assert not errors
+    # counters stayed coherent under the race
+    c = cache.counters
+    assert c.total == c.hits + c.misses and c.total > 0
+
+
 # -- bucket ladder ------------------------------------------------------------
 
 def test_default_buckets_and_bucket_for(setup):

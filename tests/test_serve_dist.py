@@ -1,0 +1,346 @@
+"""Distributed serving engine tests (quiver_tpu.serve.dist).
+
+Hermetic single-controller pod simulation on the 8-device CPU mesh. The
+contract under test, per docs/api.md "Distributed serving":
+
+- BIT-PARITY: every routed, owner-served logits row is identical to the
+  offline `batch_logits` replay of the owning shard's dispatch log through
+  a FULL-graph sampler (`replay_shard_oracle`) — i.e. serving from 1/H
+  topology + feature shards adds nothing numerically — at shards 1 and 2
+  and max_in_flight 1 and 2, in both exchange modes;
+- the ``hosts=1`` engine degenerates to the single-host `ServeEngine`
+  bit-for-bit: same served logits, same dispatch log, same key stream,
+  INCLUDING embedding-cache behavior;
+- routing is observable: per-shard sub-batch width shrinks ~1/H, the
+  exchange byte counters match the collective's static payload shape, and
+  the per-shard/router stats merge into one coherent view;
+- `update_params` fences the router AND every shard engine together.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.comm import exchange_serve_all
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    DistServeConfig,
+    DistServeEngine,
+    ServeConfig,
+    ServeEngine,
+    contiguous_partition,
+    replay_shard_oracle,
+    shard_topology_by_owner,
+    zipfian_trace,
+)
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+EDGE_INDEX = make_random_graph(N_NODES, 2000, seed=0)
+
+
+def make_full_sampler():
+    return GraphSageSampler(
+        CSRTopo(edge_index=EDGE_INDEX), sizes=SIZES, mode="TPU", seed=SAMPLER_SEED
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    sampler = make_full_sampler()
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_dist(setup, hosts, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("cache_entries", 512)
+    return DistServeEngine.build(
+        model, params, CSRTopo(edge_index=EDGE_INDEX), feat, SIZES,
+        hosts=hosts, config=DistServeConfig(hosts=hosts, **cfg_kw),
+        sampler_seed=SAMPLER_SEED,
+    )
+
+
+# -- partitioning -------------------------------------------------------------
+
+def test_contiguous_partition():
+    g = contiguous_partition(10, 3)
+    assert g.tolist() == [0, 0, 0, 0, 1, 1, 1, 1, 2, 2]
+    assert contiguous_partition(4, 1).tolist() == [0, 0, 0, 0]
+    with pytest.raises(ValueError):
+        contiguous_partition(0, 2)
+
+
+def test_shard_topology_by_owner_closure_and_stats():
+    # 2-community graph with no cross edges: the partition is k-hop CLOSED,
+    # so each shard keeps exactly its community's edges (true 1/H shards)
+    per = 20
+    src, dst = [], []
+    for u in range(2 * per):
+        base = (u // per) * per
+        for v in range(3):
+            src.append(u)
+            dst.append(base + (u + v + 1) % per)
+    topo = CSRTopo(edge_index=np.stack([np.array(src), np.array(dst)]))
+    g2h = (np.arange(2 * per) // per).astype(np.int32)
+    for h in (0, 1):
+        shard, st = shard_topology_by_owner(topo, g2h, h, hops=1)
+        assert st["owned_nodes"] == per and st["closure_nodes"] == per
+        assert st["edges_kept"] * 2 == st["edges_total"]
+        assert shard.indptr.shape[0] == topo.indptr.shape[0]  # global id space
+        # kept rows are bit-identical to the full graph's
+        for u in np.nonzero(g2h == h)[0]:
+            np.testing.assert_array_equal(
+                shard.indices[shard.indptr[u]:shard.indptr[u + 1]],
+                topo.indices[topo.indptr[u]:topo.indptr[u + 1]],
+            )
+        # other community's rows read degree 0
+        other = np.nonzero(g2h != h)[0]
+        assert (shard.indptr[other + 1] - shard.indptr[other] == 0).all()
+    # on a random (non-closed) graph the closure halo is reported, not hidden
+    rshard, rst = shard_topology_by_owner(
+        CSRTopo(edge_index=EDGE_INDEX), contiguous_partition(N_NODES, 2), 0, hops=1
+    )
+    assert rst["closure_nodes"] > rst["owned_nodes"]
+    assert 0.5 < rst["edge_frac"] <= 1.0
+
+
+# -- the serve-shaped exchange (comm level) -----------------------------------
+
+def test_exchange_serve_all_roundtrip():
+    """ids route to owners requester-major; answers route back to the
+    requesting host — the exact addressing `_exchange_jit` uses, verified
+    with an answer function that encodes (owner, id)."""
+    from jax.sharding import Mesh
+
+    H, L, C = 2, 4, 3
+    mesh = Mesh(np.array(jax.devices()[:H]), ("h",))
+    req = np.full((H, H, L), -1, np.int64)
+    req[0, 1, :2] = [5, 7]      # host 0 asks host 1 for ids 5, 7
+    req[1, 0, :3] = [2, 4, 6]   # host 1 asks host 0 for 2, 4, 6
+    seen = {}
+
+    def answer(host, recv_ids):
+        seen[host] = recv_ids.copy()
+        out = np.zeros((H, L, C), np.float32)
+        valid = recv_ids >= 0
+        out[valid] = (
+            100.0 * host + recv_ids[valid].astype(np.float32)
+        )[:, None] + np.arange(C, dtype=np.float32)[None, :]
+        return out
+
+    out = np.asarray(exchange_serve_all(mesh, "h", req, answer, C))
+    # owners saw the ids addressed to them, requester-major
+    assert seen[1][0, :2].tolist() == [5, 7] and (seen[1][1] == -1).all()
+    assert seen[0][1, :3].tolist() == [2, 4, 6] and (seen[0][0] == -1).all()
+    # requesters got their answers back in request-lane order
+    np.testing.assert_array_equal(
+        out[0, 1, :2],
+        np.array([[105, 106, 107], [107, 108, 109]], np.float32),
+    )
+    np.testing.assert_array_equal(
+        out[1, 0, :3],
+        np.array([[2, 3, 4], [4, 5, 6], [6, 7, 8]], np.float32),
+    )
+    assert (out[0, 0] == 0).all() and (out[1, 1] == 0).all()  # empty lanes
+
+
+# -- parity (the acceptance tests) --------------------------------------------
+
+@pytest.mark.parametrize("mif", [1, 2])
+def test_shards1_bit_equal_single_host_engine(setup, mif):
+    """The degenerate case: hosts=1 must reproduce the single-host
+    `ServeEngine` bit-for-bit on the same trace — served logits AND the
+    dispatch log (same key stream), including cache-hit behavior."""
+    model, params, feat = setup
+    trace = zipfian_trace(N_NODES, 40, alpha=1.1, seed=7)
+    plain = ServeEngine(
+        model, params, make_full_sampler(), feat,
+        ServeConfig(max_batch=8, max_delay_ms=1e9, record_dispatches=True,
+                    cache_entries=512, max_in_flight=mif),
+    )
+    out_plain = plain.predict(trace)
+    dist = make_dist(setup, hosts=1, max_in_flight=mif)
+    out_dist = dist.predict(trace)
+    assert np.array_equal(out_plain, out_dist)
+    log0 = dist.engines[0].dispatch_log
+    assert len(plain.dispatch_log) == len(log0)
+    for (p0, n0), (p1, n1) in zip(plain.dispatch_log, log0):
+        assert n0 == n1 and np.array_equal(p0, p1)
+
+
+@pytest.mark.parametrize("mif", [1, 2])
+def test_two_shard_routed_serving_replay_parity(setup, mif):
+    """THE acceptance pin: 2 seed-ownership shards, requests routed through
+    the collective serve exchange, every served row bit-identical to the
+    offline replay of the owning shard's dispatch log through a FULL-graph
+    sampler — 1/H topology + feature shards add nothing numerically."""
+    model, params, feat = setup
+    trace = zipfian_trace(N_NODES, 40, alpha=1.1, seed=7)
+    dist = make_dist(setup, hosts=2, max_in_flight=mif)
+    assert dist.exchange_mode == "collective"  # 8-device mesh available
+    out = dist.predict(trace)
+    oracle = replay_shard_oracle(dist, model, params, make_full_sampler, feat)
+    for i, nid in enumerate(trace):
+        assert np.array_equal(out[i], oracle[int(nid)])
+    # both shards actually served, and the routed widths shrink vs the
+    # global flush width (the 1/H claim, measured)
+    widths = dist.stats.mean_sub_batch_width()
+    assert set(widths) == {0, 1}
+    assert all(w <= dist.config.max_batch / 2 + 2 for w in widths.values())
+    assert sum(dist.stats.sub_batch_seeds.values()) == dist.stats.routed_seeds
+    # exchange byte counters match the collective's static payload shape
+    H, L, C = 2, dist._budget, dist.out_dim
+    assert dist.stats.exchange_id_bytes == dist.stats.router_dispatches * H * H * L * 4
+    assert (
+        dist.stats.exchange_logit_bytes
+        == dist.stats.router_dispatches * H * H * L * C * 4
+    )
+    # ...and the analytic model prices exactly those bytes (serve_table's
+    # lane budget must track the engine's static budget, byte for byte)
+    from quiver_tpu.parallel.scaling import serve_table
+
+    row = serve_table(
+        1e-3, 0.0, 1e-3, ref_batch=8, buckets=(dist.config.max_batch,),
+        hit_rates=(0.0,), hosts=H, out_dim=C,
+    )[0]
+    per_dispatch = (
+        dist.stats.exchange_id_bytes + dist.stats.exchange_logit_bytes
+    ) / dist.stats.router_dispatches
+    assert row.exchange_bytes == per_dispatch
+
+
+def test_host_mode_bit_equal_collective_mode(setup):
+    """exchange='host' (loopback, no mesh) must serve byte-identical
+    results to the collective mode — the wire moves bytes, never values."""
+    model, params, feat = setup
+    trace = zipfian_trace(N_NODES, 30, alpha=0.9, seed=11)
+    out_c = make_dist(setup, hosts=2).predict(trace)
+    dist_h = make_dist(setup, hosts=2, exchange="host")
+    assert dist_h.exchange_mode == "host"
+    out_h = dist_h.predict(trace)
+    assert np.array_equal(out_c, out_h)
+    assert dist_h.stats.exchange_id_bytes == 0  # nothing rode a wire
+
+
+def test_threaded_clients_replay_parity_and_router_coalescing(setup):
+    model, params, feat = setup
+    dist = make_dist(setup, hosts=2, max_delay_ms=2.0, max_in_flight=2)
+    trace = zipfian_trace(N_NODES, 48, alpha=1.1, seed=13)
+    results = {}
+    errors = []
+
+    def client(tid):
+        try:
+            ids = trace[tid * 4 : (tid + 1) * 4]
+            results[tid] = (ids, dist.predict(ids, timeout=120))
+        except Exception as exc:
+            errors.append(exc)
+
+    with dist:
+        threads = [threading.Thread(target=client, args=(t,)) for t in range(12)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+    assert not errors
+    assert dist.stats.requests == len(trace)
+    # every request accounted once: router-cached, coalesced, or routed
+    assert (
+        dist.stats.router_cache.hits + dist.stats.coalesced
+        + dist.stats.routed_seeds == len(trace)
+    )
+    oracle = replay_shard_oracle(dist, model, params, make_full_sampler, feat)
+    for ids, out in results.values():
+        for nid, row in zip(ids, out):
+            assert np.array_equal(row, oracle[int(nid)])
+
+
+def test_repeat_trace_hits_router_cache_without_routing(setup):
+    dist = make_dist(setup, hosts=2)
+    trace = zipfian_trace(N_NODES, 30, alpha=0.99, seed=11)
+    out1 = dist.predict(trace)
+    routed = dist.stats.routed_seeds
+    xbytes = dist.stats.exchange_id_bytes
+    out2 = dist.predict(trace)
+    assert np.array_equal(out1, out2)
+    assert dist.stats.routed_seeds == routed          # nothing re-routed
+    assert dist.stats.exchange_id_bytes == xbytes     # no new wire bytes
+    assert dist.stats.router_cache.hits >= len(trace)
+
+
+# -- params versioning across shards ------------------------------------------
+
+def test_update_params_fences_router_and_all_shards(setup):
+    model, params, feat = setup
+    dist = make_dist(setup, hosts=2)
+    node = 17
+    v0 = dist.predict([node])[0]
+    params2 = jax.tree_util.tree_map(lambda a: a + 0.25, params)
+    dist.update_params(params2)
+    assert dist.params_version == 1
+    assert all(e.params_version == 1 for e in dist.engines.values())
+    assert all(len(e.cache) == 0 for e in dist.engines.values())
+    assert len(dist.cache) == 0
+    v1 = dist.predict([node])[0]
+    assert not np.array_equal(v0, v1)
+    # recomputed result is cached under the new version at BOTH tiers
+    d = dist.stats.routed_seeds
+    v1b = dist.predict([node])[0]
+    assert np.array_equal(v1, v1b) and dist.stats.routed_seeds == d
+
+
+# -- stats aggregation --------------------------------------------------------
+
+def test_aggregate_stats_merges_shard_views(setup):
+    dist = make_dist(setup, hosts=2)
+    trace = zipfian_trace(N_NODES, 40, alpha=0.9, seed=5)
+    dist.predict(trace)
+    agg = dist.aggregate_stats()
+    merged = agg["shards_merged"]
+    per = agg["per_shard"]
+    assert merged["dispatches"] == sum(s["dispatches"] for s in per.values())
+    assert merged["requests"] == sum(s["requests"] for s in per.values())
+    # merged owner-side latency carries every owner-side sample
+    assert merged["latency"]["count"] == sum(
+        s["latency"]["count"] for s in per.values()
+    )
+    # router-side latency saw every request
+    assert agg["router"]["latency"]["count"] == len(trace)
+    assert agg["topology"].keys() == {0, 1}
+    assert 0 < agg["topology"][0]["edge_frac"] <= 1.0
+
+
+def test_flush_error_resolves_waiters_and_reraises(setup):
+    dist = make_dist(setup, hosts=2, exchange="host")
+
+    class Boom(RuntimeError):
+        pass
+
+    def broken(_ids, timeout=None):
+        raise Boom("shard down")
+
+    dist.engines[0].predict = broken
+    h = dist.submit(1)  # node 1 is owned by shard 0
+    with pytest.raises(Boom):
+        dist.flush()
+    with pytest.raises(Boom):
+        h.result(timeout=1)
+    assert not dist._drainable() and not dist._inflight

@@ -124,6 +124,68 @@ def test_hit_rate_counter():
     assert snap == {"hits": 3, "misses": 1, "evictions": 2, "hit_rate": 0.75}
 
 
+def test_latency_histogram_merge_equals_combined_stream():
+    """merge() must be indistinguishable from having recorded both sample
+    streams into one histogram — count/sum/min/max exact, every percentile
+    identical (same buckets -> same bin counts). The cross-shard
+    aggregation contract the distributed serve engine rides."""
+    rng = np.random.default_rng(0)
+    a, b, ref = LatencyHistogram(), LatencyHistogram(), LatencyHistogram()
+    xs = rng.lognormal(1.0, 1.5, 300)
+    ys = rng.lognormal(2.0, 0.5, 200)
+    for x in xs:
+        a.record_ms(x)
+        ref.record_ms(x)
+    for y in ys:
+        b.record_ms(y)
+        ref.record_ms(y)
+    assert a.merge(b) is a  # chains
+    assert a.count == ref.count == 500
+    assert a.sum_ms == pytest.approx(ref.sum_ms)
+    assert a.min_ms == ref.min_ms and a.max_ms == ref.max_ms
+    for p in (0, 25, 50, 95, 99, 100):
+        assert a.percentile(p) == ref.percentile(p)
+    # merging an empty histogram changes nothing (min stays finite-only)
+    before = a.snapshot()
+    a.merge(LatencyHistogram())
+    assert a.snapshot() == before
+    # mismatched bucketization refuses instead of mis-binning
+    with pytest.raises(ValueError):
+        a.merge(LatencyHistogram(growth=1.5))
+    with pytest.raises(TypeError):
+        a.merge(HitRateCounter())
+
+
+def test_hit_rate_counter_merge():
+    a, b = HitRateCounter(), HitRateCounter()
+    a.hit(3)
+    a.miss(1)
+    b.hit(1)
+    b.miss(2)
+    b.evict(4)
+    assert a.merge(b) is a
+    assert (a.hits, a.misses, a.evictions) == (4, 3, 4)
+    assert a.hit_rate == pytest.approx(4 / 7)
+    assert (b.hits, b.misses, b.evictions) == (1, 2, 4)  # source untouched
+    with pytest.raises(TypeError):
+        a.merge(LatencyHistogram())
+
+
+def test_span_recorder_merge_combines_overlap_evidence():
+    from quiver_tpu.trace import SpanRecorder
+
+    a, b = SpanRecorder(), SpanRecorder()
+    a.record("sample", 0.0, 1.0)
+    b.record("forward", 0.5, 1.5)
+    assert a.merge(b) is a
+    assert len(a) == 2 and len(b) == 1
+    ov = a.overlap_summary()
+    assert ov["busy_s"] == {"sample": 1.0, "forward": 1.0}
+    # [0.5, 1.0] of the covered [0, 1.5] wall has both stages active
+    # (summary values are rounded to 4 digits)
+    assert ov["overlap_frac"] == pytest.approx(0.5 / 1.5, abs=1e-4)
+
+
 def test_checkpoint_roundtrip(tmp_path):
     import jax.numpy as jnp
 
