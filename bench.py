@@ -872,6 +872,45 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
         f"{t_forward*1e3:.1f} ms (eval-shaped serve_table inputs)"
     )
 
+    # fused ONE-dispatch step at the same bucket (round 11): the whole
+    # sample+gather+forward as one pre-bound executable — its delta vs the
+    # split sum is the per-flush overhead the 2->1 cut removes (on the
+    # tunnel that is a whole extra RPC floor per flush)
+    try:
+        timer_eng = ServeEngine(
+            model, params, make_sampler(), table,
+            ServeConfig(max_batch=64, buckets=(64,)),
+        )
+        if timer_eng._programs is None:
+            raise TypeError("engine fell back to the split path")
+        timer_eng.warmup()
+        twin = make_sampler()
+        seeds64 = np.arange(64, dtype=np.int64)
+        np.asarray(timer_eng._programs(64, params, twin.next_key(), seeds64))
+        t0 = time.time()
+        for _ in range(10):
+            out = timer_eng._programs(64, params, twin.next_key(), seeds64)
+        np.asarray(out)
+        t_fused = (time.time() - t0) / 10
+        context["serve_path"] = "fused"
+        context["serve_fused_step_s"] = round(t_fused, 6)
+        context["serve_split_minus_fused_s"] = round(
+            max(t_sample + t_forward - t_fused, 0.0), 6
+        )
+        log(
+            f"serve fused one-dispatch @64: {t_fused*1e3:.1f} ms "
+            f"(split sum {(t_sample + t_forward)*1e3:.1f} ms; delta = "
+            "per-flush overhead the 2->1 cut removes)"
+        )
+    except TypeError as exc:
+        # fused path unavailable on this config (tiered table, HOST
+        # sampler): record the path honestly, no fused keys
+        context["serve_path"] = "split"
+        log(f"serve path: split ({exc})")
+    except Exception as exc:
+        context["serve_fused_step_error"] = repr(exc)
+        log(f"serve fused step timing failed: {exc}")
+
     for alpha in (0.0, 0.99):
         for mif in (1, 2):
             eng = ServeEngine(
@@ -918,6 +957,8 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
             context[f"{key}_p99_ms"] = round(lat["p99_ms"], 2)
             context[f"{key}_cache_hit_rate"] = round(s.cache.hit_rate, 4)
             context[f"{key}_dispatches"] = s.dispatches
+            context[f"{key}_execute_calls"] = s.execute_calls
+            context[f"{key}_late_admitted"] = s.late_admitted
             context[f"{key}_coalesced"] = s.coalesced
             ov = s.spans.overlap_summary()
             if mif > 1:

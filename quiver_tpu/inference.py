@@ -184,6 +184,225 @@ def batch_logits(
     return forward_logits(apply, params, feature, ds, ids_out=ids_out)
 
 
+# -- fused one-dispatch serving (ROADMAP item 4a/4b) --------------------------
+
+def draw_sample_key(sampler):
+    """Consume the sampler's next key WITHOUT sampling — the fused serve
+    path draws keys host-side in dispatch-index order (inside the engine's
+    sequencing lock, exactly where `sample_batch` used to run) and defers
+    the sample itself into the one pre-bound device program."""
+    return sampler.next_key()
+
+
+def feature_gather_spec(feature):
+    """``(table, index_map)`` device arrays for an IN-JIT serve gather.
+
+    ``table`` is a dense ``[R, D]`` row table; ``index_map`` is either None
+    (ids index ``table`` directly, clipped) or an ``[N]`` int32 global→row
+    map (clipped after mapping) — the indirection `serve.ClosureFeature`
+    shards ride. Raises TypeError for features whose lookup is host-side by
+    design (tiered `Feature`, `DistFeature`): materializing them onto the
+    device would silently void the capacity contract the tiers exist for,
+    so those engines stay on the split sample/forward path instead."""
+    if isinstance(feature, np.ndarray):
+        if feature.ndim != 2:
+            raise TypeError(f"feature table must be [N, D]; got {feature.shape}")
+        return jnp.asarray(feature), None
+    if isinstance(feature, jax.Array):
+        if feature.ndim != 2:
+            raise TypeError(f"feature table must be [N, D]; got {feature.shape}")
+        return feature, None
+    spec = getattr(feature, "jit_gather_spec", None)
+    if spec is not None:
+        return spec()
+    raise TypeError(
+        f"{type(feature).__name__} has no in-jit gather (host-side lookup "
+        "by design) — the serve engine falls back to the split path"
+    )
+
+
+def make_serve_step(model, sampler):
+    """Build the fused serve step: ONE jittable function running
+    sample + feature gather + forward for a padded seed batch.
+
+    Returns ``(serve_step, graph, id_dtype)`` where ``serve_step(params,
+    key, seeds, table, index_map, graph)`` reproduces
+    `sample_batch` + `forward_logits` bit-for-bit in one program (the
+    bit-parity tests in tests/test_serve.py pin it), ``graph`` is the
+    sampler's device-array pytree (a jit ARGUMENT of every call — big
+    closure constants are the remote-compile trap, NEXT.md), and
+    ``id_dtype`` the seed dtype the program was built for. The sampler's
+    key is an argument too: the ENGINE owns the key stream and draws it in
+    dispatch order (`draw_sample_key`), so fused and split engines consume
+    identical key indices."""
+    from .pyg.sage_sampler import sample_dense_fused, sample_dense_pure
+
+    graph, bind, id_dtype = sampler.fused_sample_spec()
+    sizes, caps, dedup = sampler.sizes, sampler.caps, sampler.dedup
+
+    def serve_step(params, key, seeds, table, index_map, graph):
+        sample_fn = bind(graph)
+        if dedup:
+            ds = sample_dense_pure(
+                None, None, key, seeds, sizes, caps, sample_fn=sample_fn
+            )
+        else:
+            ds = sample_dense_fused(
+                None, None, key, seeds, sizes, sample_fn=sample_fn
+            )
+        n = index_map.shape[0] if index_map is not None else table.shape[0]
+        ids = jnp.clip(ds.n_id, 0, n - 1)
+        if index_map is not None:
+            ids = jnp.clip(jnp.take(index_map, ids), 0, table.shape[0] - 1)
+        x = jnp.take(table, ids, axis=0)
+        return model.apply(params, x, ds.adjs)
+
+    return serve_step, graph, id_dtype
+
+
+# Process-wide cache of compiled serve executables, keyed by everything the
+# lowering depends on (model value, sampler config, graph/table/params
+# AVALS, bucket). Two engines over same-shaped state share one executable —
+# the sharing the jit cache used to provide, kept so per-engine AOT
+# pre-binding doesn't multiply compile time across a test suite or a shard
+# fleet — while each engine still holds its OWN pre-bound table with
+# hard-miss semantics. LRU-bounded: live engines keep direct references to
+# their executables, so eviction only reduces cross-engine sharing, never
+# invalidates a sealed program table.
+import collections as _collections
+import threading as _threading
+
+_SERVE_EXE_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
+_SERVE_EXE_CACHE_MAX = 256
+_SERVE_EXE_LOCK = _threading.Lock()
+
+
+def _aval_spec(tree) -> tuple:
+    return tuple(
+        (tuple(leaf.shape), np.dtype(leaf.dtype).str)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+class BucketPrograms:
+    """AOT pre-bound per-bucket fused serve executables (ROADMAP item 4a —
+    the CUDA-Graphs analog's capture step).
+
+    `compile_bucket` turns the fused `make_serve_step` function into one
+    LOADED executable per bucket via ``jax.jit(...).lower(...).compile()``
+    — held here, not as a jit-cache entry, so a flush is a direct
+    table-lookup + execute with zero trace-cache machinery on the hot path.
+    The per-flush seed buffer is DONATED (``donate_argnums``) so XLA may
+    reuse its device allocation for outputs/scratch; the feature table and
+    graph arrays are NOT donated — they are persistent state every flush
+    re-reads, and donating them would invalidate them after one call.
+
+    `seal()` (called by `ServeEngine.warmup`) flips misses from
+    compile-on-first-use to a HARD RuntimeError: after warmup a retrace or
+    recompile is structurally impossible — a shape the fleet didn't warm is
+    a bug surfaced in milliseconds, not a silent 12–60 s compile eaten by a
+    live request."""
+
+    def __init__(self, model, sampler, feature):
+        self._fn, self._graph, self._id_dtype = make_serve_step(model, sampler)
+        self._sampler = sampler
+        self._caps = sampler.caps  # snapshot the program was built for
+        self._table, self._map = feature_gather_spec(feature)
+        self._jit = jax.jit(self._fn, donate_argnums=(2,))
+        self._exes: dict = {}
+        self._sealed = False
+        try:
+            spec = (
+                model, sampler.sizes, sampler.caps, sampler.dedup,
+                getattr(sampler, "layout", None),
+                getattr(sampler, "weighted", False),
+                np.dtype(self._id_dtype).str,
+                _aval_spec(self._graph),
+                _aval_spec(self._table),
+                None if self._map is None else _aval_spec(self._map),
+            )
+            hash(spec)
+            self._spec = spec
+        except TypeError:  # unhashable custom model: per-engine compiles only
+            self._spec = None
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._exes))
+
+    @property
+    def sealed(self) -> bool:
+        return self._sealed
+
+    def seal(self) -> None:
+        self._sealed = True
+
+    def compile_bucket(self, bucket: int, params) -> None:
+        """Bind (compiling if no same-shaped executable exists anywhere in
+        the process) the executable for ``bucket``."""
+        bucket = int(bucket)
+        if bucket in self._exes:
+            return
+        cache_key = None
+        if self._spec is not None:
+            cache_key = (self._spec, _aval_spec(params), bucket)
+            with _SERVE_EXE_LOCK:
+                exe = _SERVE_EXE_CACHE.get(cache_key)
+                if exe is not None:
+                    _SERVE_EXE_CACHE.move_to_end(cache_key)
+            if exe is not None:
+                self._exes[bucket] = exe
+                return
+        key = jax.random.fold_in(jax.random.key(0), 0)
+        seeds = jnp.zeros((bucket,), self._id_dtype)
+        import warnings
+
+        with warnings.catch_warnings():
+            # the donated seed buffer has no same-shaped output to alias on
+            # every backend; the donation is still declared so backends
+            # that CAN reuse it (and future outputs) do
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            exe = self._jit.lower(
+                params, key, seeds, self._table, self._map, self._graph
+            ).compile()
+        if cache_key is not None:
+            with _SERVE_EXE_LOCK:
+                exe = _SERVE_EXE_CACHE.setdefault(cache_key, exe)
+                _SERVE_EXE_CACHE.move_to_end(cache_key)
+                while len(_SERVE_EXE_CACHE) > _SERVE_EXE_CACHE_MAX:
+                    _SERVE_EXE_CACHE.popitem(last=False)
+        self._exes[bucket] = exe
+
+    def __call__(self, bucket: int, params, key, seeds) -> jax.Array:
+        """ONE execute call: the whole sample+gather+forward for a padded
+        seed batch at ``bucket``. Misses compile lazily before `seal()`,
+        raise RuntimeError after."""
+        if self._sampler.caps != self._caps:
+            # the fused program bakes the caps' static shapes in; sampling
+            # with mutated caps would silently diverge from the split path
+            # and the replay oracle (calibrate_caps after engine build)
+            raise RuntimeError(
+                f"sampler caps changed from {self._caps} to "
+                f"{self._sampler.caps} after the serve programs were built "
+                "— calibrate caps BEFORE constructing the engine"
+            )
+        exe = self._exes.get(int(bucket))
+        if exe is None:
+            if self._sealed:
+                raise RuntimeError(
+                    f"serve bucket {bucket} has no pre-bound executable "
+                    f"(warmed: {self.buckets}) — warmup() seals the program "
+                    "table; a post-warmup miss means the bucket ladder and "
+                    "the warmed shapes disagree"
+                )
+            self.compile_bucket(int(bucket), params)
+            exe = self._exes[int(bucket)]
+        seeds = jnp.asarray(np.asarray(seeds), self._id_dtype)
+        return exe(params, key, seeds, self._table, self._map, self._graph)
+
+
 def time_eval_split(
     apply, params, sampler, feature, padded_batch, iters: int = 10
 ) -> Tuple[float, float]:

@@ -499,6 +499,10 @@ class ServePrediction(NamedTuple):
     shard_bucket: int = 0          # per-shard batch width, ceil(bucket/H)
     exchange_bytes: float = 0.0    # router exchange bytes per routed dispatch
     exchange_s: float = 0.0        # that payload over the DCN link
+    # -- one-vs-two-dispatch fields (round 11; defaults keep older rows
+    # value-identical: zero overhead makes the call count irrelevant) --
+    dispatches_per_flush: int = 1  # 1 = fused serve_step, 2 = split path
+    overhead_s: float = 0.0        # fixed per-execute overhead paid each call
 
 
 def serve_table(
@@ -513,6 +517,8 @@ def serve_table(
     hosts: int = 1,
     out_dim: int = 47,
     bandwidths: Optional[Dict[str, float]] = None,
+    dispatches_per_flush: int = 1,
+    dispatch_overhead_s: float = 0.0,
 ) -> List[ServePrediction]:
     """Analytic QPS model for the online serving engine
     (`quiver_tpu.serve.ServeEngine`) from MEASURED per-batch costs.
@@ -555,17 +561,34 @@ def serve_table(
     training-side exchange. Aggregate QPS then scales ~H-fold until the
     exchange term catches the shrinking dispatch — the crossover this
     table exists to locate before hardware does.
+
+    ``dispatches_per_flush`` x ``dispatch_overhead_s`` is the
+    ONE-vs-TWO-dispatch cost model (round 11): every device execute call
+    pays a fixed overhead that does not shrink with batch (kernel launch,
+    host sync — the measured ~0.06–0.13 s RPC floor through the tunnel).
+    The round-9 split path pays it twice per flush (sample + forward,
+    ``dispatches_per_flush=2``); the fused `inference.serve_step` path
+    pays it once (``=1``, the engine default). With the default zero
+    overhead the rows reduce to the round-10 model exactly; feed the
+    measured floor (or the probe's measured split-minus-fused delta) to
+    price what the 2→1 cut buys at each bucket — the smaller the bucket,
+    the more of its flush time was overhead, so the win concentrates
+    exactly where latency-bound serving lives.
     """
     bw = dict(DEFAULT_BANDWIDTHS)
     if bandwidths:
         bw.update(bandwidths)
     if hosts < 1:
         raise ValueError("hosts must be >= 1")
+    if dispatches_per_flush < 1:
+        raise ValueError("dispatches_per_flush must be >= 1")
     rows: List[ServePrediction] = []
     per_seed = (t_sample_s + t_gather_s + t_forward_s) / max(ref_batch, 1)
     for b in buckets:
         shard_b = -(-b // hosts)
-        t_dispatch = per_seed * shard_b
+        t_dispatch = (
+            per_seed * shard_b + dispatches_per_flush * dispatch_overhead_s
+        )
         if hosts > 1:
             from ..comm import round_up_pow2
 
@@ -596,6 +619,8 @@ def serve_table(
                     shard_bucket=shard_b,
                     exchange_bytes=xbytes,
                     exchange_s=x_s,
+                    dispatches_per_flush=dispatches_per_flush,
+                    overhead_s=dispatch_overhead_s,
                 )
             )
     return rows

@@ -601,6 +601,99 @@ class GraphSageSampler:
         self._call += 1
         return key
 
+    def next_key(self) -> jax.Array:
+        """Consume and return the next key of this sampler's deterministic
+        stream WITHOUT running a sample — key i is exactly the key
+        `sample_dense`'s i-th call would have drawn. The fused serve path
+        (`inference.serve_step`) draws keys host-side in dispatch order and
+        runs the sample itself inside the one pre-bound device program, so
+        the key stream (and any replay of the dispatch log through a twin
+        sampler) stays identical to the split sample/forward path."""
+        if self.mode != "TPU":
+            raise TypeError(
+                "next_key() draws the TPU-mode jax key stream; HOST/CPU "
+                "samplers derive their RNG seed inside sample_dense"
+            )
+        return self._next_key()
+
+    def fused_sample_spec(self):
+        """``(graph, bind, id_dtype)`` for building FUSED in-jit
+        sample+gather+forward programs (`inference.make_serve_step`).
+
+        ``graph`` is the device-array pytree the fused program must take as
+        jit ARGUMENTS — never closure constants: big closure constants are
+        the remote-compile trap (NEXT.md; bit round 5's probe script).
+        ``bind(graph)`` rebuilds the one-hop ``sample_fn`` over the TRACED
+        graph arrays inside the jit, mirroring `_engine()`'s eager
+        closures. Raises TypeError when this sampler cannot be fused
+        (HOST/CPU modes sample host-side; ``auto_grow_caps`` resizes caps
+        mid-stream, which a pre-bound static-shape executable cannot
+        follow)."""
+        if self.mode != "TPU":
+            raise TypeError("fused sampling needs mode='TPU' (device-resident graph)")
+        if self.auto_grow_caps:
+            raise TypeError(
+                "auto_grow_caps resizes caps mid-stream; the fused serve "
+                "program needs static caps (calibrate_caps first, or "
+                "construct with auto_grow_caps=False)"
+            )
+        if self.layout == "tiled":
+            bd, tiles = self.lazy_init_quiver()
+            if self.weighted:
+                wtiles = self.csr_topo.to_device_tiled_weights(self._device_obj())
+                graph = (bd, tiles, wtiles)
+                max_deg = self.max_deg
+
+                def bind(g):
+                    bd, tiles, wtiles = g
+
+                    def sample_fn(cur, cur_valid, k, key):
+                        return _tiled_weighted_sample_layer_op(
+                            bd, tiles, wtiles, cur, cur_valid, k, key, max_deg
+                        )
+
+                    return sample_fn
+            else:
+                graph = (bd, tiles)
+
+                def bind(g):
+                    bd, tiles = g
+
+                    def sample_fn(cur, cur_valid, k, key):
+                        return _tiled_sample_layer_op(bd, tiles, cur, cur_valid, k, key)
+
+                    return sample_fn
+            return graph, bind, tiles.dtype
+        indptr, indices = self.lazy_init_quiver()
+        if self.weighted:
+            if self._w_dev is None:
+                self._w_dev = jnp.asarray(
+                    np.asarray(self.csr_topo.edge_weights, np.float32)
+                )
+            graph = (indptr, indices, self._w_dev)
+            max_deg = self.max_deg
+
+            def bind(g):
+                indptr, indices, w = g
+
+                def sample_fn(cur, cur_valid, k, key):
+                    return _weighted_sample_layer_op(
+                        indptr, indices, w, cur, cur_valid, k, key, max_deg
+                    )
+
+                return sample_fn
+        else:
+            graph = (indptr, indices)
+
+            def bind(g):
+                indptr, indices = g
+
+                def sample_fn(cur, cur_valid, k, key):
+                    return _sample_layer_op(indptr, indices, cur, cur_valid, k, key)
+
+                return sample_fn
+        return graph, bind, indices.dtype
+
     def _weighted_sample_fn(self):
         """sample_fn closure routing one-hop draws through the weighted
         (Gumbel top-k) op; None when this sampler is uniform."""

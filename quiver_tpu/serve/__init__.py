@@ -20,6 +20,7 @@ out, logits back) to per-owner `ServeEngine`s serving from ~1/H topology
 
 from .cache import EmbeddingCache
 from .dist import (
+    ClosureFeature,
     DistServeConfig,
     DistServeEngine,
     DistServeStats,
@@ -37,6 +38,7 @@ from .engine import (
 from .trace_gen import poisson_arrivals, trace_skew_stats, zipfian_trace
 
 __all__ = [
+    "ClosureFeature",
     "DistServeConfig",
     "DistServeEngine",
     "DistServeStats",

@@ -30,7 +30,12 @@ Topology of a request:
    and per-host HBM holds ~1/H of the tables (exact 1/H when the
    partition is k-hop closed, e.g. community partitions; the halo the
    closure adds on other partitions is reported, never hidden — see
-   `shard_topology_by_owner`).
+   `shard_topology_by_owner`). Under the default
+   ``feature_residency="closure"`` each owner materializes its closure's
+   feature rows at build time (`ClosureFeature`) so the whole shard
+   dispatch is the FUSED one-program serve step — one execute call per
+   owner flush; ``"exchange"`` keeps the round-10 per-flush on-demand
+   feature exchange (`DistFeature`) and the split dispatch.
 4. Results **scatter back by request id** and re-interleave into the
    router's dispatch-log order.
 
@@ -88,7 +93,9 @@ def shard_topology_by_owner(
     global2host: np.ndarray,
     host: int,
     hops: int,
-) -> Tuple[CSRTopo, Dict[str, float]]:
+    return_closure: bool = False,
+    closure_hops: Optional[int] = None,
+):
     """Host ``host``'s serving topology shard: the full-id-space CSR with
     adjacency kept ONLY for the ``hops``-hop closure of its owned nodes
     (every other row reads degree 0).
@@ -110,7 +117,13 @@ def shard_topology_by_owner(
     halo is real replication and ``edge_frac`` reports it honestly.
 
     Returns ``(shard_topo, stats)`` with stats keys ``owned_nodes``,
-    ``closure_nodes``, ``edges_kept``, ``edges_total``, ``edge_frac``.
+    ``closure_nodes``, ``edges_kept``, ``edges_total``, ``edge_frac``;
+    with ``return_closure=True``, ``(shard_topo, stats, closure_ids)`` —
+    the sorted global ids of the ``closure_hops``-hop closure (default:
+    ``hops``). `ClosureFeature` wants ``closure_hops = hops + 1``: the
+    final hop's LEAF frontier is feature-gathered but never expanded, so
+    leaves live one hop beyond the adjacency closure — that deeper set is
+    exactly every node a shard engine can ever gather a row for.
     """
     indptr = np.asarray(csr_topo.indptr, np.int64)
     indices = np.asarray(csr_topo.indices, np.int64)
@@ -121,14 +134,19 @@ def shard_topology_by_owner(
     owned = np.nonzero(g2h == host)[0]
     closure = np.zeros(n, bool)
     closure[owned] = True
+    hops = max(int(hops), 0)
+    feat_hops = hops if closure_hops is None else max(int(closure_hops), hops)
     # edge-parallel BFS (vectorized — a per-frontier-node python loop is
     # O(minutes) at products scale): src id per CSR slot built once, each
-    # hop masks the frontier's edges and uniques their endpoints
+    # hop masks the frontier's edges and uniques their endpoints. The
+    # ADJACENCY closure is captured at depth ``hops``; the BFS may continue
+    # to ``closure_hops`` for the returned (feature) closure ids.
     src_per_edge = np.repeat(
         np.arange(n, dtype=np.int64), (indptr[1:] - indptr[:-1])
     )
     frontier_mask = closure.copy()
-    for _ in range(max(int(hops), 0)):
+    topo_closure = closure.copy() if hops == 0 else None
+    for hop in range(feat_hops):
         if not frontier_mask.any():
             break
         nxt = np.unique(indices[frontier_mask[src_per_edge]])
@@ -138,10 +156,14 @@ def shard_topology_by_owner(
         closure[nxt] = True
         frontier_mask = np.zeros(n, bool)
         frontier_mask[nxt] = True
-    deg = np.where(closure, indptr[1:] - indptr[:-1], 0)
+        if hop + 1 == hops:
+            topo_closure = closure.copy()
+    if topo_closure is None:  # BFS exhausted the graph before `hops`
+        topo_closure = closure.copy()
+    deg = np.where(topo_closure, indptr[1:] - indptr[:-1], 0)
     new_indptr = np.zeros(n + 1, np.int64)
     np.cumsum(deg, out=new_indptr[1:])
-    keep_edge = closure[src_per_edge]
+    keep_edge = topo_closure[src_per_edge]
     new_indices = indices[keep_edge]
     new_weights = (
         None
@@ -151,13 +173,16 @@ def shard_topology_by_owner(
     shard = CSRTopo(indptr=new_indptr, indices=new_indices, edge_weights=new_weights)
     stats = {
         "owned_nodes": int(owned.shape[0]),
-        "closure_nodes": int(closure.sum()),
+        "closure_nodes": int(topo_closure.sum()),
+        "feature_closure_nodes": int(closure.sum()),
         "edges_kept": int(new_indices.shape[0]),
         "edges_total": int(indices.shape[0]),
         "edge_frac": (
             float(new_indices.shape[0]) / float(max(indices.shape[0], 1))
         ),
     }
+    if return_closure:
+        return shard, stats, np.nonzero(closure)[0]
     return shard, stats
 
 
@@ -209,6 +234,74 @@ class _ShardFeature:
         return self._dist[ids]
 
 
+class ClosureFeature:
+    """Owner-resident serve features over GLOBAL ids — the fusable shard
+    feature (``feature_residency="closure"``).
+
+    Holds the feature rows of the shard's whole ``hops``-hop closure
+    (owned + halo — exactly the rows the per-flush `DistFeature` exchange
+    would have fetched, materialized ONCE at build time) plus an ``[N]``
+    int32 global→row map, so the owner's gather is a pure in-jit
+    take-of-take and the FUSED one-dispatch serve program applies
+    (`inference.feature_gather_spec` reads `jit_gather_spec`). On a
+    k-hop-closed partition the closure adds nothing and residency is
+    exactly 1/H of the table; elsewhere the halo is real replication,
+    reported in ``shard_topo_stats`` (``closure_nodes`` vs ``owned_nodes``)
+    — never hidden.
+
+    Out-of-closure ids map to -1 and clip to row 0: such lanes are
+    unreachable from owned seeds (the closure IS the sampler's reachable
+    set), so they only ever occur in masked pad lanes the model's
+    aggregation zeroes out — the same guarantee every padded pipeline here
+    rides. Host ``__getitem__`` runs the identical clip/map/clip/take
+    arithmetic, so split-path dispatches and parity replays are
+    value-identical to the fused gather."""
+
+    def __init__(self, rows: np.ndarray, local_map: np.ndarray):
+        self._rows = np.ascontiguousarray(np.asarray(rows, np.float32))
+        self._map = np.asarray(local_map, np.int32)
+        if self._rows.ndim != 2 or self._map.ndim != 1:
+            raise ValueError("ClosureFeature wants rows [C, D] and map [N]")
+        # hosts=1 (closure == everything): the map is the identity, so the
+        # fused gather collapses to the plain-table program — the hosts=1
+        # engine then runs the EXACT executable the single-host engine
+        # runs (bitwise degeneration by construction, and one fewer
+        # compiled program shape)
+        self._identity = self._map.shape[0] == self._rows.shape[0] and bool(
+            np.array_equal(self._map, np.arange(self._map.shape[0], dtype=np.int32))
+        )
+        self._dev: Optional[Tuple] = None
+
+    @property
+    def shape(self):
+        return (self._map.shape[0], self._rows.shape[1])
+
+    @property
+    def dim(self) -> int:
+        return self._rows.shape[1]
+
+    @property
+    def resident_rows(self) -> int:
+        return self._rows.shape[0]
+
+    def jit_gather_spec(self):
+        import jax.numpy as jnp
+
+        if self._dev is None:
+            self._dev = (
+                jnp.asarray(self._rows),
+                None if self._identity else jnp.asarray(self._map),
+            )
+        return self._dev
+
+    def __getitem__(self, n_id):
+        import jax.numpy as jnp
+
+        ids = np.clip(np.asarray(n_id), 0, self._map.shape[0] - 1)
+        loc = np.clip(self._map[ids], 0, self._rows.shape[0] - 1)
+        return jnp.asarray(self._rows[loc])
+
+
 @dataclass
 class DistServeConfig:
     """Router knobs (per-shard engine knobs ride ``shard_config``).
@@ -256,6 +349,19 @@ class DistServeConfig:
                      cached row was computed by a logged dispatch).
     clock          : injectable monotonic clock shared with shard engines.
     record_dispatches : keep the router's (seeds, per-owner split) log.
+    feature_residency : "closure" (default) materializes each owner's
+                     feature rows for its whole k-hop closure at BUILD time
+                     (`ClosureFeature`: the rows the per-flush DistFeature
+                     exchange would have fetched, fetched once), making the
+                     owner gather in-jit so shard engines run the FUSED
+                     one-dispatch serve program; "exchange" keeps the
+                     round-10 on-demand feature exchange (owned rows local,
+                     halo rows over the wire per flush — shard engines then
+                     serve on the split path). Value-identical; residency
+                     trades halo-row memory for per-flush exchange work.
+    late_admission : admit late-arriving seeds into a routed flush that is
+                     assembled but still waiting for a window slot (up to
+                     ``max_batch``), mirroring `ServeConfig.late_admission`.
     """
 
     hosts: int = 2
@@ -270,6 +376,8 @@ class DistServeConfig:
     clock: Callable[[], float] = time.monotonic
     flush_poll_ms: float = 0.2
     record_dispatches: bool = False
+    feature_residency: str = "closure"
+    late_admission: bool = True
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -281,6 +389,7 @@ class DistServeConfig:
             cache_entries=self.cache_entries,
             clock=self.clock,
             record_dispatches=self.record_dispatches,
+            late_admission=self.late_admission,
         )
 
 
@@ -297,6 +406,7 @@ class DistServeStats:
     coalesced: int = 0
     router_dispatches: int = 0
     routed_seeds: int = 0
+    late_admitted: int = 0
     inflight_peak: int = 0
     sub_batches: Dict[int, int] = field(default_factory=dict)
     sub_batch_seeds: Dict[int, int] = field(default_factory=dict)
@@ -319,6 +429,7 @@ class DistServeStats:
             "coalesced": self.coalesced,
             "router_dispatches": self.router_dispatches,
             "routed_seeds": self.routed_seeds,
+            "late_admitted": self.late_admitted,
             "inflight_peak": self.inflight_peak,
             "sub_batches": dict(self.sub_batches),
             "mean_sub_batch_width": self.mean_sub_batch_width(),
@@ -331,14 +442,18 @@ class DistServeStats:
 
 
 class _RoutedFlush:
-    """Per-flush router state between assemble and resolve."""
+    """Per-flush router state between assemble and resolve. ``bucket`` is
+    the admission cap (the router pads nothing, so its "pad slack" is the
+    drained width up to ``max_batch``); the owner split is computed at SEAL
+    time so late-admitted seeds route with their flush."""
 
-    __slots__ = ("keys", "slots", "split", "error")
+    __slots__ = ("keys", "slots", "split", "bucket", "error")
 
     def __init__(self, keys, slots, split):
         self.keys = keys
         self.slots = slots
         self.split = split  # [(host, ids ndarray, positions ndarray)]
+        self.bucket = 0
         self.error: Optional[BaseException] = None
 
 
@@ -397,6 +512,7 @@ class DistServeEngine:
         self.dispatch_log: List[Tuple[np.ndarray, List[Tuple[int, np.ndarray]]]] = []
         self._pending: Dict[int, _Slot] = {}
         self._inflight: Dict[int, _Slot] = {}
+        self._open: Optional[_RoutedFlush] = None
         self._lock = threading.Lock()
         self._fence = threading.Condition(self._lock)
         self._seq = threading.Lock()
@@ -469,9 +585,12 @@ class DistServeEngine:
             comm = TpuComm(
                 rank=0, world_size=hosts, hosts=hosts, mesh=mesh, axis="serve_host"
             )
-        # feature-exchange budget: a shard forward gathers up to the final
-        # padded n_id width of the largest bucket, all of which could be
-        # remote in the worst case
+        residency = config.feature_residency
+        if residency not in ("closure", "exchange"):
+            raise ValueError(f"unknown feature_residency {residency!r}")
+        # feature-exchange budget ("exchange" residency only): a shard
+        # forward gathers up to the final padded n_id width of the largest
+        # bucket, all of which could be remote in the worst case
         from ..ops.sample import pad_widths
 
         shard_cfg = config.resolved_shard_config()
@@ -483,28 +602,47 @@ class DistServeEngine:
         engines: Dict[int, ServeEngine] = {}
         topo_stats: Dict[int, Dict[str, float]] = {}
         for h in range(hosts):
-            topo_h, st = shard_topology_by_owner(
-                csr_topo, global2host, h, hops=len(sizes) - 1
+            # adjacency closure: len(sizes)-1 expansion hops; FEATURE
+            # closure one deeper — the last hop's leaves are gathered but
+            # never expanded (shard_topology_by_owner docstring)
+            topo_h, st, closure_ids = shard_topology_by_owner(
+                csr_topo, global2host, h, hops=len(sizes) - 1,
+                return_closure=True, closure_hops=len(sizes),
             )
             topo_stats[h] = st
             sampler = GraphSageSampler(
                 topo_h, sizes=sizes, mode=sampler_mode, seed=sampler_seed, **kw
             )
-            owned = np.nonzero(global2host == h)[0]
-            f = Feature(rank=0, device_list=[0], device_cache_size=0)
-            f.from_cpu_tensor(feat[owned])
-            f.set_local_order(owned)
-            if mode == "collective":
-                fcomm = TpuComm(
-                    rank=h, world_size=hosts, hosts=hosts, mesh=mesh,
-                    axis="serve_host",
+            if residency == "closure":
+                # materialize the closure's rows ONCE (the rows the
+                # per-flush exchange would fetch) — the owner gather is
+                # then in-jit, so the shard engine serves on the FUSED
+                # one-dispatch program; residency is honest: closure ==
+                # owned (exactly 1/H) on k-hop-closed partitions, the halo
+                # elsewhere is already reported in topo_stats
+                local_map = np.full(n, -1, np.int32)
+                local_map[closure_ids] = np.arange(
+                    closure_ids.shape[0], dtype=np.int32
                 )
-                fcomm.static_budget = feat_budget
+                shard_feat = ClosureFeature(feat[closure_ids], local_map)
             else:
-                fcomm = LoopbackComm(hosts)
-            feat_comms.append(fcomm)
-            info = PartitionInfo(device=0, host=h, hosts=hosts, global2host=global2host)
-            shard_feat = _ShardFeature(DistFeature(f, info, fcomm), n)
+                owned = np.nonzero(global2host == h)[0]
+                f = Feature(rank=0, device_list=[0], device_cache_size=0)
+                f.from_cpu_tensor(feat[owned])
+                f.set_local_order(owned)
+                if mode == "collective":
+                    fcomm = TpuComm(
+                        rank=h, world_size=hosts, hosts=hosts, mesh=mesh,
+                        axis="serve_host",
+                    )
+                    fcomm.static_budget = feat_budget
+                else:
+                    fcomm = LoopbackComm(hosts)
+                feat_comms.append(fcomm)
+                info = PartitionInfo(
+                    device=0, host=h, hosts=hosts, global2host=global2host
+                )
+                shard_feat = _ShardFeature(DistFeature(f, info, fcomm), n)
             engines[h] = ServeEngine(model, params, sampler, shard_feat, shard_cfg)
         # single-controller mode: every feature comm holds every block (a
         # real pod registers only its own — the 1/H HBM claim is about the
@@ -566,7 +704,16 @@ class DistServeEngine:
                 self.stats.coalesced += 1
             else:
                 slot = _Slot(key, self.params_version, now)
-                self._pending[key] = slot
+                fl = self._open
+                if fl is not None and len(fl.keys) < fl.bucket:
+                    # late admission into the routed flush still waiting
+                    # for its window slot (owner split happens at seal)
+                    fl.keys.append(key)
+                    fl.slots.append(slot)
+                    self._inflight[key] = slot
+                    self.stats.late_admitted += 1
+                else:
+                    self._pending[key] = slot
             slot.waiters.append(now)
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
@@ -600,19 +747,30 @@ class DistServeEngine:
     # -- the three router stages ------------------------------------------
 
     def _assemble(self) -> Optional[_RoutedFlush]:
+        """Drain + publish (mirrors `ServeEngine._assemble`): the owner
+        split waits for `_seal_assembled` so late-admitted seeds route with
+        their flush."""
         with self._lock:
             if not self._pending:
                 return None
             keys = list(self._pending)[: self.config.max_batch]
             slots = [self._pending.pop(k) for k in keys]
             self._inflight.update(zip(keys, slots))
+            fl = _RoutedFlush(keys, slots, [])
+            fl.bucket = self.config.max_batch
             self._inflight_flushes += 1
             self.stats.inflight_peak = max(
                 self.stats.inflight_peak, self._inflight_flushes
             )
-        fl = _RoutedFlush(keys, slots, [])
+            if self.config.late_admission and len(keys) < fl.bucket:
+                self._open = fl
+        return fl
+
+    def _seal_assembled(self, fl: _RoutedFlush) -> None:
+        with self._lock:
+            self._open = None
         try:
-            arr = np.asarray(keys, np.int64)
+            arr = np.asarray(fl.keys, np.int64)
             owners = self.global2host[arr]
             for h in range(self.hosts):
                 pos = np.nonzero(owners == h)[0]
@@ -624,7 +782,6 @@ class DistServeEngine:
                 )
         except BaseException as exc:
             fl.error = exc
-        return fl
 
     def _dispatch(self, fl: _RoutedFlush) -> Optional[np.ndarray]:
         """Forward the per-owner sub-batches and re-interleave the answers
@@ -684,17 +841,33 @@ class DistServeEngine:
         on the calling thread; up to ``max_in_flight`` concurrent callers
         overlap (the router's assemble/split is serialized in dispatch
         order under ``_seq``, so the router log — and through it every
-        shard's key stream — stays deterministic)."""
-        self._window.acquire()
+        shard's key stream — stays deterministic). As in
+        `ServeEngine.flush`, the window permit is taken under ``_seq``
+        AFTER the drain, so seeds arriving while this flush waits for a
+        slot join it (late admission) before the owner split is sealed."""
         fl = None
+        have_permit = False
         try:
             with self._seq:
                 t0 = self._clock()
                 fl = self._assemble()
                 if fl is not None:
                     self.stats.spans.record("assemble", t0, self._clock())
-            if fl is None:
-                return 0
+                if fl is None:
+                    return 0
+                try:
+                    self._window.acquire()
+                    have_permit = True
+                    t0 = self._clock()
+                    self._seal_assembled(fl)
+                    self.stats.spans.record("assemble", t0, self._clock())
+                finally:
+                    # _seal_assembled's first act already closed admission
+                    # (it MUST happen under _lock before the key draw);
+                    # this repeat only covers an interrupt landing between
+                    # the window acquire and the seal
+                    with self._lock:
+                        self._open = None
             rows = None
             if fl.error is None:
                 t0 = self._clock()
@@ -708,7 +881,8 @@ class DistServeEngine:
                 raise fl.error
             return len(fl.keys)
         finally:
-            self._window.release()
+            if have_permit:
+                self._window.release()
 
     def _drainable(self) -> bool:
         with self._lock:

@@ -24,26 +24,41 @@ work with three levers, applied in order of cheapness:
    ``max_batch`` by default). Fixed buckets mean one compiled program per
    bucket serves all traffic — no per-request recompiles, ever.
 
-The device path is the exact `sampled_eval` inner step split in two
-(`inference.sample_batch` + `inference.forward_logits` ==
-`inference.batch_logits`: same sampler stream, same pad convention, same
-lookup, same cached jitted apply). That shared path is what makes served
-logits BIT-IDENTICAL to offline eval on the same (sampler state, batch)
-pair; the parity test replays the engine's dispatch log through a fresh
-sampler and compares exactly (tests/test_serve.py).
+The device path comes in two BIT-IDENTICAL flavors. The **fused
+one-dispatch path** (round 11, the default wherever the sampler/feature
+pair supports it — see ``ServeConfig.dispatch_mode``) runs
+sample + gather + forward as ONE pre-bound AOT executable per bucket
+(`inference.make_serve_step` / `inference.BucketPrograms`): a flush costs
+one execute call, `warmup()` compiles-and-seals the program table so a
+retrace after warmup is structurally impossible (miss = hard error), and
+the per-flush seed buffer is donated. The **split path** is the exact
+`sampled_eval` inner step split in two (`inference.sample_batch` +
+`inference.forward_logits` == `inference.batch_logits`) and survives for
+offline eval, cost attribution, and features that must gather host-side
+(tiered `Feature`). Both consume the same sampler key stream, which is
+what makes served logits BIT-IDENTICAL to offline eval on the same
+(sampler state, batch) pair; the parity tests replay the engine's dispatch
+log through a fresh sampler and compare exactly (tests/test_serve.py).
 
-**Pipelined dispatch (round 9).** A flush runs three stages:
+**Pipelined dispatch (round 9) + late admission (round 11).** A flush runs
+three stages:
 
-- **assemble** — drain up to ``max_batch`` pending slots, pad to the
-  bucket, append the dispatch log entry, and draw the sampler's next key
-  (`sample_batch`). Serialized under a small sequencing lock and stamped
-  with a monotonic dispatch index, so the sampler's key stream and the
-  replay log are identical IN DISPATCH ORDER no matter how many flushes
-  are in flight (``dispatch_log[i]`` is the i-th assemble and consumed the
-  sampler's i-th call — the determinism contract the parity replay rides).
-- **dispatch** — the device forward (`forward_logits`) + the blocking D2H.
-  Runs OUTSIDE the sequencing lock, so the next flush assembles (and the
-  host batches/coalesces) while the device executes this one.
+- **assemble** — drain up to ``max_batch`` pending slots and fix the
+  bucket; then, with the drained flush PUBLISHED for late admission,
+  take an in-flight window permit (while the flush waits for a slot,
+  `submit` keeps admitting new seeds into its pad lanes — continuous
+  seed-level batching, recovering slack that round 8–10 computed and
+  discarded); finally SEAL: close admission, draw the monotonic dispatch
+  index, append the dispatch-log entry, and consume the sampler's next
+  key. The whole stage is serialized under a small sequencing lock, so the
+  sampler's key stream and the replay log are identical IN DISPATCH ORDER
+  no matter how many flushes are in flight or how admissions interleave
+  (``dispatch_log[i]`` is the i-th seal and consumed the sampler's i-th
+  call — the determinism contract the parity replay rides).
+- **dispatch** — the device work + the blocking D2H: one pre-bound
+  execute on the fused path, `forward_logits` on the split path. Runs
+  OUTSIDE the sequencing lock, so the next flush assembles (and the host
+  batches/coalesces) while the device executes this one.
 - **resolve** — unpad, cache writeback (version-checked), per-flush slot
   resolution, latency/stat accounting. Completions may land out of
   dispatch order; each flush resolves only its OWN slots, so ordering
@@ -64,10 +79,11 @@ the same honest way the tiered training pipeline reports it
 flush, then swaps the weights and bumps the version — so no served logit is
 ever computed from a params tree that changed under it mid-flush, and no
 two in-flight flushes ever straddle a version (which also keeps the
-in-flight coalescing map collision-free). `warmup()` pre-traces every
-bucket's compiled program (through a twin sampler when the sampler supports
-cloning, so the serving key stream is untouched) so first-request latency
-doesn't eat a compile.
+in-flight coalescing map collision-free). `warmup()` pre-binds every
+bucket's executable (fused: AOT lower+compile, zero keys consumed, then
+SEALED — a later miss is a hard error; split: one warm dispatch through a
+twin sampler where supported) so first-request latency doesn't eat a
+compile.
 """
 
 from __future__ import annotations
@@ -79,7 +95,14 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..inference import _cached_apply, forward_logits, pad_seed_batch, sample_batch
+from ..inference import (
+    BucketPrograms,
+    _cached_apply,
+    draw_sample_key,
+    forward_logits,
+    pad_seed_batch,
+    sample_batch,
+)
 from ..trace import HitRateCounter, LatencyHistogram, SpanRecorder
 from .cache import EmbeddingCache
 
@@ -128,6 +151,26 @@ class ServeConfig:
                      for parity replay/debugging (off by default: it grows
                      with traffic). Log order == dispatch-index order ==
                      sampler key-stream order, even with in-flight > 1.
+    dispatch_mode  : "auto" (default) serves through the FUSED one-program
+                     path (`inference.make_serve_step` + AOT-pre-bound
+                     `BucketPrograms`) whenever the sampler and feature
+                     support it (TPU-mode sampler, dense in-jit-gatherable
+                     feature — `inference.feature_gather_spec`), falling
+                     back to the split sample/forward path otherwise
+                     (tiered `Feature`, HOST/CPU samplers). "fused" makes
+                     that fallback a construction-time error; "split"
+                     forces the round-9 two-dispatch path (baselines,
+                     features that must gather host-side). Fused and split
+                     serve BIT-IDENTICAL logits on the same key stream.
+    late_admission : admit seeds submitted AFTER a flush assembled into
+                     that flush's pad lanes, up to its bucket, while it
+                     waits for an in-flight window slot — continuous
+                     seed-level batching: the pad slack was computed-and-
+                     discarded waste, now it retires real requests.
+                     Admission closes before the dispatch index and the
+                     sampler key are drawn, so the dispatch log and key
+                     stream stay deterministic and replayable
+                     (``stats.late_admitted`` counts recovered lanes).
     """
 
     max_batch: int = 64
@@ -138,6 +181,8 @@ class ServeConfig:
     clock: Callable[[], float] = time.monotonic
     flush_poll_ms: float = 0.2
     record_dispatches: bool = False
+    dispatch_mode: str = "auto"
+    late_admission: bool = True
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -209,16 +254,31 @@ class ServeStats:
     ``dispatches`` is the number of device batches actually launched —
     the acceptance metric "dispatch count < N" reads this.
     ``inflight_peak`` is the largest number of flushes observed between
-    assemble and resolve at once (<= config.max_in_flight; > 1 is direct
-    evidence the window was used). ``spans`` records per-stage
+    assemble and resolve at once (> 1 is direct evidence the window was
+    used; bounded by ``max_in_flight + 1`` — a drained flush waiting for
+    its window permit, i.e. the one admitting late seeds, is between
+    assemble and resolve too). ``spans`` records per-stage
     (assemble/dispatch/resolve) spans on the engine's clock —
-    ``spans.overlap_summary()`` is the measured-overlap evidence."""
+    ``spans.overlap_summary()`` is the measured-overlap evidence.
+
+    ``dispatch_calls`` counts dispatch-STAGE entries (including ones that
+    errored; ``dispatches`` counts only resolved successes) and
+    ``execute_calls`` the device program legs those stages ran — 1 per
+    flush on the fused one-program path, 2 on the split path (the round-9
+    sample + forward ledger; the split sample leg is itself op-by-op
+    eager dispatch, so 2 is that ledger's floor, not an op count). The 2→1
+    dispatch claim is OBSERVABLE as ``execute_calls == dispatches`` on a
+    fused engine, not inferred. ``late_admitted`` counts seeds admitted
+    into an assembled flush's pad lanes (recovered bucket slack)."""
 
     requests: int = 0
     coalesced: int = 0
     dispatches: int = 0
     dispatched_seeds: int = 0   # unique seeds sent to the device
     padded_seeds: int = 0       # bucket slack rows computed and discarded
+    dispatch_calls: int = 0
+    execute_calls: int = 0
+    late_admitted: int = 0
     inflight_peak: int = 0
     dispatch_buckets: Dict[int, int] = field(default_factory=dict)
     cache: HitRateCounter = field(default_factory=HitRateCounter)
@@ -244,6 +304,9 @@ class ServeStats:
         self.dispatches += other.dispatches
         self.dispatched_seeds += other.dispatched_seeds
         self.padded_seeds += other.padded_seeds
+        self.dispatch_calls += other.dispatch_calls
+        self.execute_calls += other.execute_calls
+        self.late_admitted += other.late_admitted
         self.inflight_peak = max(self.inflight_peak, other.inflight_peak)
         for b, n in other.dispatch_buckets.copy().items():
             self.dispatch_buckets[b] = self.dispatch_buckets.get(b, 0) + n
@@ -259,6 +322,9 @@ class ServeStats:
             "dispatches": self.dispatches,
             "dispatched_seeds": self.dispatched_seeds,
             "padded_seeds": self.padded_seeds,
+            "dispatch_calls": self.dispatch_calls,
+            "execute_calls": self.execute_calls,
+            "late_admitted": self.late_admitted,
             "inflight_peak": self.inflight_peak,
             "dispatch_buckets": dict(self.dispatch_buckets),
             "cache": self.cache.snapshot(),
@@ -271,9 +337,14 @@ class _Flush:
     """Per-flush state between assemble and resolve: the drained slots and
     the params snapshot the dispatch will run under. Dispatch ORDER is not
     carried here — it is the log-append/key-draw order the sequencing lock
-    imposes (`ServeEngine._dispatch_index` counts it)."""
+    imposes (`ServeEngine._dispatch_index` counts it). ``bucket`` is fixed
+    at drain time; late admission may append to ``keys``/``slots`` up to it
+    until `_seal_assembled` closes the flush. The fused path carries the
+    drawn sampler ``key`` + the ``padded`` seed batch into its one-program
+    dispatch; the split path carries the pre-run sample ``ds``."""
 
-    __slots__ = ("keys", "slots", "params", "seeds", "bucket", "ds", "error")
+    __slots__ = ("keys", "slots", "params", "seeds", "bucket", "ds", "key",
+                 "padded", "error")
 
     def __init__(self, keys, slots, params):
         self.keys = keys
@@ -282,6 +353,8 @@ class _Flush:
         self.seeds = None
         self.bucket = 0
         self.ds = None
+        self.key = None
+        self.padded = None
         self.error: Optional[BaseException] = None
 
 
@@ -307,11 +380,27 @@ class ServeEngine:
         self.config = config or ServeConfig()
         if self.config.max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1")
+        if self.config.dispatch_mode not in ("auto", "fused", "split"):
+            raise ValueError(
+                f"unknown dispatch_mode {self.config.dispatch_mode!r}"
+            )
         self._buckets = self.config.resolved_buckets()
         self._apply = _cached_apply(model)
         self._params = params
         self._sampler = sampler
         self._feature = feature
+        # fused one-dispatch path: one pre-bindable program per bucket when
+        # the sampler/feature pair supports it (see ServeConfig.dispatch_mode)
+        self._programs: Optional[BucketPrograms] = None
+        if self.config.dispatch_mode != "split":
+            try:
+                self._programs = BucketPrograms(model, sampler, feature)
+            except (TypeError, AttributeError) as exc:
+                if self.config.dispatch_mode == "fused":
+                    raise ValueError(
+                        f"dispatch_mode='fused' but the serve step cannot "
+                        f"fuse: {exc}"
+                    ) from exc
         self._clock = self.config.clock
         self.stats = ServeStats()
         self.cache = EmbeddingCache(self.config.cache_entries,
@@ -322,6 +411,9 @@ class ServeEngine:
         # = FIFO), _inflight slots snapshot-ed by a running flush
         self._pending: "Dict[int, _Slot]" = {}
         self._inflight: Dict[int, _Slot] = {}
+        # the assembled-but-not-yet-sealed flush accepting late admissions
+        # (guarded by _lock; non-None only while its flusher holds _seq)
+        self._open: Optional[_Flush] = None
         self._lock = threading.Lock()          # queue + cache-version state
         # fence condition over _lock: update_params waits here for every
         # in-flight flush to resolve before swapping the weights
@@ -343,10 +435,13 @@ class ServeEngine:
 
     def submit(self, node_id: int) -> ServeResult:
         """Enqueue one node-prediction request; returns a handle. Fills of
-        ``max_batch`` flush inline on the submitting thread. KEEP IN
-        LOCKSTEP with `DistServeEngine.submit` (serve/dist.py): the
-        distributed router's hosts=1 bit-parity contract rides this exact
-        cache-check/coalesce/flush-at-fill sequence."""
+        ``max_batch`` flush inline on the submitting thread. A seed
+        arriving while a flush sits assembled-but-not-yet-dispatched (late
+        admission enabled, pad slack left) rides that flush's pad lanes
+        instead of waiting a whole extra flush. KEEP IN LOCKSTEP with
+        `DistServeEngine.submit` (serve/dist.py): the distributed router's
+        hosts=1 bit-parity contract rides this exact
+        cache-check/coalesce/admit/flush-at-fill sequence."""
         key = int(node_id)
         now = self._clock()
         need_flush = False
@@ -361,7 +456,17 @@ class ServeEngine:
                 self.stats.coalesced += 1
             else:
                 slot = _Slot(key, self.params_version, now)
-                self._pending[key] = slot
+                fl = self._open
+                if fl is not None and len(fl.keys) < fl.bucket:
+                    # late admission into the open flush's pad slack (its
+                    # update_params fence guarantees the versions agree:
+                    # _open only exists while its flusher holds _seq)
+                    fl.keys.append(key)
+                    fl.slots.append(slot)
+                    self._inflight[key] = slot
+                    self.stats.late_admitted += 1
+                else:
+                    self._pending[key] = slot
             slot.waiters.append(now)
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
@@ -402,10 +507,11 @@ class ServeEngine:
     # -- the three flush stages -------------------------------------------
 
     def _assemble(self) -> Optional[_Flush]:
-        """Stage 1 (caller must hold a window permit and ``_seq``): drain
-        up to ``max_batch`` pending slots, pad to the bucket, log the
-        dispatch, and draw the sampler's next key. Everything that must be
-        ordered by dispatch index happens here."""
+        """Stage 1a (caller must hold ``_seq``): drain up to ``max_batch``
+        pending slots into a new flush, fix its bucket, and — when late
+        admission is on and the bucket left pad slack — PUBLISH it so
+        `submit` can fill the slack until `_seal_assembled` closes it
+        (typically while this flush waits for an in-flight window slot)."""
         with self._lock:
             if not self._pending:
                 return None
@@ -416,14 +522,27 @@ class ServeEngine:
             # swap lands while this flush is in flight, so the snapshot and
             # every drained slot's version agree
             fl = _Flush(keys, slots, self._params)
+            fl.bucket = self._bucket_for(len(keys))
             self._inflight_flushes += 1
             self.stats.inflight_peak = max(
                 self.stats.inflight_peak, self._inflight_flushes
             )
+            if self.config.late_admission and len(keys) < fl.bucket:
+                self._open = fl
+        return fl
+
+    def _seal_assembled(self, fl: _Flush) -> None:
+        """Stage 1b (caller holds ``_seq`` and a window permit): close late
+        admission, then draw the dispatch index, append the dispatch-log
+        entry, and consume the sampler's next key. Everything that must be
+        ordered by dispatch index happens HERE — admitted seeds are already
+        in ``fl.keys``, so the log and the key stream see the final batch
+        composition exactly once."""
+        with self._lock:
+            self._open = None
         self._dispatch_index += 1
         try:
-            fl.seeds = np.asarray(keys, dtype=np.int64)
-            fl.bucket = self._bucket_for(len(keys))
+            fl.seeds = np.asarray(fl.keys, dtype=np.int64)
             if self.config.max_in_flight == 1:
                 # serial mode: reuse one pad buffer per bucket (round-8
                 # behavior); with in-flight > 1 each flush owns its buffer
@@ -433,18 +552,36 @@ class ServeEngine:
             else:
                 padded = pad_seed_batch(fl.seeds, fl.bucket)
             if self.config.record_dispatches:
-                self.dispatch_log.append((padded.copy(), len(keys)))
-            fl.ds = sample_batch(self._sampler, padded)
+                self.dispatch_log.append((padded.copy(), len(fl.keys)))
+            if self._programs is not None:
+                # fused path: draw the key in dispatch order, defer the
+                # sample into the one-program dispatch stage
+                fl.key = draw_sample_key(self._sampler)
+                fl.padded = padded
+            else:
+                fl.ds = sample_batch(self._sampler, padded)
         except BaseException as exc:  # resolved (with the error) by stage 3
             fl.error = exc
-        return fl
 
     def _dispatch(self, fl: _Flush) -> Optional[np.ndarray]:
-        """Stage 2 (no engine lock held): the device forward + blocking
-        D2H. Concurrent across flushes up to the window bound."""
-        logits = np.asarray(
-            forward_logits(self._apply, fl.params, self._feature, fl.ds)
-        )
+        """Stage 2 (no engine lock held): the device work + blocking D2H —
+        ONE pre-bound execute call on the fused path, the round-9
+        sample(-in-assemble) + forward pair on the split path. Concurrent
+        across flushes up to the window bound."""
+        with self._lock:
+            self.stats.dispatch_calls += 1
+        if fl.ds is None and self._programs is not None:
+            logits = np.asarray(
+                self._programs(fl.bucket, fl.params, fl.key, fl.padded)
+            )
+            n_exec = 1
+        else:
+            logits = np.asarray(
+                forward_logits(self._apply, fl.params, self._feature, fl.ds)
+            )
+            n_exec = 2  # the sample leg ran in _seal_assembled
+        with self._lock:
+            self.stats.execute_calls += n_exec
         # rows of this array are handed to every waiter AND the cache;
         # read-only makes an in-place mutation by one caller a loud
         # ValueError instead of silently corrupting every later cache hit
@@ -487,26 +624,45 @@ class ServeEngine:
     def flush(self) -> int:
         """Dispatch up to ``max_batch`` pending unique seeds as one bucket-
         padded device batch NOW (policy bypassed). Returns the number of
-        unique seeds dispatched.
+        unique seeds dispatched (late-admitted ones included).
 
         Synchronous: assemble -> dispatch -> resolve run on the calling
         thread, and any stage error re-raises here (after resolving every
         drained slot with it). Pipelining comes from concurrent callers —
         up to ``max_in_flight`` flushes may overlap, with assembles (and
-        the sampler key stream) serialized in dispatch order."""
-        self._window.acquire()
+        the sampler key stream) serialized in dispatch order. The in-flight
+        window permit is taken UNDER the sequencing lock, AFTER the drain:
+        while a flush waits for a slot (device saturated), late-arriving
+        seeds join its pad lanes; admission closes in `_seal_assembled`
+        before the dispatch index and sampler key are drawn, so the log and
+        key stream stay deterministic at any admission interleaving."""
         fl = None
+        have_permit = False
         try:
             with self._seq:
-                # the span opens AFTER _seq is held: a caller blocked
-                # behind another flush's assemble is idle, not working,
-                # and counting the wait would fake stage overlap
+                # spans open AFTER _seq is held, and the window wait is
+                # excluded: a caller blocked behind another flush (or a
+                # full window) is idle, not working, and counting the wait
+                # would fake stage overlap
                 t0 = self._clock()
                 fl = self._assemble()
                 if fl is not None:
                     self.stats.spans.record("assemble", t0, self._clock())
-            if fl is None:
-                return 0
+                if fl is None:
+                    return 0
+                try:
+                    self._window.acquire()
+                    have_permit = True
+                    t0 = self._clock()
+                    self._seal_assembled(fl)  # errors land in fl.error
+                    self.stats.spans.record("assemble", t0, self._clock())
+                finally:
+                    # _seal_assembled's first act already closed admission
+                    # (it MUST happen under _lock before the key draw);
+                    # this repeat only covers an interrupt landing between
+                    # the window acquire and the seal
+                    with self._lock:
+                        self._open = None
             logits = None
             if fl.error is None:
                 t0 = self._clock()
@@ -520,7 +676,8 @@ class ServeEngine:
                 raise fl.error
             return len(fl.keys)
         finally:
-            self._window.release()
+            if have_permit:
+                self._window.release()
 
     def _bucket_for(self, n: int) -> int:
         for b in self._buckets:
@@ -557,22 +714,35 @@ class ServeEngine:
             return None
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> Dict[int, float]:
-        """Pre-trace the compiled program for every bucket shape so the
-        first REAL request at each bucket doesn't eat a compile. Returns
-        {bucket: seconds} (wall time per warm dispatch — compile time on
-        first call, execution time thereafter).
+        """Bind the compiled program for every bucket shape so the first
+        REAL request at each bucket doesn't eat a compile. Returns
+        {bucket: seconds}.
 
-        Uses a twin sampler when available (key stream untouched);
-        otherwise runs through the serving sampler under the sequencing
-        lock and appends an ``n_valid=0`` entry to the dispatch log, so a
+        Fused engines AOT-compile one LOADED executable per bucket
+        (``jax.jit(...).lower(...).compile()`` via
+        `inference.BucketPrograms`) — no jit cache warmed, no dispatch
+        executed, NO key consumed (lowering traces abstract values only) —
+        and then SEAL the program table: a post-warmup bucket miss raises
+        RuntimeError instead of silently compiling for 12–60 s under a live
+        request. Split engines keep the round-9 behavior: one warm dispatch
+        per bucket through a twin sampler when the sampler supports cloning
+        (key stream untouched); otherwise through the serving sampler under
+        the sequencing lock with an ``n_valid=0`` dispatch-log entry, so a
         parity replay still consumes the same key indices."""
         buckets = self._buckets if buckets is None else tuple(
             sorted(int(b) for b in buckets)
         )
-        twin = self._warmup_sampler()
         with self._lock:
             params = self._params
         times: Dict[int, float] = {}
+        if self._programs is not None:
+            for b in buckets:
+                t0 = time.perf_counter()
+                self._programs.compile_bucket(b, params)
+                times[b] = time.perf_counter() - t0
+            self._programs.seal()
+            return times
+        twin = self._warmup_sampler()
         for b in buckets:
             padded = np.zeros(b, np.int64)
             t0 = time.perf_counter()

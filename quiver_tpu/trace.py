@@ -107,6 +107,26 @@ def print_trace_report() -> None:
 
 # -- benchmark metric helpers -------------------------------------------------
 
+def median_min_max(values) -> Dict[str, float]:
+    """``{"median", "min", "max", "n"}`` of a numeric sequence — the
+    repeated-run summary probe scripts report. Single-run numbers on a
+    noisy shared box flip run to run (NEXT.md operational reminders), so
+    the honest headline is the median of N repeats WITH the spread next to
+    it; a probe that prints one number is reporting noise. Median of an
+    even count is the mean of the two middle values."""
+    import statistics
+
+    vals = [float(v) for v in values]
+    if not vals:
+        raise ValueError("median_min_max needs at least one value")
+    return {
+        "median": statistics.median(vals),
+        "min": min(vals),
+        "max": max(vals),
+        "n": len(vals),
+    }
+
+
 def seps(sampled_edges: int, seconds: float) -> float:
     """Sampled edges per second (reference bench_sampler.py:14-16)."""
     return sampled_edges / max(seconds, 1e-12)

@@ -32,6 +32,11 @@ def main():
     ap.add_argument("--serve-sample-ms", type=float, default=None)
     ap.add_argument("--serve-forward-ms", type=float, default=None)
     ap.add_argument("--serve-ref-batch", type=int, default=64)
+    # one-vs-two-dispatch model (round 11): fixed per-execute overhead —
+    # the RPC/launch floor paid once per flush on the fused serve path,
+    # twice on the split path. Measured by bench.py's serve section as
+    # serve_split_minus_fused_s (picked up via --bench) or passed here.
+    ap.add_argument("--serve-overhead-ms", type=float, default=None)
     # distributed serving (round 10): H-host rows for the seed-ownership
     # routed engine — per-shard dispatch + DCN exchange term
     ap.add_argument("--serve-hosts", default="1,2,4,8")
@@ -43,6 +48,7 @@ def main():
     source = f"--step-ms {args.step_ms}"
     serve_sample_s = (args.serve_sample_ms or 0) / 1e3
     serve_forward_s = (args.serve_forward_ms or 0) / 1e3
+    serve_overhead_s = (args.serve_overhead_ms or 0) / 1e3
     serve_ref_batch = args.serve_ref_batch
     serve_source = "--serve-sample-ms/--serve-forward-ms"
     if args.bench:
@@ -60,6 +66,8 @@ def main():
                 serve_forward_s = ctx.get("serve_forward_s", 0.0)
                 serve_ref_batch = ctx.get("serve_eval_ref_batch", serve_ref_batch)
                 serve_source = f"{args.bench} serve_sample_s/serve_forward_s"
+        if args.serve_overhead_ms is None and ctx.get("serve_split_minus_fused_s"):
+            serve_overhead_s = ctx["serve_split_minus_fused_s"]
     if not step_s:
         step_s = 0.0415  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
         source = "PERF_NOTES.md round-4 default 41.5 ms"
@@ -140,10 +148,35 @@ def main():
         + " Scaled linearly to each bucket (OPTIMISTIC at small\nbuckets: "
         "fixed per-dispatch overhead is omitted — see the serve_table "
         "docstring).\nThe measured counterpart with the real engine is "
-        "scripts/serve_probe.py ->\nSERVE_r02.json (pipelined window sweep) "
-        "and SERVE_r01.json (cache/skew sweep).\n\n"
+        "scripts/serve_probe.py ->\nSERVE_r04.json (fused vs split, "
+        "median-of-N), SERVE_r02.json (window sweep),\nSERVE_r01.json "
+        "(cache/skew sweep).\n\n"
         + format_serve_markdown(serve_rows)
     )
+    # one-vs-two-dispatch rows (round 11): the fixed per-execute overhead
+    # paid once on the fused serve path, twice on the round-9 split path
+    serve_dispatch_rows = []
+    if serve_overhead_s:
+        sc = (
+            (serve_sample_s, serve_forward_s, serve_ref_batch)
+            if (serve_sample_s or serve_forward_s)
+            else (step_s, 0.0, 1024)
+        )
+        for dpf in (1, 2):
+            serve_dispatch_rows += serve_table(
+                sc[0], 0.0, sc[1], ref_batch=sc[2], buckets=(64, 256),
+                hit_rates=(0.0, 0.5), unique_frac=0.8, max_delay_ms=2.0,
+                dispatches_per_flush=dpf, dispatch_overhead_s=serve_overhead_s,
+            )
+        serve_md += (
+            "\n\n### One-vs-two-dispatch (fused serve_step vs split "
+            "sample+forward)\n\n"
+            f"Fixed per-execute overhead {serve_overhead_s*1e3:.2f} ms "
+            "(measured split-minus-fused delta\nor --serve-overhead-ms) "
+            "paid once per flush fused, twice split; the win\nconcentrates "
+            "at small (latency-bound) buckets.\n\n"
+            + format_serve_markdown(serve_dispatch_rows)
+        )
     # H-host distributed serving rows (quiver_tpu.serve.DistServeEngine):
     # same cost inputs, bucket split by seed ownership — per-shard width
     # bucket/H, the serve-shaped exchange priced at the DCN rate like the
@@ -204,10 +237,12 @@ def main():
         ),
         "serve_sample_s": serve_sample_s,
         "serve_forward_s": serve_forward_s,
+        "serve_overhead_s": serve_overhead_s,
         "rows": [r._asdict() for r in rows],
         "sharded_fetch": [r._asdict() for r in fetch_rows],
         "quant_fetch": [r._asdict() for r in quant_rows],
         "serve": [r._asdict() for r in serve_rows],
+        "serve_one_vs_two_dispatch": [r._asdict() for r in serve_dispatch_rows],
         "serve_dist": [r._asdict() for r in dist_rows],
     }))
 

@@ -264,3 +264,43 @@ def test_serve_table_request_algebra():
     )
     md = format_serve_markdown(rows)
     assert "| bucket |" in md and md.count("\n|") >= 6
+
+
+def test_serve_table_one_vs_two_dispatch_overhead():
+    """The round-11 cost model: a fixed per-execute overhead is paid once
+    on the fused path, twice on the split path; zero overhead reduces to
+    the round-10 rows exactly."""
+    from quiver_tpu.parallel.scaling import serve_table
+
+    kw = dict(t_sample_s=0.01, t_gather_s=0.0, t_forward_s=0.01,
+              ref_batch=100, buckets=(10, 100), hit_rates=(0.0,),
+              unique_frac=1.0, max_delay_ms=2.0)
+    base = serve_table(**kw)
+    legacy = serve_table(**kw, dispatches_per_flush=2)  # zero overhead
+    assert [r.dispatch_s for r in base] == [r.dispatch_s for r in legacy]
+    fused = serve_table(**kw, dispatches_per_flush=1, dispatch_overhead_s=0.1)
+    split = serve_table(**kw, dispatches_per_flush=2, dispatch_overhead_s=0.1)
+    by_f = {r.bucket: r for r in fused}
+    by_s = {r.bucket: r for r in split}
+    for b in (10, 100):
+        # exactly one extra overhead per flush on the split path
+        assert by_s[b].dispatch_s == pytest.approx(by_f[b].dispatch_s + 0.1)
+        assert by_f[b].qps > by_s[b].qps
+    # the win concentrates at small buckets: relative QPS gain shrinks as
+    # the per-seed term amortizes the fixed overhead away
+    gain = {b: by_f[b].qps / by_s[b].qps for b in (10, 100)}
+    assert gain[10] > gain[100] > 1.0
+    assert by_f[10].dispatches_per_flush == 1 and by_s[10].overhead_s == 0.1
+    with pytest.raises(ValueError):
+        serve_table(**kw, dispatches_per_flush=0)
+
+
+def test_median_min_max():
+    from quiver_tpu.trace import median_min_max
+
+    s = median_min_max([3.0, 1.0, 2.0])
+    assert s == {"median": 2.0, "min": 1.0, "max": 3.0, "n": 3}
+    assert median_min_max([4, 1, 3, 2])["median"] == pytest.approx(2.5)
+    assert median_min_max([7])["median"] == 7.0
+    with pytest.raises(ValueError):
+        median_min_max([])

@@ -35,6 +35,7 @@ from quiver_tpu.serve import (
     EmbeddingCache,
     ServeConfig,
     ServeEngine,
+    ServeStats,
     default_buckets,
     poisson_arrivals,
     trace_skew_stats,
@@ -561,8 +562,13 @@ def test_dispatch_index_order_pinned_under_deterministic_clock(setup):
     assert [list(p[:n]) for p, n in eng.dispatch_log] == [[1, 2], [3], [4, 5, 6, 7]]
     assert eng._dispatch_index == 3
     assert eng.stats.dispatch_buckets == {2: 1, 1: 1, 4: 1}
-    # spans carry injected-clock timestamps only (all within [0, t])
-    assert len(eng.stats.spans) == 9       # 3 flushes x 3 stages
+    # spans carry injected-clock timestamps only (all within [0, t]);
+    # assemble records two pieces per flush (drain, then seal after the
+    # window permit) so the window WAIT between them never fakes overlap
+    assert len(eng.stats.spans) == 12      # 3 flushes x (2 assemble + 2)
+    stages = [s for s, _, _ in eng.stats.spans]
+    assert stages.count("assemble") == 6
+    assert stages.count("dispatch") == stages.count("resolve") == 3
     for _, t0, t1 in eng.stats.spans:
         assert 0.0 <= t0 <= t1 <= t[0]
 
@@ -592,21 +598,193 @@ def test_warmup_pretraces_buckets_without_touching_key_stream(setup):
         assert np.array_equal(h.result(), oracle[nid])
 
 
+# -- fused one-dispatch path (round 11) ---------------------------------------
+
+def test_fused_and_split_paths_bit_identical(setup):
+    """THE round-11 parity pin: the fused one-program serve path
+    (sample+gather+forward as one pre-bound executable) serves logits and
+    a dispatch log BIT-IDENTICAL to the round-9 split path on the same
+    trace, and the 2→1 execute-call cut is observable in the ledger."""
+    trace = zipfian_trace(N_NODES, 60, alpha=0.9, seed=5)
+    outs, logs, engines = [], [], []
+    for mode in ("fused", "split"):
+        eng = make_engine(
+            setup, max_batch=8, max_delay_ms=1e9, cache_entries=512,
+            dispatch_mode=mode,
+        )
+        outs.append(eng.predict(trace))
+        logs.append(eng.dispatch_log)
+        engines.append(eng)
+    fused, split = engines
+    assert fused._programs is not None and split._programs is None
+    assert np.array_equal(outs[0], outs[1])
+    assert len(logs[0]) == len(logs[1])
+    for (p0, n0), (p1, n1) in zip(logs[0], logs[1]):
+        assert n0 == n1 and np.array_equal(p0, p1)
+    # execute-call ledger: exactly ONE device execute per flush fused,
+    # two (sample + forward) per flush split
+    assert fused.stats.dispatches > 0
+    assert fused.stats.execute_calls == fused.stats.dispatches
+    assert fused.stats.dispatch_calls == fused.stats.dispatches
+    assert split.stats.execute_calls == 2 * split.stats.dispatches
+    # and both still replay bit-exact through the offline batch_logits path
+    oracle = replay_oracle(setup, fused)
+    for i, nid in enumerate(trace):
+        assert np.array_equal(outs[0][i], oracle[int(nid)])
+
+
+def test_dispatch_mode_validation_and_forced_fused(setup):
+    model, params, feat = setup
+    with pytest.raises(ValueError, match="dispatch_mode"):
+        ServeEngine(model, params, make_sampler(), feat,
+                    ServeConfig(dispatch_mode="warp"))
+    # a feature with no in-jit gather cannot satisfy dispatch_mode='fused'
+    gate = _GateFeature(feat)
+    with pytest.raises(ValueError, match="cannot fuse"):
+        ServeEngine(model, params, make_sampler(), gate,
+                    ServeConfig(dispatch_mode="fused"))
+    # ...but 'auto' quietly falls back to the split path for it
+    eng = ServeEngine(model, params, make_sampler(), gate, ServeConfig())
+    assert eng._programs is None
+
+
+def test_post_warmup_bucket_miss_is_hard_error(setup):
+    """warmup() seals the fused program table: a bucket the fleet didn't
+    warm raises RuntimeError (resolved into the waiters like any flush
+    error) instead of silently compiling under a live request."""
+    eng = make_engine(setup, max_batch=8, max_delay_ms=1e9)
+    assert eng._programs is not None
+    times = eng.warmup(buckets=(4, 8))       # partial warm: 1 and 2 missing
+    assert set(times) == {4, 8} and eng._programs.sealed
+    for i in range(3):
+        eng.submit(i)
+    assert eng.flush() == 3                  # bucket 4: pre-bound, fine
+    h = eng.submit(50)                       # bucket 1: sealed miss
+    with pytest.raises(RuntimeError, match="no pre-bound executable"):
+        eng.flush()
+    with pytest.raises(RuntimeError, match="no pre-bound executable"):
+        h.result(timeout=1)
+    assert not eng._drainable() and not eng._inflight
+    # a FULL warmup covers the whole ladder — no miss is possible
+    eng2 = make_engine(setup, max_batch=8, max_delay_ms=1e9)
+    eng2.warmup()
+    assert set(eng2._programs.buckets) == set(default_buckets(8))
+
+
+def test_serve_stats_merge_includes_round11_counters():
+    a, b = ServeStats(), ServeStats()
+    a.dispatch_calls, a.execute_calls, a.late_admitted = 3, 3, 1
+    b.dispatch_calls, b.execute_calls, b.late_admitted = 1, 2, 4
+    m = ServeStats().merge(a).merge(b)
+    assert (m.dispatch_calls, m.execute_calls, m.late_admitted) == (4, 5, 5)
+    snap = m.snapshot()
+    assert snap["execute_calls"] == 5 and snap["late_admitted"] == 5
+
+
+def test_cached_apply_reuses_traced_program_across_evals(setup):
+    """Trace-count pin for `inference._cached_apply`: equal model VALUES
+    share one jitted apply, and a repeated `sampled_eval` retraces
+    nothing — the jit cache size is flat across calls."""
+    from quiver_tpu.inference import sampled_eval
+
+    model, params, feat = setup
+    twin = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    apply = _cached_apply(model)
+    assert apply is _cached_apply(twin)      # value-keyed, not id-keyed
+    labels = np.zeros(N_NODES, np.int64)
+    nodes = np.arange(32)
+    sampled_eval(model, params, make_sampler(), feat, labels, nodes,
+                 batch_size=16)
+    assert hasattr(apply, "_cache_size")
+    before = apply._cache_size()
+    for _ in range(2):                       # repeat evals: zero retraces
+        sampled_eval(model, params, make_sampler(), feat, labels, nodes,
+                     batch_size=16)
+    assert apply._cache_size() == before
+
+
+# -- late admission (continuous seed-level batching, round 11) ----------------
+
+def test_late_admission_replay_determinism(setup):
+    """A seed submitted while a flush sits assembled-but-blocked on the
+    in-flight window joins that flush's pad lanes: it appears in the
+    dispatch log exactly once, repeats of it coalesce, and the served
+    logits are bit-equal to a no-late-admission run submitting the same
+    final batches — admission never perturbs the key stream."""
+    eng, gate = make_gated_engine(
+        setup, max_batch=8, max_delay_ms=1e9, max_in_flight=1,
+        cache_entries=512,
+    )
+    eng.warmup()
+    gate.delays = [3.0]                      # flush A stalls mid-dispatch
+    gate.started.clear()
+    h1 = [eng.submit(i) for i in (0, 1, 2)]
+    t_a = threading.Thread(target=eng.flush)
+    t_a.start()
+    assert gate.started.wait(30)             # A holds the only window permit
+    h2 = [eng.submit(i) for i in (10, 11, 12)]
+    t_b = threading.Thread(target=eng.flush)
+    t_b.start()                              # B drains, publishes, blocks
+    deadline = _time.time() + 20
+    while eng._open is None and _time.time() < deadline:
+        _time.sleep(0.005)
+    assert eng._open is not None             # B is open for admission
+    h_late = eng.submit(13)                  # rides B's pad lane (bucket 4)
+    assert eng.stats.late_admitted == 1
+    co = eng.stats.coalesced
+    h_co = eng.submit(13)                    # coalesces onto the admitted slot
+    assert eng.stats.coalesced == co + 1
+    t_a.join()
+    t_b.join()
+    flat = [list(p[:nv]) for p, nv in eng.dispatch_log]
+    assert flat == [[0, 1, 2], [10, 11, 12, 13]]
+    seeds = [s for f in flat for s in f]     # admitted exactly once, no dupes
+    assert len(seeds) == len(set(seeds))
+    assert eng.stats.padded_seeds == 1       # only A's slack went to waste
+    # bit-equal to a no-late-admission engine fed the same final batches
+    ref = make_engine(setup, max_batch=8, max_delay_ms=1e9,
+                      late_admission=False)
+    ref_out = {}
+    for batch in flat:
+        hs = [ref.submit(i) for i in batch]
+        ref.flush()
+        for nid, h in zip(batch, hs):
+            ref_out[nid] = h.result(timeout=30)
+    assert ref.stats.late_admitted == 0
+    for nid, h in zip((0, 1, 2, 10, 11, 12, 13, 13),
+                      h1 + h2 + [h_late, h_co]):
+        assert np.array_equal(h.result(timeout=30), ref_out[nid])
+    # ...and through the offline replay oracle
+    oracle = replay_oracle(setup, eng)
+    for nid in (0, 1, 2, 10, 11, 12, 13):
+        assert np.array_equal(ref_out[nid], oracle[nid])
+
+
 # -- error propagation --------------------------------------------------------
 
 def test_flush_error_resolves_waiters(setup):
-    eng = make_engine(setup, max_batch=8, max_delay_ms=1e9)
-
     class Boom(RuntimeError):
         pass
 
-    def broken_sample(_):
+    def broken(*_a, **_k):
         raise Boom("sampler down")
 
-    eng._sampler.sample_dense = broken_sample
+    # split path: the sample_dense leg raises mid-seal
+    eng = make_engine(setup, max_batch=8, max_delay_ms=1e9, dispatch_mode="split")
+    eng._sampler.sample_dense = broken
     h = eng.submit(1)
     with pytest.raises(Boom):
         eng.flush()
     with pytest.raises(Boom):
         h.result(timeout=1)
     assert not eng._drainable() and not eng._inflight
+    # fused path: the key draw raises mid-seal — same resolution contract
+    eng2 = make_engine(setup, max_batch=8, max_delay_ms=1e9)
+    assert eng2._programs is not None
+    eng2._sampler.next_key = broken
+    h2 = eng2.submit(1)
+    with pytest.raises(Boom):
+        eng2.flush()
+    with pytest.raises(Boom):
+        h2.result(timeout=1)
+    assert not eng2._drainable() and not eng2._inflight

@@ -286,6 +286,42 @@ def test_repeat_trace_hits_router_cache_without_routing(setup):
     assert dist.stats.router_cache.hits >= len(trace)
 
 
+# -- feature residency (round 11: fused one-dispatch owners) ------------------
+
+def test_feature_residency_modes_value_identical(setup):
+    """The default ``feature_residency='closure'`` (owner-resident closure
+    rows, FUSED one-program shard dispatch) must serve byte-identical
+    results to the round-10 ``'exchange'`` residency (1/H owned rows +
+    per-flush feature exchange, split dispatch) — residency moves bytes
+    between build time and flush time, never values."""
+    model, params, feat = setup
+    trace = zipfian_trace(N_NODES, 30, alpha=0.9, seed=11)
+    dist_c = make_dist(setup, hosts=2)
+    out_c = dist_c.predict(trace)
+    dist_x = make_dist(setup, hosts=2, feature_residency="exchange")
+    out_x = dist_x.predict(trace)
+    assert np.array_equal(out_c, out_x)
+    # closure owners run the fused program: ONE execute call per flush
+    assert all(e._programs is not None for e in dist_c.engines.values())
+    merged_c = dist_c.aggregate_stats()["shards_merged"]
+    assert merged_c["dispatches"] > 0
+    assert merged_c["execute_calls"] == merged_c["dispatches"]
+    # exchange owners gather host-side: split path, two legs per flush
+    assert all(e._programs is None for e in dist_x.engines.values())
+    merged_x = dist_x.aggregate_stats()["shards_merged"]
+    assert merged_x["execute_calls"] == 2 * merged_x["dispatches"]
+    # the feature closure is one hop DEEPER than the adjacency closure
+    # (leaves are gathered, never expanded) and reported honestly
+    for st in dist_c.shard_topo_stats.values():
+        assert st["feature_closure_nodes"] >= st["closure_nodes"]
+    for h, eng in dist_c.engines.items():
+        assert eng._feature.resident_rows == (
+            dist_c.shard_topo_stats[h]["feature_closure_nodes"]
+        )
+    with pytest.raises(ValueError, match="feature_residency"):
+        make_dist(setup, hosts=2, feature_residency="teleport")
+
+
 # -- params versioning across shards ------------------------------------------
 
 def test_update_params_fences_router_and_all_shards(setup):
