@@ -972,6 +972,63 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
                 + (f", overlap {ov.get('overlap_frac', 0.0):.0%}" if mif > 1 else "")
             )
 
+    # observability cost on the saturated leg (round 12, ISSUE 7): the
+    # same mif=2 threaded-client run with the request-lifecycle journal +
+    # metrics registry ON vs OFF, median-of-3 INTERLEAVED (off/on pairs
+    # back to back, so box drift hits both sides equally). The journal is
+    # designed to be left on in production; this is the measured price.
+    def _run_saturated(journal_events):
+        eng = ServeEngine(
+            model, params, make_sampler(), table,
+            ServeConfig(max_batch=64, buckets=(64,), max_delay_ms=2.0,
+                        cache_entries=1 << 16, max_in_flight=2,
+                        journal_events=journal_events),
+        )
+        eng.warmup()
+        if journal_events:
+            eng.register_metrics()  # passive adapters live during the run
+        eng.cache.invalidate()
+        eng.reset_stats()
+        trace = zipfian_trace(n_nodes, n_requests, alpha=0.99, seed=23)
+        chunks = np.array_split(trace, 2)
+        errs = []
+
+        def client(c):
+            try:
+                eng.predict(c, 600)
+            except Exception as exc:
+                errs.append(repr(exc))
+
+        t0 = time.time()
+        with eng:
+            ts = [threading.Thread(target=client, args=(c,)) for c in chunks]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+        wall = time.time() - t0
+        if errs:
+            raise RuntimeError(errs)
+        return n_requests / wall
+
+    try:
+        qps_obs_on, qps_obs_off = [], []
+        for _ in range(3):
+            qps_obs_off.append(round(_run_saturated(0), 1))
+            qps_obs_on.append(round(_run_saturated(1 << 16), 1))
+        med_on = sorted(qps_obs_on)[1]
+        med_off = sorted(qps_obs_off)[1]
+        context["serve_obs_qps_on"] = qps_obs_on
+        context["serve_obs_qps_off"] = qps_obs_off
+        context["serve_obs_overhead_frac"] = round(1.0 - med_on / med_off, 4)
+        log(
+            f"serve obs overhead: on {med_on:.0f} vs off {med_off:.0f} QPS "
+            f"(median-of-3) -> frac {context['serve_obs_overhead_frac']:+.4f} "
+            f"(spread on {min(qps_obs_on):.0f}-{max(qps_obs_on):.0f}, "
+            f"off {min(qps_obs_off):.0f}-{max(qps_obs_off):.0f})"
+        )
+    except Exception as exc:
+        context["serve_obs_overhead_error"] = repr(exc)
+        log(f"serve obs overhead leg failed: {exc}")
+
     # distributed serving (round 10): seed-ownership routed engine at
     # hosts=2 over the SAME graph, exchange='host' (one chip — the hops
     # are host-side here; the collective leg is covered by the CPU-tier

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -51,6 +52,31 @@ ID_PAD = np.int64(-1)
 # Re-entrant because the serve exchange's owner callbacks may themselves
 # exchange (feature halo fetches) on the same thread.
 _SC_COLLECTIVE_LOCK = threading.RLock()
+
+# Optional exchange-span recording (observability, ISSUE 7): when a
+# recorder is installed, TpuComm.exchange / TpuComm.exchange_serve record
+# ("comm.exchange"/"comm.exchange_serve", t0, t1) spans into it, so
+# `trace.export_chrome_trace` can place the wire legs on the same
+# timeline as the serve engines' stages. Spans are stamped on
+# _EXCHANGE_CLOCK — time.monotonic by default, which matches the default
+# ServeConfig.clock; engines driven by a NON-default clock must pass that
+# clock to `record_exchange_spans` or the merged timeline's clock domains
+# diverge. Costs one None-check when disabled; OBSERVE-ONLY — never read
+# by any transfer decision.
+EXCHANGE_SPANS = None
+_EXCHANGE_CLOCK = time.monotonic
+
+
+def record_exchange_spans(recorder, clock=time.monotonic):
+    """Install (or, with ``None``, remove) the process-wide exchange-span
+    recorder — typically a fresh `trace.SpanRecorder`. ``clock`` must be
+    THE clock the engines whose timeline these spans will merge into are
+    running on (`ServeConfig.clock`; the default monotonic matches the
+    default engine clock). Returns the recorder for chaining."""
+    global EXCHANGE_SPANS, _EXCHANGE_CLOCK
+    EXCHANGE_SPANS = recorder
+    _EXCHANGE_CLOCK = clock
+    return recorder
 
 
 def _ids_to_int32(arr: np.ndarray) -> np.ndarray:
@@ -332,6 +358,8 @@ class TpuComm:
         ``jax.make_array_from_process_local_data`` — no process ever holds
         the global table.
         """
+        rec = EXCHANGE_SPANS
+        t_span0 = _EXCHANGE_CLOCK() if rec is not None else 0.0
         if budget is None:
             budget = self.static_budget
             if budget is None:
@@ -366,6 +394,8 @@ class TpuComm:
         for j, ids in enumerate(host2ids):
             n = len(ids)
             res.append(mine[j, :n] if n else None)
+        if rec is not None:
+            rec.record("comm.exchange", t_span0, _EXCHANGE_CLOCK())
         return res
 
     def _exchange_multiprocess(self, req_mine: np.ndarray, h: int) -> jax.Array:
@@ -471,6 +501,8 @@ class TpuComm:
         Returns one ``[len(ids), out_dim]`` float32 array per owner (None
         where no ids were requested), aligned with ``host2ids`` order.
         """
+        rec = EXCHANGE_SPANS
+        t_span0 = _EXCHANGE_CLOCK() if rec is not None else 0.0
         if budget is None:
             budget = self.static_budget
             if budget is None:
@@ -535,6 +567,8 @@ class TpuComm:
         for j, ids in enumerate(host2ids):
             n = len(ids)
             res.append(np.asarray(mine[j, :n]) if n else None)
+        if rec is not None:
+            rec.record("comm.exchange_serve", t_span0, _EXCHANGE_CLOCK())
         return res
 
     # reference-compatible raw verbs (comm.py send/recv/allreduce) expressed

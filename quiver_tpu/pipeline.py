@@ -213,6 +213,31 @@ class PipelineStats:
         hidden_frac_measured, per-stage busy seconds)."""
         return self.spans.overlap_summary() if self.spans else {}
 
+    def register_metrics(self, registry=None,
+                         prefix: str = "quiver_pipeline", labels=None):
+        """Adapt these live pipeline counters into a
+        `trace.MetricsRegistry` (created when not given) — the same
+        adapter discipline as `ServeEngine.register_metrics`: callback-
+        backed readers, nothing counted twice. ``overlap_frac`` is
+        computed from the span recorder at exposition time (bounded ring,
+        so a scrape stays cheap)."""
+        from .trace import MetricsRegistry
+
+        reg = registry if registry is not None else MetricsRegistry()
+        reg.counter_fn(f"{prefix}_batches_total", lambda: self.batches,
+                       "pipelined train batches", labels)
+        reg.counter_fn(f"{prefix}_cold_rows_total", lambda: self.cold_rows,
+                       "cold-tier rows fetched", labels)
+        reg.counter_fn(f"{prefix}_hot_rows_total", lambda: self.hot_rows,
+                       "hot-tier rows gathered", labels)
+        reg.gauge_fn(f"{prefix}_overlap_frac",
+                     lambda: self.overlap_summary().get("overlap_frac", 0.0),
+                     "fraction of covered wall with >= 2 stages active",
+                     labels)
+        reg.gauge_fn(f"{prefix}_span_count", lambda: len(self.spans),
+                     "stage spans in the recorder ring", labels)
+        return reg
+
 
 class TrainPipeline:
     """sample -> tiered gather -> step, with staged prefetch threads.
@@ -320,6 +345,29 @@ class TrainPipeline:
 
     def _stage(self, seeds: np.ndarray) -> TieredBatch:
         return self._stage_ds(self.sampler.sample_dense(seeds), seeds)
+
+    def register_metrics(self, registry=None,
+                         prefix: str = "quiver_pipeline", labels=None):
+        """`PipelineStats.register_metrics` plus the tiered feature
+        pipeline's true-traffic counters (padding excluded)."""
+        reg = self.stats.register_metrics(registry, prefix, labels)
+        reg.counter_fn(f"{prefix}_tier_rows_seen_total",
+                       lambda: self.tiered.rows_seen,
+                       "rows through the tiered gather", labels)
+        reg.counter_fn(f"{prefix}_tier_cold_rows_seen_total",
+                       lambda: self.tiered.cold_rows_seen,
+                       "rows answered by the cold tier", labels)
+        return reg
+
+    def export_chrome_trace(self, path: str, metadata=None):
+        """Perfetto-loadable timeline of the recorded stage spans
+        (sample / gather / upload / step lanes — the staged-overlap
+        evidence as a picture instead of a fraction)."""
+        from .trace import export_chrome_trace
+
+        return export_chrome_trace(
+            path, [("train_pipeline", self.stats.spans)], metadata
+        )
 
     def run_epoch(
         self,

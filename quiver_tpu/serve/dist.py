@@ -68,9 +68,19 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import comm as comm_mod
 from ..comm import HostRankTable, TpuComm, round_up_pow2
 from ..feature import DistFeature, Feature, PartitionInfo
-from ..trace import HitRateCounter, LatencyHistogram, SpanRecorder
+from ..trace import (
+    NULL_JOURNAL,
+    EventJournal,
+    HitRateCounter,
+    LatencyHistogram,
+    MetricsRegistry,
+    SpanRecorder,
+    export_chrome_trace as _export_chrome_trace,
+    register_hit_rate,
+)
 from ..utils import CSRTopo
 from .cache import EmbeddingCache
 from .engine import ServeConfig, ServeEngine, ServeResult, ServeStats, _Slot
@@ -362,6 +372,14 @@ class DistServeConfig:
     late_admission : admit late-arriving seeds into a routed flush that is
                      assembled but still waiting for a window slot (up to
                      ``max_batch``), mirroring `ServeConfig.late_admission`.
+    journal_events : router-side `trace.EventJournal` capacity (0 =
+                     disabled). The default shard config inherits it, so
+                     every owner engine journals too; `fleet_snapshot` /
+                     `export_chrome_trace` merge the owner journals
+                     deterministically (sorted host, dispatch-index order
+                     within — the same discipline as the stats merges).
+                     Observe-only, same contract as
+                     `ServeConfig.journal_events`.
     """
 
     hosts: int = 2
@@ -378,6 +396,7 @@ class DistServeConfig:
     record_dispatches: bool = False
     feature_residency: str = "closure"
     late_admission: bool = True
+    journal_events: int = 0
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -390,6 +409,7 @@ class DistServeConfig:
             clock=self.clock,
             record_dispatches=self.record_dispatches,
             late_admission=self.late_admission,
+            journal_events=self.journal_events,
         )
 
 
@@ -447,7 +467,7 @@ class _RoutedFlush:
     drained width up to ``max_batch``); the owner split is computed at SEAL
     time so late-admitted seeds route with their flush."""
 
-    __slots__ = ("keys", "slots", "split", "bucket", "error")
+    __slots__ = ("keys", "slots", "split", "bucket", "error", "fid")
 
     def __init__(self, keys, slots, split):
         self.keys = keys
@@ -455,6 +475,7 @@ class _RoutedFlush:
         self.split = split  # [(host, ids ndarray, positions ndarray)]
         self.bucket = 0
         self.error: Optional[BaseException] = None
+        self.fid = -1  # journal flush id (router dispatch-log index)
 
 
 class DistServeEngine:
@@ -503,6 +524,13 @@ class DistServeEngine:
         self._budget = self.config.budget or round_up_pow2(self.config.max_batch)
         self._clock = self.config.clock
         self.stats = DistServeStats()
+        self.journal = (
+            EventJournal(self.config.journal_events, clock=self._clock)
+            if self.config.journal_events > 0
+            else NULL_JOURNAL
+        )
+        self._next_rid = 0     # journal request ids (guarded by _lock)
+        self._flush_index = 0  # router dispatch-log index (guarded by _seq)
         rc = self.config.router_cache_entries
         self.cache = EmbeddingCache(
             self.config.cache_entries if rc is None else rc,
@@ -693,17 +721,24 @@ class DistServeEngine:
             )
         now = self._clock()
         need_flush = False
+        jr = self.journal
         with self._lock:
             self.stats.requests += 1
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
                 self.stats.latency.record_ms((self._clock() - now) * 1e3)
+                jr.emit("cache_hit", -1, -1, key)
                 return ServeResult(value=cached)
             slot = self._pending.get(key) or self._inflight.get(key)
             if slot is not None and slot.version == self.params_version:
                 self.stats.coalesced += 1
+                jr.emit("coalesce", slot.rid, -1, key)
             else:
-                slot = _Slot(key, self.params_version, now)
+                rid = -1
+                if jr.enabled:
+                    rid = self._next_rid
+                    self._next_rid += 1
+                slot = _Slot(key, self.params_version, now, rid=rid)
                 fl = self._open
                 if fl is not None and len(fl.keys) < fl.bucket:
                     # late admission into the routed flush still waiting
@@ -712,8 +747,10 @@ class DistServeEngine:
                     fl.slots.append(slot)
                     self._inflight[key] = slot
                     self.stats.late_admitted += 1
+                    jr.emit("late_admit", rid, fl.fid, key)
                 else:
                     self._pending[key] = slot
+                    jr.emit("submit", rid, -1, key)
             slot.waiters.append(now)
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
@@ -762,6 +799,13 @@ class DistServeEngine:
             self.stats.inflight_peak = max(
                 self.stats.inflight_peak, self._inflight_flushes
             )
+            jr = self.journal
+            if jr.enabled:
+                # caller holds _seq: the index _seal_assembled will draw
+                fl.fid = self._flush_index + 1
+                for k, slot in zip(keys, slots):
+                    jr.emit("assemble", slot.rid, fl.fid, k)
+                jr.emit("flush", -1, fl.fid, len(keys), fl.bucket)
             if self.config.late_admission and len(keys) < fl.bucket:
                 self._open = fl
         return fl
@@ -769,6 +813,8 @@ class DistServeEngine:
     def _seal_assembled(self, fl: _RoutedFlush) -> None:
         with self._lock:
             self._open = None
+        self._flush_index += 1
+        self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
             arr = np.asarray(fl.keys, np.int64)
             owners = self.global2host[arr]
@@ -787,6 +833,9 @@ class DistServeEngine:
         """Forward the per-owner sub-batches and re-interleave the answers
         into flush-key order. Collective mode ships ids/logits over the
         mesh; host mode calls the owner engines directly."""
+        # a = bucket per the EVENT_KINDS vocabulary; the router's "bucket"
+        # is its admission cap (it pads nothing)
+        self.journal.emit("dispatch", -1, fl.fid, fl.bucket)
         out = np.zeros((len(fl.keys), self.out_dim), np.float32)
         if self.exchange_mode == "collective":
             by_host = {h: (ids, pos) for h, ids, pos in fl.split}
@@ -809,6 +858,8 @@ class DistServeEngine:
             for h, ids, pos in fl.split:
                 out[pos] = np.asarray(self.engines[h].predict(ids))
         out.setflags(write=False)
+        # one routed round-trip = one "execute" at the router grain
+        self.journal.emit("execute_done", -1, fl.fid, len(fl.split))
         return out
 
     def _resolve(self, fl: _RoutedFlush, rows: Optional[np.ndarray]) -> None:
@@ -835,6 +886,7 @@ class DistServeEngine:
             self._inflight_flushes -= 1
             self._fence.notify_all()
             self.stats.spans.record("resolve", t_res0, self._clock())
+            self.journal.emit("resolve", -1, fl.fid, len(fl.keys))
 
     def flush(self) -> int:
         """Route up to ``max_batch`` pending unique seeds NOW. Synchronous
@@ -856,8 +908,13 @@ class DistServeEngine:
                 if fl is None:
                     return 0
                 try:
+                    jr = self.journal
+                    t_w0 = self._clock() if jr.enabled else 0.0
                     self._window.acquire()
                     have_permit = True
+                    if jr.enabled:
+                        jr.emit("window_wait", -1, fl.fid,
+                                self._clock() - t_w0)
                     t0 = self._clock()
                     self._seal_assembled(fl)
                     self.stats.spans.record("assemble", t0, self._clock())
@@ -933,12 +990,139 @@ class DistServeEngine:
     def reset_stats(self) -> None:
         """Zero router counters (re-pointing the router cache's counter at
         the fresh stats, same contract as `ServeEngine.reset_stats`) and
-        every shard engine's stats. Cache CONTENTS are untouched."""
+        every shard engine's stats (journals included). Cache CONTENTS are
+        untouched."""
         with self._lock:
             self.stats = DistServeStats()
             self.cache.counters = self.stats.router_cache
+            if self.journal.enabled:
+                self.journal.clear()
         for eng in self.engines.values():
             eng.reset_stats()
+
+    # -- fleet observability ----------------------------------------------
+
+    def register_metrics(self, registry: Optional[MetricsRegistry] = None,
+                         prefix: str = "quiver_router",
+                         labels: Optional[Dict[str, str]] = None,
+                         ) -> MetricsRegistry:
+        """Adapt the ROUTER's live state into a registry (created when not
+        given): `DistServeStats` counters, queue/window gauges, exchange
+        wire bytes, per-owner sub-batch counters (``host`` label), the
+        router result cache, and the end-to-end latency histogram. All
+        callback-backed (read at exposition time, `reset_stats`-safe).
+        Owner-engine metrics ride :meth:`fleet_registry`."""
+        reg = registry if registry is not None else MetricsRegistry()
+        for f in ("requests", "coalesced", "router_dispatches",
+                  "routed_seeds", "late_admitted"):
+            reg.counter_fn(f"{prefix}_{f}_total",
+                           (lambda f=f: getattr(self.stats, f)),
+                           f"DistServeStats.{f}", labels)
+        reg.counter_fn(f"{prefix}_exchange_id_bytes_total",
+                       lambda: self.stats.exchange_id_bytes,
+                       "global collective id payload bytes", labels)
+        reg.counter_fn(f"{prefix}_exchange_logit_bytes_total",
+                       lambda: self.stats.exchange_logit_bytes,
+                       "global collective logits payload bytes", labels)
+        reg.gauge_fn(f"{prefix}_pending_depth", lambda: len(self._pending),
+                     "unique seeds queued at the router", labels)
+        reg.gauge_fn(f"{prefix}_inflight_flushes",
+                     lambda: self._inflight_flushes,
+                     "routed flushes between assemble and resolve", labels)
+        reg.gauge_fn(f"{prefix}_inflight_window",
+                     lambda: self.config.max_in_flight,
+                     "configured router max_in_flight bound", labels)
+        reg.gauge_fn(f"{prefix}_inflight_peak",
+                     lambda: self.stats.inflight_peak,
+                     "largest routed in-flight occupancy observed", labels)
+        reg.gauge_fn(f"{prefix}_cache_rows", lambda: len(self.cache),
+                     "router result-cache resident rows", labels)
+        reg.gauge_fn(f"{prefix}_params_version", lambda: self.params_version,
+                     "current weights version", labels)
+        for h in sorted(self.engines):
+            reg.counter_fn(
+                f"{prefix}_sub_batches_total",
+                (lambda h=h: self.stats.sub_batches.get(h, 0)),
+                "owner sub-batches routed",
+                dict(labels or {}, host=str(h)),
+            )
+            reg.counter_fn(
+                f"{prefix}_sub_batch_seeds_total",
+                (lambda h=h: self.stats.sub_batch_seeds.get(h, 0)),
+                "seeds routed to owner",
+                dict(labels or {}, host=str(h)),
+            )
+        register_hit_rate(reg, f"{prefix}_cache",
+                          lambda: self.stats.router_cache, labels)
+        reg.histogram(f"{prefix}_latency_ms",
+                      "end-to-end routed request latency", labels,
+                      fn=lambda: self.stats.latency)
+        return reg
+
+    def fleet_registry(self, registry: Optional[MetricsRegistry] = None,
+                       ) -> MetricsRegistry:
+        """ONE registry over the whole fleet: the router's metrics plus
+        every owner engine's (`ServeEngine.register_metrics`) under a
+        ``host`` label, registered in sorted-host order — the same
+        deterministic merge discipline as `aggregate_stats`, so two
+        expositions of the same state are textually identical."""
+        reg = self.register_metrics(registry)
+        for h in sorted(self.engines):
+            self.engines[h].register_metrics(
+                reg, prefix="quiver_serve", labels={"host": str(h)}
+            )
+        return reg
+
+    def aggregate_journal(self) -> List[Tuple]:
+        """The fleet's lifecycle events as (host, t, kind, rid, fid, a, b)
+        tuples — router events first under host=-1, then each owner's in
+        sorted-host order. Within one journal the ring is already in
+        emit order, and flush events emit in dispatch-index order (seals
+        are serialized under each engine's sequencing lock), so the merge
+        is deterministic for a deterministic run — the same contract as
+        the dispatch-log/stats merges."""
+        merged: List[Tuple] = [(-1, *ev) for ev in self.journal.snapshot()]
+        for h in sorted(self.engines):
+            merged.extend(
+                (h, *ev) for ev in self.engines[h].journal.snapshot()
+            )
+        return merged
+
+    def fleet_snapshot(self) -> Dict[str, object]:
+        """Fleet observability in one JSON-able document: the router's
+        request breakdown (end-to-end stages), per-owner breakdowns
+        (sorted hosts), and the fleet registry snapshot. This is the
+        serve-stack answer to "where did this request's time go" at fleet
+        grain — queue/route at the router, device/resolve at the owners."""
+        return {
+            "router": self.journal.request_breakdown(),
+            "per_shard": {
+                h: self.engines[h].journal.request_breakdown()
+                for h in sorted(self.engines)
+            },
+            "metrics": self.fleet_registry().snapshot(),
+        }
+
+    def export_chrome_trace(self, path: str, extra_sources: Sequence = (),
+                            metadata: Optional[Dict[str, object]] = None,
+                            ) -> Dict[str, object]:
+        """One Perfetto-loadable timeline for the fleet: router spans +
+        journal, every owner engine's spans + journal (sorted hosts), and —
+        when `comm.record_exchange_spans` installed a recorder — the wire
+        legs, all on the shared monotonic clock."""
+        sources: List = [("router.spans", self.stats.spans)]
+        if self.journal.enabled:
+            sources.append(("router.journal", self.journal))
+        for h in sorted(self.engines):
+            eng = self.engines[h]
+            sources.append((f"owner{h}.spans", eng.stats.spans))
+            if eng.journal.enabled:
+                sources.append((f"owner{h}.journal", eng.journal))
+        rec = comm_mod.EXCHANGE_SPANS
+        if rec is not None and len(rec):
+            sources.append(("comm.exchange", rec))
+        sources.extend(extra_sources)
+        return _export_chrome_trace(path, sources, metadata)
 
     def start(self) -> "DistServeEngine":
         if self._running:

@@ -103,7 +103,16 @@ from ..inference import (
     pad_seed_batch,
     sample_batch,
 )
-from ..trace import HitRateCounter, LatencyHistogram, SpanRecorder
+from ..trace import (
+    NULL_JOURNAL,
+    EventJournal,
+    HitRateCounter,
+    LatencyHistogram,
+    MetricsRegistry,
+    SpanRecorder,
+    export_chrome_trace as _export_chrome_trace,
+    register_hit_rate,
+)
 from .cache import EmbeddingCache
 
 
@@ -171,6 +180,17 @@ class ServeConfig:
                      sampler key are drawn, so the dispatch log and key
                      stream stay deterministic and replayable
                      (``stats.late_admitted`` counts recovered lanes).
+    journal_events : capacity of the request-lifecycle `trace.EventJournal`
+                     (0 = disabled, the default). When on, the engine
+                     stamps submit/coalesce/cache-hit/late-admit/assemble/
+                     window-wait/dispatch/execute-done/resolve events on
+                     its clock — `journal.request_breakdown()` then yields
+                     per-stage p50/p99 and per-flush pad occupancy.
+                     OBSERVE-ONLY: events never feed control flow, so
+                     enabling it changes no served bit (pinned in
+                     tests/test_obs.py); cost is one deque append per
+                     event, cheap enough to leave on (bench.py
+                     ``serve_obs_overhead_frac``).
     """
 
     max_batch: int = 64
@@ -183,6 +203,7 @@ class ServeConfig:
     record_dispatches: bool = False
     dispatch_mode: str = "auto"
     late_admission: bool = True
+    journal_events: int = 0
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -200,11 +221,15 @@ class ServeConfig:
 
 class _Slot:
     """One unique (node_id, params_version) computation; every coalesced
-    request for it holds a reference and blocks on ``event``."""
+    request for it holds a reference and blocks on ``event``. ``rid`` is
+    the slot's journal request id (engine-monotonic; -1 when the engine
+    isn't journaling) — the key the lifecycle events thread through."""
 
-    __slots__ = ("node_id", "version", "event", "value", "error", "enqueue_t", "waiters")
+    __slots__ = ("node_id", "version", "event", "value", "error", "enqueue_t",
+                 "waiters", "rid")
 
-    def __init__(self, node_id: int, version: int, enqueue_t: float):
+    def __init__(self, node_id: int, version: int, enqueue_t: float,
+                 rid: int = -1):
         self.node_id = node_id
         self.version = version
         self.event = threading.Event()
@@ -212,6 +237,7 @@ class _Slot:
         self.error: Optional[BaseException] = None
         self.enqueue_t = enqueue_t
         self.waiters: List[float] = []  # submit timestamps, for latency
+        self.rid = rid
 
     def resolve(self, value: Optional[np.ndarray], error=None) -> None:
         self.value = value
@@ -344,7 +370,7 @@ class _Flush:
     dispatch; the split path carries the pre-run sample ``ds``."""
 
     __slots__ = ("keys", "slots", "params", "seeds", "bucket", "ds", "key",
-                 "padded", "error")
+                 "padded", "error", "fid")
 
     def __init__(self, keys, slots, params):
         self.keys = keys
@@ -356,6 +382,10 @@ class _Flush:
         self.key = None
         self.padded = None
         self.error: Optional[BaseException] = None
+        # journal flush id == the dispatch index `_seal_assembled` will
+        # draw (assemble and seal happen under one _seq hold, so nothing
+        # can interleave an increment between them)
+        self.fid = -1
 
 
 class ServeEngine:
@@ -403,6 +433,14 @@ class ServeEngine:
                     ) from exc
         self._clock = self.config.clock
         self.stats = ServeStats()
+        # request-lifecycle journal (ServeConfig.journal_events; the
+        # shared NULL_JOURNAL's emit is one attribute check when off)
+        self.journal = (
+            EventJournal(self.config.journal_events, clock=self._clock)
+            if self.config.journal_events > 0
+            else NULL_JOURNAL
+        )
+        self._next_rid = 0  # journal request ids (guarded by _lock)
         self.cache = EmbeddingCache(self.config.cache_entries,
                                     counters=self.stats.cache)
         self.params_version = 0
@@ -445,17 +483,24 @@ class ServeEngine:
         key = int(node_id)
         now = self._clock()
         need_flush = False
+        jr = self.journal
         with self._lock:
             self.stats.requests += 1
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
                 self.stats.latency.record_ms((self._clock() - now) * 1e3)
+                jr.emit("cache_hit", -1, -1, key)
                 return ServeResult(value=cached)
             slot = self._pending.get(key) or self._inflight.get(key)
             if slot is not None and slot.version == self.params_version:
                 self.stats.coalesced += 1
+                jr.emit("coalesce", slot.rid, -1, key)
             else:
-                slot = _Slot(key, self.params_version, now)
+                rid = -1
+                if jr.enabled:
+                    rid = self._next_rid
+                    self._next_rid += 1
+                slot = _Slot(key, self.params_version, now, rid=rid)
                 fl = self._open
                 if fl is not None and len(fl.keys) < fl.bucket:
                     # late admission into the open flush's pad slack (its
@@ -465,8 +510,10 @@ class ServeEngine:
                     fl.slots.append(slot)
                     self._inflight[key] = slot
                     self.stats.late_admitted += 1
+                    jr.emit("late_admit", rid, fl.fid, key)
                 else:
                     self._pending[key] = slot
+                    jr.emit("submit", rid, -1, key)
             slot.waiters.append(now)
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
@@ -527,6 +574,14 @@ class ServeEngine:
             self.stats.inflight_peak = max(
                 self.stats.inflight_peak, self._inflight_flushes
             )
+            jr = self.journal
+            if jr.enabled:
+                # the caller holds _seq, so the index _seal_assembled will
+                # draw is exactly the next one
+                fl.fid = self._dispatch_index + 1
+                for k, slot in zip(keys, slots):
+                    jr.emit("assemble", slot.rid, fl.fid, k)
+                jr.emit("flush", -1, fl.fid, len(keys), fl.bucket)
             if self.config.late_admission and len(keys) < fl.bucket:
                 self._open = fl
         return fl
@@ -541,6 +596,7 @@ class ServeEngine:
         with self._lock:
             self._open = None
         self._dispatch_index += 1
+        self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
             fl.seeds = np.asarray(fl.keys, dtype=np.int64)
             if self.config.max_in_flight == 1:
@@ -570,6 +626,7 @@ class ServeEngine:
         across flushes up to the window bound."""
         with self._lock:
             self.stats.dispatch_calls += 1
+        self.journal.emit("dispatch", -1, fl.fid, fl.bucket)
         if fl.ds is None and self._programs is not None:
             logits = np.asarray(
                 self._programs(fl.bucket, fl.params, fl.key, fl.padded)
@@ -582,6 +639,7 @@ class ServeEngine:
             n_exec = 2  # the sample leg ran in _seal_assembled
         with self._lock:
             self.stats.execute_calls += n_exec
+        self.journal.emit("execute_done", -1, fl.fid, n_exec)
         # rows of this array are handed to every waiter AND the cache;
         # read-only makes an in-place mutation by one caller a loud
         # ValueError instead of silently corrupting every later cache hit
@@ -620,6 +678,7 @@ class ServeEngine:
             self._inflight_flushes -= 1
             self._fence.notify_all()
             self.stats.spans.record("resolve", t_res0, self._clock())
+            self.journal.emit("resolve", -1, fl.fid, len(fl.keys))
 
     def flush(self) -> int:
         """Dispatch up to ``max_batch`` pending unique seeds as one bucket-
@@ -651,8 +710,13 @@ class ServeEngine:
                 if fl is None:
                     return 0
                 try:
+                    jr = self.journal
+                    t_w0 = self._clock() if jr.enabled else 0.0
                     self._window.acquire()
                     have_permit = True
+                    if jr.enabled:
+                        jr.emit("window_wait", -1, fl.fid,
+                                self._clock() - t_w0)
                     t0 = self._clock()
                     self._seal_assembled(fl)  # errors land in fl.error
                     self.stats.spans.record("assemble", t0, self._clock())
@@ -693,11 +757,90 @@ class ServeEngine:
         """Zero every counter/histogram AND re-point the embedding cache's
         counter at the fresh `ServeStats` (the two must move together — a
         bare ``stats.__init__()`` would leave the cache counting into the
-        detached old object). Benches call this after their warm-up pass;
-        cache CONTENTS are untouched (use `cache.invalidate()` for that)."""
+        detached old object). The journal ring is cleared with it — stale
+        lifecycle events would make breakdowns straddle the reset. Benches
+        call this after their warm-up pass; cache CONTENTS are untouched
+        (use `cache.invalidate()` for that). Registry adapters registered
+        by `register_metrics` follow the swap (they resolve through
+        ``self.stats`` at read time)."""
         with self._lock:
             self.stats = ServeStats()
             self.cache.counters = self.stats.cache
+            if self.journal.enabled:
+                self.journal.clear()
+
+    # -- observability surface --------------------------------------------
+
+    def register_metrics(self, registry: Optional[MetricsRegistry] = None,
+                         prefix: str = "quiver_serve",
+                         labels: Optional[Dict[str, str]] = None,
+                         ) -> MetricsRegistry:
+        """Adapt this engine's live state into a `trace.MetricsRegistry`
+        (created when not given): every `ServeStats` counter as a
+        callback-backed counter, the engine's QUEUE-STATE gauges (pending
+        depth, in-flight flushes/window/peak, cache rows, params version),
+        per-bucket dispatch counts (``bucket`` label), the embedding
+        cache's hit/miss/eviction family, and the live latency histogram.
+        Adapters READ the engine at exposition time — nothing is counted
+        twice, and `reset_stats` swaps are followed. Returns the
+        registry (``registry.to_prometheus()`` /
+        ``registry.snapshot()`` are the export surfaces)."""
+        reg = registry if registry is not None else MetricsRegistry()
+        for f in ("requests", "coalesced", "dispatches", "dispatched_seeds",
+                  "padded_seeds", "dispatch_calls", "execute_calls",
+                  "late_admitted"):
+            reg.counter_fn(f"{prefix}_{f}_total",
+                           (lambda f=f: getattr(self.stats, f)),
+                           f"ServeStats.{f}", labels)
+        reg.gauge_fn(f"{prefix}_pending_depth",
+                     lambda: len(self._pending),
+                     "unique seeds queued and not yet drained", labels)
+        reg.gauge_fn(f"{prefix}_inflight_flushes",
+                     lambda: self._inflight_flushes,
+                     "flushes between assemble and resolve now", labels)
+        reg.gauge_fn(f"{prefix}_inflight_window",
+                     lambda: self.config.max_in_flight,
+                     "configured max_in_flight bound", labels)
+        reg.gauge_fn(f"{prefix}_inflight_peak",
+                     lambda: self.stats.inflight_peak,
+                     "largest in-flight occupancy observed", labels)
+        reg.gauge_fn(f"{prefix}_cache_rows", lambda: len(self.cache),
+                     "embedding-cache resident rows", labels)
+        reg.gauge_fn(f"{prefix}_params_version",
+                     lambda: self.params_version,
+                     "current weights version", labels)
+        reg.gauge_fn(f"{prefix}_journal_events", lambda: len(self.journal),
+                     "lifecycle events in the journal ring", labels)
+        for b in self._buckets:
+            reg.counter_fn(
+                f"{prefix}_bucket_dispatches_total",
+                (lambda b=b: self.stats.dispatch_buckets.get(b, 0)),
+                "resolved dispatches by bucket shape",
+                dict(labels or {}, bucket=str(b)),
+            )
+        register_hit_rate(reg, f"{prefix}_cache", lambda: self.stats.cache,
+                          labels)
+        reg.histogram(f"{prefix}_latency_ms",
+                      "end-to-end request latency (submit -> resolve)",
+                      labels, fn=lambda: self.stats.latency)
+        return reg
+
+    def export_chrome_trace(self, path: str, extra_sources: Sequence = (),
+                            metadata: Optional[Dict[str, object]] = None,
+                            ) -> Dict[str, object]:
+        """Write a Perfetto/chrome://tracing-loadable ``trace_events``
+        timeline merging the engine's stage spans (``stats.spans``) and —
+        when journaling is on — the request-lifecycle journal (per-flush
+        lanes show overlapped in-flight flushes side by side). Spans and
+        journal share the engine clock, so the merge is one timeline, not
+        two guesses. ``extra_sources`` appends more (name, SpanRecorder |
+        EventJournal) pairs recorded on the same clock (e.g.
+        `comm` exchange spans)."""
+        sources: List = [("serve.spans", self.stats.spans)]
+        if self.journal.enabled:
+            sources.append(("serve.journal", self.journal))
+        sources.extend(extra_sources)
+        return _export_chrome_trace(path, sources, metadata)
 
     # -- warmup -----------------------------------------------------------
 

@@ -18,13 +18,21 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from collections import defaultdict
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 TRACE_ENV = "QUIVER_ENABLE_TRACE"
 
 _registry: Dict[str, Tuple[int, float]] = defaultdict(lambda: (0, 0.0))
+# trace_scope aggregation is a read-modify-write on _registry[name]; serve
+# pollers and client threads trace concurrently, so an unlocked update
+# loses counts (two threads read the same (cnt, tot) and one increment
+# vanishes). One process-wide lock covers the update AND the
+# trace_report(reset=True) snapshot-then-clear, which would otherwise drop
+# scopes landing between the dict copy and the clear.
+_registry_lock = threading.Lock()
 
 
 def trace_enabled() -> bool:
@@ -87,15 +95,20 @@ def trace_scope(name: str, sync=None) -> Iterator["_SyncBox"]:
 
             jax.block_until_ready(box.sync)
         dt = time.perf_counter() - t0
-        cnt, tot = _registry[name]
-        _registry[name] = (cnt + 1, tot + dt)
+        with _registry_lock:
+            cnt, tot = _registry[name]
+            _registry[name] = (cnt + 1, tot + dt)
 
 
 def trace_report(reset: bool = False) -> Dict[str, Tuple[int, float]]:
-    """Snapshot of aggregated scopes: {name: (count, total_seconds)}."""
-    out = dict(_registry)
-    if reset:
-        _registry.clear()
+    """Snapshot of aggregated scopes: {name: (count, total_seconds)}.
+    ``reset=True`` snapshots and clears ATOMICALLY (same lock as the scope
+    updates), so no concurrently-finishing scope falls between the copy
+    and the clear."""
+    with _registry_lock:
+        out = dict(_registry)
+        if reset:
+            _registry.clear()
     return out
 
 
@@ -163,7 +176,20 @@ def gbps(
 
 import bisect
 import math
-import threading
+
+
+def _snapshot_deque(dq) -> tuple:
+    """Consistent tuple copy of a deque under concurrent appends:
+    iterating a deque being mutated raises RuntimeError, so retry — a
+    handful of attempts always wins because each copy is a single C-level
+    pass. Shared by `SpanRecorder` and `EventJournal` so the retry
+    discipline has exactly one home."""
+    for _ in range(64):
+        try:
+            return tuple(dq)
+        except RuntimeError:
+            continue
+    return ()
 
 
 class SpanRecorder:
@@ -193,15 +219,7 @@ class SpanRecorder:
         self._spans.append((stage, t0, t1))
 
     def _snapshot(self) -> tuple:
-        # tuple(deque) iterates, and a deque iterator raises RuntimeError if
-        # the deque is appended to mid-iteration — retry; a handful of
-        # attempts always wins because each copy is a single C-level pass
-        for _ in range(64):
-            try:
-                return tuple(self._spans)
-            except RuntimeError:
-                continue
-        return ()
+        return _snapshot_deque(self._spans)
 
     def __iter__(self):
         return iter(self._snapshot())
@@ -432,6 +450,736 @@ class HitRateCounter:
             "evictions": self.evictions,
             "hit_rate": self.hit_rate,
         }
+
+
+# -- request-scoped lifecycle journal -----------------------------------------
+
+# One journal event is a fixed-arity tuple (t, kind, rid, fid, a, b):
+#   t    : seconds on the journal's monotonic clock (the engine's clock)
+#   kind : event name from EVENT_KINDS
+#   rid  : request/slot id (-1 when the event is per-flush)
+#   fid  : flush id == the engine's dispatch index (-1 when per-request
+#          and not yet attached to a flush)
+#   a, b : numeric payload (node id, bucket, counts, durations — per kind)
+# Fixed arity keeps emit() to one tuple build + one deque append, which is
+# what lets the journal stay ON in production serving.
+EVENT_KINDS = (
+    "submit",        # rid, -, a=node            new pending slot created
+    "cache_hit",     # -,   -, a=node            answered from the embedding cache
+    "coalesce",      # rid, -, a=node            waiter attached to an existing slot
+    "late_admit",    # rid, fid, a=node          rode an assembled flush's pad lane
+    "assemble",      # rid, fid, a=node          slot drained into flush fid
+    "flush",         # -, fid, a=n_drained, b=bucket   flush assembled (pre-seal)
+    "window_wait",   # -, fid, a=wait_seconds    in-flight window permit acquired
+    "seal",          # -, fid, a=n_final, b=bucket     admission closed, index drawn
+    "dispatch",      # -, fid, a=bucket          device work begins
+    "execute_done",  # -, fid, a=execute_calls   device work + D2H returned
+    "resolve",       # -, fid, a=n_resolved      slots resolved, stats landed
+)
+
+# rough per-event host bytes: 6-slot tuple + boxed floats/small ints. Used
+# only for the approx_bytes bound the rollover test pins — the real bound
+# is the event COUNT (deque maxlen).
+_EVENT_APPROX_BYTES = 160
+
+
+def _stage_stats(values: Sequence[float]) -> Dict[str, float]:
+    """{"p50", "p99", "mean", "n"} of a value list (empirical percentiles:
+    the k-th sorted sample at rank ceil(p/100*n)). The journal is bounded,
+    so materializing the sorted list is bounded too."""
+    vals = sorted(float(v) for v in values)
+    n = len(vals)
+    if not n:
+        return {"p50": 0.0, "p99": 0.0, "mean": 0.0, "n": 0}
+
+    def pick(p: float) -> float:
+        return vals[min(n - 1, max(0, math.ceil(p / 100.0 * n) - 1))]
+
+    return {
+        "p50": pick(50),
+        "p99": pick(99),
+        "mean": sum(vals) / n,
+        "n": n,
+    }
+
+
+class EventJournal:
+    """Bounded, lock-cheap ring buffer of structured lifecycle events on a
+    shared monotonic clock — the per-request observability spine of the
+    serve stack (ISSUE 7 tentpole).
+
+    The write path is ONE conditional + one tuple build + one
+    ``deque.append`` (atomic under the GIL), so serving threads never
+    contend on a lock to journal; the ring (``maxlen=capacity``) bounds
+    memory no matter how long the engine runs — the newest ``capacity``
+    events win, ``dropped`` counts what rolled off. ``snapshot()`` uses the
+    same retry-on-mutation discipline as `SpanRecorder.overlap_summary`:
+    emitters may append mid-copy and the copy retries.
+
+    OBSERVE-ONLY RULE: nothing in the engine reads the journal to make a
+    decision — events never feed control flow, which is why enabling the
+    journal provably changes no served bit (the replay-parity pin in
+    tests/test_obs.py). Keep it that way: a policy that wants these
+    numbers must consume them through an explicit, separately-tested knob.
+
+    ``enabled=False`` (or the shared :data:`NULL_JOURNAL`) makes ``emit``
+    a single attribute check — the near-zero disabled cost the serve
+    engines rely on.
+    """
+
+    __slots__ = ("capacity", "clock", "enabled", "dropped", "_events")
+
+    def __init__(self, capacity: int = 65536,
+                 clock: Callable[[], float] = time.monotonic,
+                 enabled: bool = True):
+        import collections
+
+        if capacity < 1:
+            raise ValueError("EventJournal capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.clock = clock
+        self.enabled = bool(enabled)
+        self.dropped = 0  # events rolled off the ring (approximate: unlocked)
+        self._events = collections.deque(maxlen=self.capacity)
+
+    @property
+    def approx_bytes(self) -> int:
+        """Upper bound on the ring's event storage (capacity * per-event
+        estimate) — the byte half of the rollover bound."""
+        return self.capacity * _EVENT_APPROX_BYTES
+
+    def emit(self, kind: str, rid: int = -1, fid: int = -1,
+             a: float = 0, b: float = 0) -> None:
+        if not self.enabled:
+            return
+        ev = self._events
+        if len(ev) == self.capacity:
+            self.dropped += 1
+        ev.append((self.clock(), kind, rid, fid, a, b))
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def __bool__(self) -> bool:
+        return bool(self._events)
+
+    def __iter__(self):
+        return iter(self.snapshot())
+
+    def clear(self) -> None:
+        self._events.clear()
+        self.dropped = 0
+
+    def snapshot(self) -> Tuple:
+        """Consistent tuple copy of the ring (`_snapshot_deque`: the
+        retry-on-concurrent-append discipline shared with
+        `SpanRecorder`)."""
+        return _snapshot_deque(self._events)
+
+    def request_breakdown(self) -> Dict[str, object]:
+        """Per-request per-stage latency percentiles + per-flush pad
+        occupancy, computed from the journaled lifecycle events — the
+        numbers late admission and QoS policies are judged by.
+
+        Stages (per request, ms): ``queue_ms`` (submit/coalesce/late-admit
+        -> its flush's dispatch), ``device_ms`` (dispatch -> execute-done
+        of the flush it rode), ``resolve_ms`` (execute-done -> resolve).
+        Per-flush: ``pad_frac`` ((bucket - n_final)/bucket — the slack
+        late admission exists to recover), ``window_wait_ms``. Requests
+        whose flush rolled off the ring (or never dispatched yet) are
+        skipped, not guessed."""
+        flushes: Dict[int, Dict[str, float]] = {}
+        reqs: List[Tuple[float, int]] = []  # (submit_t, fid) once linked
+        pending_rid: Dict[int, float] = {}  # rid -> earliest submit_t seen
+        rid_extra: Dict[int, List[float]] = {}  # rid -> later waiter times
+        rid_fid: Dict[int, int] = {}  # rid -> flush once assembled/admitted
+        cache_hits = 0
+        for (t, kind, rid, fid, a, b) in self.snapshot():
+            if kind in ("submit", "coalesce"):
+                linked = rid_fid.get(rid)
+                if linked is not None:
+                    # coalesced onto an ALREADY-assembled (in-flight) slot:
+                    # link straight to its flush — these are exactly the
+                    # hot-key waiters saturated load produces, and dropping
+                    # them would bias queue_ms low (their queue wait clamps
+                    # to 0 below when they attached after the dispatch)
+                    reqs.append((t, linked))
+                elif rid in pending_rid or rid in rid_extra:
+                    rid_extra.setdefault(rid, []).append(t)
+                else:
+                    pending_rid[rid] = t
+            elif kind == "cache_hit":
+                cache_hits += 1
+            elif kind in ("late_admit", "assemble"):
+                rid_fid[rid] = fid
+                if kind == "late_admit" and rid not in pending_rid:
+                    pending_rid[rid] = t
+                t0 = pending_rid.pop(rid, None)
+                if t0 is not None:
+                    reqs.append((t0, fid))
+                for tw in rid_extra.pop(rid, ()):  # coalesced co-waiters
+                    reqs.append((tw, fid))
+            else:
+                f = flushes.setdefault(fid, {})
+                if kind == "flush":
+                    f["n_drained"], f["bucket"] = a, b
+                elif kind == "window_wait":
+                    f["window_wait_s"] = a
+                elif kind == "seal":
+                    f["n_final"], f["bucket"] = a, b
+                elif kind == "dispatch":
+                    f["dispatch_t"] = t
+                elif kind == "execute_done":
+                    f["execute_done_t"] = t
+                elif kind == "resolve":
+                    f["resolve_t"] = t
+        queue_ms: List[float] = []
+        device_ms: List[float] = []
+        resolve_ms: List[float] = []
+        for t0, fid in reqs:
+            f = flushes.get(fid)
+            if not f or "dispatch_t" not in f:
+                continue  # flush rolled off the ring or still in flight
+            # clamp: a waiter that coalesced onto a flush already past its
+            # dispatch point waited zero queue time, not negative
+            queue_ms.append(max(f["dispatch_t"] - t0, 0.0) * 1e3)
+            if "execute_done_t" in f:
+                device_ms.append((f["execute_done_t"] - f["dispatch_t"]) * 1e3)
+                if "resolve_t" in f:
+                    resolve_ms.append(
+                        (f["resolve_t"] - f["execute_done_t"]) * 1e3
+                    )
+        pad_fracs = [
+            (f["bucket"] - f["n_final"]) / f["bucket"]
+            for f in flushes.values()
+            if f.get("bucket") and "n_final" in f
+        ]
+        waits_ms = [
+            f["window_wait_s"] * 1e3
+            for f in flushes.values()
+            if "window_wait_s" in f
+        ]
+        return {
+            "requests": len(queue_ms),
+            "cache_hits": cache_hits,
+            "flushes": len([f for f in flushes.values() if "dispatch_t" in f]),
+            "queue_ms": _stage_stats(queue_ms),
+            "device_ms": _stage_stats(device_ms),
+            "resolve_ms": _stage_stats(resolve_ms),
+            "window_wait_ms": _stage_stats(waits_ms),
+            "pad_frac": _stage_stats(pad_fracs),
+            "dropped_events": self.dropped,
+        }
+
+
+class _NullJournal(EventJournal):
+    """Shared disabled journal: ``emit`` is one attribute check. Engines
+    hold this when journaling is off, so the hot path never branches on
+    None."""
+
+    def __init__(self):
+        super().__init__(capacity=1, enabled=False)
+
+    def emit(self, *_a, **_k) -> None:
+        return
+
+
+NULL_JOURNAL = _NullJournal()
+
+
+# -- unified metrics registry --------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric names allow [a-zA-Z_:][a-zA-Z0-9_:]*; map the
+    registry's dotted spellings onto it."""
+    s = "".join(ch if ch.isalnum() or ch in "_:" else "_" for ch in name)
+    return "_" + s if s and s[0].isdigit() else s
+
+
+def _prom_value(v) -> str:
+    """Full-precision Prometheus sample value: integers verbatim, floats
+    via repr. ``%g`` would round to 6 significant digits — a byte counter
+    past 1e6 would expose stale rounded values and break rate()."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2**63:
+        return str(int(f))
+    return repr(f)
+
+
+def _prom_label_value(v) -> str:
+    """Escape a label value per the Prometheus text format (backslash,
+    double quote, newline) — one bad value must not invalidate the whole
+    exposition."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _prom_labels(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_prom_name(k)}="{_prom_label_value(v)}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class CounterMetric:
+    """Monotonic counter. ``inc`` is locked (multi-thread emitters);
+    callback-backed counters (``fn``) read a live source at snapshot time
+    instead — that is how existing `ServeStats` counts are ADAPTED into
+    the registry without double-counting state."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "labels", "_lock", "_value", "_fn")
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None,
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels) if labels else None
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn = fn
+
+    def inc(self, n: float = 1) -> None:
+        if self._fn is not None:
+            raise ValueError(f"counter {self.name} is callback-backed")
+        if n < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return float(self._fn()) if self._fn is not None else self._value
+
+    def expose(self) -> List[str]:
+        return [f"{_prom_name(self.name)}{_prom_labels(self.labels)} "
+                f"{_prom_value(self.value)}"]
+
+
+class GaugeMetric:
+    """Point-in-time value: ``set`` stores, or a callback reads the live
+    source at snapshot time (queue depths, cache sizes — state the engine
+    already holds; the adapter registers a reader, never a copy)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "labels", "_value", "_fn")
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None,
+                 fn: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels) if labels else None
+        self._value = 0.0
+        self._fn = fn
+
+    def set(self, v: float) -> None:
+        if self._fn is not None:
+            raise ValueError(f"gauge {self.name} is callback-backed")
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return float(self._fn()) if self._fn is not None else self._value
+
+    def expose(self) -> List[str]:
+        return [f"{_prom_name(self.name)}{_prom_labels(self.labels)} "
+                f"{_prom_value(self.value)}"]
+
+
+class HistogramMetric:
+    """A `LatencyHistogram` under a registry name. ``observe`` records
+    into it; an ADAPTED histogram (``hist=`` an existing engine histogram,
+    or ``fn=`` a callable resolving one — engines whose ``reset_stats``
+    swaps the stats object register a resolver so the exposition always
+    reads the LIVE histogram) exposes that object — one set of buckets,
+    two views."""
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "labels", "_hist", "_fn")
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None,
+                 hist: Optional[LatencyHistogram] = None,
+                 fn: Optional[Callable[[], LatencyHistogram]] = None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels) if labels else None
+        self._fn = fn
+        self._hist = (
+            None if fn is not None
+            else (hist if hist is not None else LatencyHistogram())
+        )
+
+    @property
+    def hist(self) -> LatencyHistogram:
+        return self._fn() if self._fn is not None else self._hist
+
+    def observe(self, v: float) -> None:
+        self.hist.record_ms(v)
+
+    @property
+    def value(self) -> Dict[str, float]:
+        return self.hist.snapshot()
+
+    def expose(self) -> List[str]:
+        """Prometheus histogram exposition: CUMULATIVE bucket counts by
+        upper edge, then sum and count. Taken under the histogram's lock
+        so the three agree."""
+        h = self.hist
+        base = _prom_name(self.name)
+        lab = self.labels or {}
+        with h._lock:
+            counts = list(h._counts)
+            total = h.count
+            s = h.sum_ms
+        lines = []
+        acc = 0
+        for edge, c in zip(h._edges, counts):
+            acc += c
+            le = dict(lab, le=f"{edge:g}")
+            lines.append(f"{base}_bucket{_prom_labels(le)} {acc}")
+        lines.append(
+            f"{base}_bucket{_prom_labels(dict(lab, le='+Inf'))} {total}"
+        )
+        lines.append(f"{base}_sum{_prom_labels(lab or None)} {_prom_value(s)}")
+        lines.append(f"{base}_count{_prom_labels(lab or None)} {total}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms with one JSON snapshot and one
+    Prometheus text exposition — the single pane the serve stack's
+    scattered stat objects (`ServeStats`, `DistServeStats`,
+    `PipelineStats`, `HitRateCounter`) adapt INTO (adapters register
+    callback-backed metrics reading the live objects; nothing is counted
+    twice).
+
+    Naming convention (docs/api.md "Observability"):
+    ``quiver_<subsystem>_<metric>`` with ``_total`` for counters and a
+    unit suffix (``_ms``, ``_bytes``, ``_rows``) elsewhere; instance
+    dimensions (shard host, bucket) ride LABELS, not name suffixes.
+    Registration is idempotent for an identical (name, labels, kind) and
+    a hard error for a kind clash — two subsystems silently sharing a
+    name is how dashboards lie."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple], object] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]) -> Tuple[str, Tuple]:
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def _register(self, cls, name, help, labels, **kw):
+        key = self._key(name, labels)
+        with self._lock:
+            existing = self._metrics.get(key)
+            if existing is not None:
+                if existing.kind != cls.kind:
+                    raise ValueError(
+                        f"metric {name!r}{labels or ''} already registered "
+                        f"as {existing.kind}, not {cls.kind}"
+                    )
+                # re-registering a callback/adapted metric RE-POINTS it at
+                # the new source (last writer wins): an operator who
+                # rebuilds an engine and re-registers into a long-lived
+                # registry must not keep scraping the dead engine's frozen
+                # closures. Stored-value metrics keep their state.
+                fn = kw.get("fn")
+                if fn is not None:
+                    existing._fn = fn
+                    if cls is HistogramMetric:
+                        existing._hist = None
+                elif cls is HistogramMetric and kw.get("hist") is not None:
+                    existing._hist = kw["hist"]
+                    existing._fn = None
+                return existing
+            m = cls(name, help=help, labels=labels, **kw)
+            self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> CounterMetric:
+        return self._register(CounterMetric, name, help, labels)
+
+    def counter_fn(self, name: str, fn: Callable[[], float], help: str = "",
+                   labels: Optional[Dict[str, str]] = None) -> CounterMetric:
+        return self._register(CounterMetric, name, help, labels, fn=fn)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> GaugeMetric:
+        return self._register(GaugeMetric, name, help, labels)
+
+    def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "",
+                 labels: Optional[Dict[str, str]] = None) -> GaugeMetric:
+        return self._register(GaugeMetric, name, help, labels, fn=fn)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Optional[Dict[str, str]] = None,
+                  hist: Optional[LatencyHistogram] = None,
+                  fn: Optional[Callable[[], LatencyHistogram]] = None,
+                  ) -> HistogramMetric:
+        return self._register(
+            HistogramMetric, name, help, labels, hist=hist, fn=fn
+        )
+
+    def metrics(self) -> List[object]:
+        """All registered metrics in registration order (dict order is
+        insertion order — DETERMINISTIC, which is what makes two
+        expositions of one registry diff cleanly)."""
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-able {name or name{labels}: value} — histograms expand to
+        their summary dicts."""
+        out: Dict[str, object] = {}
+        for m in self.metrics():
+            out[f"{m.name}{_prom_labels(m.labels)}"] = m.value
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (one # HELP/# TYPE header per metric
+        family, families in registration order, label rows grouped under
+        their family)."""
+        lines: List[str] = []
+        by_family: Dict[str, List[object]] = {}
+        order: List[str] = []
+        for m in self.metrics():
+            if m.name not in by_family:
+                by_family[m.name] = []
+                order.append(m.name)
+            by_family[m.name].append(m)
+        for name in order:
+            family = by_family[name]
+            kinds = {m.kind for m in family}
+            if len(kinds) > 1:  # _register forbids this; belt and braces
+                raise ValueError(f"metric family {name!r} mixes kinds {kinds}")
+            if family[0].help:
+                lines.append(f"# HELP {_prom_name(name)} {family[0].help}")
+            lines.append(f"# TYPE {_prom_name(name)} {family[0].kind}")
+            for m in family:
+                lines.extend(m.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def register_hit_rate(registry: MetricsRegistry, name: str,
+                      counter,
+                      labels: Optional[Dict[str, str]] = None) -> None:
+    """Adapt a live `HitRateCounter` into ``registry`` as
+    ``<name>_{hits,misses,evictions}_total`` + ``<name>_hit_rate`` —
+    callback-backed, so the counter keeps counting into itself and the
+    registry reads it at snapshot time. ``counter`` may be the counter
+    itself or a zero-arg resolver (engines whose ``reset_stats`` swaps
+    the stats object pass a resolver so the registry follows the swap)."""
+    get = counter if callable(counter) else (lambda: counter)
+    registry.counter_fn(f"{name}_hits_total", lambda: get().hits,
+                        "cache hits", labels)
+    registry.counter_fn(f"{name}_misses_total", lambda: get().misses,
+                        "cache misses", labels)
+    registry.counter_fn(f"{name}_evictions_total", lambda: get().evictions,
+                        "cache evictions", labels)
+    registry.gauge_fn(f"{name}_hit_rate", lambda: get().hit_rate,
+                      "hits / (hits + misses)", labels)
+
+
+# -- Chrome-trace (Perfetto) export -------------------------------------------
+
+
+def _assign_lanes(intervals: Sequence[Tuple[float, float]]) -> List[int]:
+    """Greedy interval-graph coloring: lane of each (t0, t1) such that
+    overlapping intervals get distinct lanes. This is what renders
+    OVERLAPPED in-flight flushes as parallel tracks instead of nested
+    slices — the timeline's whole point."""
+    order = sorted(range(len(intervals)), key=lambda i: intervals[i][0])
+    lane_free: List[float] = []  # lane -> time it frees up
+    lanes = [0] * len(intervals)
+    for i in order:
+        t0, t1 = intervals[i]
+        for ln, free in enumerate(lane_free):
+            if free <= t0:
+                lane_free[ln] = t1
+                lanes[i] = ln
+                break
+        else:
+            lanes[i] = len(lane_free)
+            lane_free.append(t1)
+    return lanes
+
+
+def chrome_trace_events(
+    sources: Sequence[Tuple[str, object]],
+    time_origin: Optional[float] = None,
+) -> List[Dict[str, object]]:
+    """Merge span/journal sources into Chrome ``trace_events`` dicts.
+
+    ``sources`` is [(process_name, source)] where a source is a
+    `SpanRecorder` (or any iterable of (stage, t0, t1) triples) or an
+    `EventJournal`. Each source becomes one pid; stage names (and journal
+    flush lanes) become named tids. All sources must share one monotonic
+    clock (the serve stack's engines/journals/comm spans all do);
+    ``time_origin`` (default: earliest timestamp seen) rebases ts to 0.
+
+    Journal rendering: per-flush lifecycle becomes complete ("X") slices —
+    ``flush <fid>`` spanning seal->resolve on a per-overlap lane (so
+    concurrent in-flight flushes sit side by side), with ``device`` and
+    ``resolve`` sub-slices — and per-request events (submit / cache_hit /
+    coalesce / late_admit) become instants ("i") on one requests track.
+    """
+    spans_by_pid: List[Tuple[int, str, List[Tuple[str, float, float]]]] = []
+    instants: List[Tuple[int, float, str, Dict[str, object]]] = []
+    flush_slices: List[Tuple[int, float, float, str, Dict[str, object], int]] = []
+    # an EXPLICIT origin is honored verbatim (callers aligning several
+    # exports on one shared clock); only when absent is the earliest
+    # timestamp used
+    explicit_origin = time_origin is not None
+    t_min = time_origin
+    for pid, (pname, src) in enumerate(sources):
+        if isinstance(src, EventJournal):
+            flushes: Dict[int, Dict[str, float]] = {}
+            for (t, kind, rid, fid, a, b) in src.snapshot():
+                if not explicit_origin and (t_min is None or t < t_min):
+                    t_min = t
+                if kind in ("submit", "cache_hit", "coalesce", "late_admit"):
+                    instants.append(
+                        (pid, t, kind, {"rid": rid, "node": a, "fid": fid})
+                    )
+                elif fid >= 0:
+                    f = flushes.setdefault(fid, {})
+                    if kind == "flush":
+                        f["assemble_t"], f["n_drained"], f["bucket"] = t, a, b
+                    elif kind == "seal":
+                        f["seal_t"], f["n_final"], f["bucket"] = t, a, b
+                    elif kind == "window_wait":
+                        f["window_wait_s"] = a
+                    elif kind == "dispatch":
+                        f["dispatch_t"] = t
+                    elif kind == "execute_done":
+                        f["execute_done_t"] = t
+                    elif kind == "resolve":
+                        f["resolve_t"] = t
+            items = []
+            for fid, f in sorted(flushes.items()):
+                t0 = f.get("assemble_t", f.get("seal_t"))
+                t1 = f.get("resolve_t", f.get("execute_done_t"))
+                if t0 is None or t1 is None:
+                    continue  # incomplete at snapshot time / rolled off
+                args = {
+                    "fid": fid,
+                    "n": f.get("n_final", f.get("n_drained", 0)),
+                    "bucket": f.get("bucket", 0),
+                    "window_wait_ms": round(
+                        f.get("window_wait_s", 0.0) * 1e3, 3
+                    ),
+                }
+                subs = []
+                if "dispatch_t" in f and "execute_done_t" in f:
+                    subs.append(
+                        ("device", f["dispatch_t"], f["execute_done_t"])
+                    )
+                if "execute_done_t" in f and "resolve_t" in f:
+                    subs.append(
+                        ("resolve", f["execute_done_t"], f["resolve_t"])
+                    )
+                items.append((fid, t0, t1, args, subs))
+            lanes = _assign_lanes([(t0, t1) for _, t0, t1, _, _ in items])
+            for (fid, t0, t1, args, subs), lane in zip(items, lanes):
+                flush_slices.append(
+                    (pid, t0, t1, f"flush {fid}", args, lane)
+                )
+                for sname, st0, st1 in subs:
+                    flush_slices.append((pid, st0, st1, sname, {}, lane))
+            spans_by_pid.append((pid, pname, []))
+        else:
+            triples = [tuple(s) for s in src]
+            if not explicit_origin:
+                for _, t0, _t1 in triples:
+                    if t_min is None or t0 < t_min:
+                        t_min = t0
+            spans_by_pid.append((pid, pname, triples))
+    t_min = t_min or 0.0
+
+    def us(t: float) -> float:
+        return round((t - t_min) * 1e6, 3)
+
+    events: List[Dict[str, object]] = []
+    tids: Dict[Tuple[int, str], int] = {}
+
+    def tid_for(pid: int, track: str) -> int:
+        key = (pid, track)
+        if key not in tids:
+            tids[key] = len([k for k in tids if k[0] == pid])
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid,
+                "tid": tids[key], "args": {"name": track},
+            })
+        return tids[key]
+
+    for pid, pname, _ in spans_by_pid:
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": pname},
+        })
+    for pid, pname, triples in spans_by_pid:
+        # per-stage tracks; same-stage spans that overlap (concurrent
+        # flush callers) fan out to numbered lanes
+        by_stage: Dict[str, List[Tuple[float, float]]] = {}
+        for stage, t0, t1 in triples:
+            by_stage.setdefault(stage, []).append((t0, t1))
+        for stage, iv in by_stage.items():
+            lanes = _assign_lanes(iv)
+            for (t0, t1), lane in zip(iv, lanes):
+                track = stage if lane == 0 else f"{stage}/{lane}"
+                events.append({
+                    "name": stage, "ph": "X", "ts": us(t0),
+                    "dur": round(max(t1 - t0, 0.0) * 1e6, 3),
+                    "pid": pid, "tid": tid_for(pid, track), "cat": "span",
+                })
+    for pid, t0, t1, name, args, lane in flush_slices:
+        track = "flushes" if lane == 0 else f"flushes/{lane}"
+        events.append({
+            "name": name, "ph": "X", "ts": us(t0),
+            "dur": round(max(t1 - t0, 0.0) * 1e6, 3),
+            "pid": pid, "tid": tid_for(pid, track), "cat": "flush",
+            "args": args,
+        })
+    for pid, t, kind, args in instants:
+        events.append({
+            "name": kind, "ph": "i", "ts": us(t), "s": "t",
+            "pid": pid, "tid": tid_for(pid, "requests"), "cat": "request",
+            "args": args,
+        })
+    return events
+
+
+def export_chrome_trace(
+    path: str,
+    sources: Sequence[Tuple[str, object]],
+    metadata: Optional[Dict[str, object]] = None,
+) -> Dict[str, object]:
+    """Write a Chrome ``trace_events`` JSON (Perfetto / chrome://tracing
+    loadable) merging the given span/journal sources — see
+    :func:`chrome_trace_events` for the source contract. Returns the
+    document (also written to ``path`` when non-empty)."""
+    import json
+
+    doc: Dict[str, object] = {
+        "traceEvents": chrome_trace_events(sources),
+        "displayTimeUnit": "ms",
+    }
+    if metadata:
+        doc["metadata"] = metadata
+    if path:
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+    return doc
 
 
 # -- jax profiler pass-throughs ----------------------------------------------

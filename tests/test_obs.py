@@ -1,0 +1,513 @@
+"""Request-scoped observability tests (ISSUE 7): the lifecycle
+`EventJournal`, the unified `MetricsRegistry`, the Chrome-trace export,
+and the trace_scope aggregation-race fix.
+
+The load-bearing contracts:
+
+- the journal is a BOUNDED ring (newest events win under a byte/count
+  bound) whose snapshot stays consistent under concurrent emitters (same
+  retry discipline as `SpanRecorder.overlap_summary`);
+- `request_breakdown()` yields per-stage p50/p99 + per-flush pad
+  occupancy from a real engine run;
+- the exported timeline is valid Chrome ``trace_events`` JSON;
+- OBSERVE-ONLY: enabling the journal + registry changes no served logit
+  bit and no dispatch-log byte (the replay rule — observation never feeds
+  control flow);
+- `trace_scope` aggregation is exact under concurrent scopes (the
+  round-12 race fix: unlocked read-modify-write lost counts).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu import trace as qtrace
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import ServeConfig, ServeEngine, zipfian_trace
+from quiver_tpu.trace import (
+    EventJournal,
+    MetricsRegistry,
+    NULL_JOURNAL,
+    SpanRecorder,
+    chrome_trace_events,
+    export_chrome_trace,
+    register_hit_rate,
+    trace_report,
+    trace_scope,
+)
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+
+
+def make_sampler():
+    topo = CSRTopo(edge_index=make_random_graph(N_NODES, 2000, seed=0))
+    return GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SAMPLER_SEED)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    sampler = make_sampler()
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_engine(setup, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("buckets", (8,))
+    return ServeEngine(model, params, make_sampler(), feat, ServeConfig(**cfg_kw))
+
+
+# -- trace_scope race fix -----------------------------------------------------
+
+
+def test_trace_scope_threaded_counts_exact(monkeypatch):
+    """The round-12 fix: N threads x M scopes must aggregate to exactly
+    N*M counts. The old unlocked read-modify-write at trace.py lost
+    increments whenever two scopes finished together (serve pollers +
+    client threads both trace)."""
+    monkeypatch.setenv(qtrace.TRACE_ENV, "1")
+    trace_report(reset=True)
+    threads, per_thread = 8, 400
+
+    def worker():
+        for _ in range(per_thread):
+            with trace_scope("obs_race_scope"):
+                pass
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    cnt, tot = trace_report(reset=True)["obs_race_scope"]
+    assert cnt == threads * per_thread
+    assert tot >= 0.0
+
+
+def test_trace_report_reset_atomic_under_concurrent_scopes(monkeypatch):
+    """Counts harvested across periodic reset=True reports plus the final
+    leftovers must equal exactly what the threads recorded — a scope
+    finishing between the snapshot and the clear must not vanish."""
+    monkeypatch.setenv(qtrace.TRACE_ENV, "1")
+    trace_report(reset=True)
+    threads, per_thread = 4, 500
+    harvested = []
+    stop = threading.Event()
+
+    def reaper():
+        while not stop.is_set():
+            rep = trace_report(reset=True)
+            if "obs_reset_scope" in rep:
+                harvested.append(rep["obs_reset_scope"][0])
+
+    def worker():
+        for _ in range(per_thread):
+            with trace_scope("obs_reset_scope"):
+                pass
+
+    r = threading.Thread(target=reaper)
+    r.start()
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    stop.set()
+    r.join()
+    rep = trace_report(reset=True)
+    leftover = rep.get("obs_reset_scope", (0, 0.0))[0]
+    assert sum(harvested) + leftover == threads * per_thread
+
+
+# -- EventJournal -------------------------------------------------------------
+
+
+def test_journal_rollover_keeps_newest_under_bound():
+    j = EventJournal(capacity=64)
+    for i in range(1000):
+        j.emit("submit", i, -1, i)
+    assert len(j) == 64
+    assert j.dropped == 1000 - 64
+    evs = j.snapshot()
+    # newest events won: rids are the last 64 emitted, in order
+    assert [e[2] for e in evs] == list(range(1000 - 64, 1000))
+    # the byte bound is capacity-proportional, not traffic-proportional
+    assert j.approx_bytes < 64 * 1024
+
+
+def test_journal_snapshot_consistent_under_concurrent_emit():
+    j = EventJournal(capacity=512)
+    stop = threading.Event()
+    bad = []
+
+    def emitter(tid):
+        i = 0
+        while not stop.is_set():
+            j.emit("submit", tid * 1_000_000 + i, -1, i)
+            i += 1
+
+    def snapshotter():
+        for _ in range(300):
+            for ev in j.snapshot():
+                if len(ev) != 6 or ev[1] != "submit":
+                    bad.append(ev)
+
+    ts = [threading.Thread(target=emitter, args=(k,)) for k in range(3)]
+    s = threading.Thread(target=snapshotter)
+    [t.start() for t in ts]
+    s.start()
+    s.join()
+    stop.set()
+    [t.join() for t in ts]
+    assert not bad
+
+
+def test_null_journal_emit_is_noop():
+    before = len(NULL_JOURNAL)
+    NULL_JOURNAL.emit("submit", 1, 2, 3)
+    assert len(NULL_JOURNAL) == before == 0
+    assert not NULL_JOURNAL.enabled
+
+
+def test_request_breakdown_from_engine_run(setup):
+    eng = make_engine(setup, journal_events=4096)
+    eng.warmup()
+    trace = zipfian_trace(N_NODES, 64, alpha=0.9, seed=7)
+    eng.predict(trace)
+    bd = eng.journal.request_breakdown()
+    # every journaled flush carries pad occupancy; stages are measured
+    assert bd["flushes"] == eng.stats.dispatches > 0
+    assert bd["pad_frac"]["n"] == bd["flushes"]
+    assert 0.0 <= bd["pad_frac"]["p50"] <= 1.0
+    assert bd["requests"] > 0
+    for stage in ("queue_ms", "device_ms", "resolve_ms"):
+        assert bd[stage]["n"] > 0
+        assert bd[stage]["p99"] >= bd[stage]["p50"] >= 0.0
+    # device time is real work on this box, not a zero-width stamp
+    assert bd["device_ms"]["p50"] > 0.0
+    # every submit journaled exactly one outcome; in this single-threaded
+    # deterministic drive every non-cache-hit outcome links to a dispatched
+    # flush, so breakdown requests + cache hits account for the whole trace
+    assert bd["cache_hits"] == eng.stats.cache.hits
+    assert bd["requests"] + bd["cache_hits"] == len(trace)
+
+
+def test_journal_breakdown_accounts_late_admission(setup):
+    """A late-admitted seed gets the same rid->fid link as a drained one:
+    the breakdown must count it as a request riding its flush."""
+    eng = make_engine(setup, journal_events=4096, max_in_flight=1,
+                      late_admission=True)
+    eng.warmup()
+    eng.predict([1, 2, 3])  # normal flush
+    # open a flush by hand: submit then drain under _seq while injecting a
+    # late arrival through the public submit path
+    h1 = eng.submit(10)
+    with eng._seq:
+        fl = eng._assemble()
+        assert fl is not None
+        h2 = eng.submit(11)  # lands in the open flush's pad lanes
+        assert eng.stats.late_admitted == 1
+        eng._window.acquire()
+        eng._seal_assembled(fl)
+    logits = eng._dispatch(fl)
+    eng._resolve(fl, logits)
+    eng._window.release()
+    assert h1.result(5) is not None and h2.result(5) is not None
+    bd = eng.journal.request_breakdown()
+    kinds = [e[1] for e in eng.journal.snapshot()]
+    assert "late_admit" in kinds
+    # both the drained and the late-admitted request are in the breakdown
+    assert bd["requests"] >= 5
+
+
+def test_breakdown_links_coalesce_onto_inflight_slot():
+    """A waiter coalescing onto an ALREADY-assembled slot must still
+    count in the breakdown, linked to that slot's flush with queue wait
+    clamped at 0 — dropping it would bias queue_ms low under exactly the
+    hot-key saturated load the journal exists to measure."""
+    j = EventJournal(capacity=64, clock=lambda: 0.0)
+    for ev in [
+        (0.0, "submit", 1, -1, 7, 0),
+        (1.0, "assemble", 1, 5, 7, 0),
+        (1.0, "flush", -1, 5, 1, 8),
+        (2.0, "seal", -1, 5, 1, 8),
+        (3.0, "dispatch", -1, 5, 8, 0),
+        (4.0, "coalesce", 1, -1, 7, 0),  # attaches AFTER dispatch began
+        (5.0, "execute_done", -1, 5, 1, 0),
+        (6.0, "resolve", -1, 5, 1, 0),
+    ]:
+        j._events.append(ev)
+    bd = j.request_breakdown()
+    assert bd["requests"] == 2  # the original submit AND the late coalesce
+    assert bd["queue_ms"]["n"] == 2
+    assert bd["queue_ms"]["p99"] == 3000.0  # submit waited 3 s to dispatch
+    assert bd["queue_ms"]["p50"] == 0.0     # mid-flight coalesce clamps to 0
+
+
+def test_chrome_trace_honors_explicit_time_origin():
+    """An explicit time_origin is the rebase point verbatim — even when
+    events predate it — so two exports sharing one origin stay aligned."""
+    sr = SpanRecorder()
+    sr.record("s", 100.0, 101.0)
+    ts = [e["ts"] for e in chrome_trace_events([("p", sr)], time_origin=90.0)
+          if e["ph"] == "X"]
+    assert ts == [pytest.approx(10e6)]
+    ts_before = [
+        e["ts"]
+        for e in chrome_trace_events([("p", sr)], time_origin=100.5)
+        if e["ph"] == "X"
+    ]
+    assert ts_before == [pytest.approx(-0.5e6)]  # not silently re-min'ed
+
+
+# -- observe-only: enabling the journal changes no bits -----------------------
+
+
+def test_journal_enabled_replay_parity_pin(setup):
+    """THE observe-only pin: the same deterministic trace through a
+    journal+registry-enabled engine and a bare one must produce
+    bit-identical logits AND byte-identical dispatch logs. If this fails,
+    observation leaked into control flow — breaking the replay rule every
+    parity test in this repo rides."""
+    trace = zipfian_trace(N_NODES, 96, alpha=1.1, seed=11)
+    eng_on = make_engine(setup, journal_events=4096)
+    eng_on.warmup()
+    eng_on.register_metrics()  # adapters installed during the run
+    out_on = np.asarray(eng_on.predict(trace))
+    eng_off = make_engine(setup)
+    eng_off.warmup()
+    out_off = np.asarray(eng_off.predict(trace))
+    assert np.array_equal(out_on, out_off)
+    assert len(eng_on.dispatch_log) == len(eng_off.dispatch_log)
+    for (p_on, n_on), (p_off, n_off) in zip(
+        eng_on.dispatch_log, eng_off.dispatch_log
+    ):
+        assert n_on == n_off
+        assert np.array_equal(p_on, p_off)
+    # and the journal actually observed the run
+    assert len(eng_on.journal) > 0
+    assert eng_on.journal.request_breakdown()["flushes"] > 0
+
+
+# -- MetricsRegistry ----------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("quiver_test_requests_total", "reqs")
+    c.inc()
+    c.inc(4)
+    reg.gauge_fn("quiver_test_depth", lambda: 7)
+    g = reg.gauge("quiver_test_level")
+    g.set(2.5)
+    h = reg.histogram("quiver_test_latency_ms")
+    h.observe(1.0)
+    h.observe(100.0)
+    snap = reg.snapshot()
+    assert snap["quiver_test_requests_total"] == 5
+    assert snap["quiver_test_depth"] == 7
+    assert snap["quiver_test_level"] == 2.5
+    assert snap["quiver_test_latency_ms"]["count"] == 2
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        reg.gauge("quiver_test_requests_total")  # kind clash is a hard error
+    # idempotent re-registration returns the same object
+    assert reg.counter("quiver_test_requests_total") is c
+
+
+def test_registry_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("quiver_test_total", "help text").inc(3)
+    reg.gauge("quiver_test_depth", labels={"host": "0"}).set(4)
+    h = reg.histogram("quiver_test_ms")
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    assert "# HELP quiver_test_total help text" in lines
+    assert "# TYPE quiver_test_total counter" in lines
+    assert "quiver_test_total 3" in lines
+    assert 'quiver_test_depth{host="0"} 4' in lines
+    assert "# TYPE quiver_test_ms histogram" in lines
+    # histogram buckets are CUMULATIVE and +Inf equals the count
+    bucket_lines = [l for l in lines if l.startswith("quiver_test_ms_bucket")]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert counts == sorted(counts)
+    assert bucket_lines[-1] == 'quiver_test_ms_bucket{le="+Inf"} 3'
+    assert "quiver_test_ms_count 3" in lines
+    # large counters expose at FULL precision (%g would round to 6
+    # significant digits and freeze rate() on big byte counters)
+    reg.counter("quiver_test_bytes_total").inc(123_456_789)
+    assert "quiver_test_bytes_total 123456789" in reg.to_prometheus()
+    # label values are escaped per the text format — one bad value must
+    # not invalidate the whole exposition
+    reg.gauge("quiver_test_esc", labels={"env": 'us"ea\\st'}).set(1)
+    assert 'quiver_test_esc{env="us\\"ea\\\\st"} 1' in reg.to_prometheus()
+
+
+def test_registry_reregistration_repoints_callback_adapters():
+    """An engine rebuild that re-registers into a long-lived registry must
+    re-point callback-backed metrics at the NEW source — a silent return
+    of the old closure would scrape the dead engine forever."""
+    reg = MetricsRegistry()
+    reg.counter_fn("quiver_test_live_total", lambda: 1)
+    assert reg.snapshot()["quiver_test_live_total"] == 1
+    reg.counter_fn("quiver_test_live_total", lambda: 2)  # engine rebuilt
+    assert reg.snapshot()["quiver_test_live_total"] == 2
+    h_old = qtrace.LatencyHistogram()
+    h_old.record_ms(1.0)
+    h_new = qtrace.LatencyHistogram()
+    reg.histogram("quiver_test_live_ms", fn=lambda: h_old)
+    reg.histogram("quiver_test_live_ms", fn=lambda: h_new)
+    assert reg.snapshot()["quiver_test_live_ms"]["count"] == 0
+    # stored-value metrics keep their state on idempotent re-registration
+    c = reg.counter("quiver_test_stored_total")
+    c.inc(5)
+    assert reg.counter("quiver_test_stored_total") is c
+    assert reg.snapshot()["quiver_test_stored_total"] == 5
+
+
+def test_hit_rate_adapter_follows_live_counter():
+    reg = MetricsRegistry()
+    hr = qtrace.HitRateCounter()
+    register_hit_rate(reg, "quiver_test_cache", hr)
+    hr.hit(3)
+    hr.miss(1)
+    snap = reg.snapshot()
+    assert snap["quiver_test_cache_hits_total"] == 3
+    assert snap["quiver_test_cache_misses_total"] == 1
+    assert snap["quiver_test_cache_hit_rate"] == 0.75
+
+
+def test_engine_register_metrics_live_gauges(setup):
+    eng = make_engine(setup, journal_events=1024)
+    eng.warmup()
+    reg = eng.register_metrics()
+    eng.predict([1, 2, 3, 4])
+    snap = reg.snapshot()
+    assert snap["quiver_serve_requests_total"] == eng.stats.requests == 4
+    assert snap["quiver_serve_dispatches_total"] == eng.stats.dispatches
+    assert snap["quiver_serve_pending_depth"] == 0  # drained
+    assert snap["quiver_serve_params_version"] == 0
+    assert snap['quiver_serve_bucket_dispatches_total{bucket="8"}'] == (
+        eng.stats.dispatch_buckets.get(8, 0)
+    )
+    assert snap["quiver_serve_cache_rows"] == len(eng.cache)
+    # the adapters follow a reset_stats swap (callback-backed, not copies)
+    eng.reset_stats()
+    snap2 = reg.snapshot()
+    assert snap2["quiver_serve_requests_total"] == 0
+    assert snap2["quiver_serve_latency_ms"]["count"] == 0
+    text = reg.to_prometheus()
+    assert "# TYPE quiver_serve_latency_ms histogram" in text
+
+
+# -- Chrome-trace export ------------------------------------------------------
+
+
+def _validate_trace_events(doc):
+    """Minimal trace_events schema check: the invariants Perfetto's JSON
+    importer requires of every event."""
+    assert isinstance(doc, dict) and isinstance(doc["traceEvents"], list)
+    assert doc["traceEvents"], "empty timeline"
+    for ev in doc["traceEvents"]:
+        assert isinstance(ev["ph"], str) and ev["ph"] in ("X", "i", "M")
+        assert isinstance(ev["name"], str)
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+        elif ev["ph"] == "i":
+            assert ev["ts"] >= 0 and ev["s"] in ("t", "p", "g")
+
+
+def test_export_chrome_trace_schema(tmp_path, setup):
+    eng = make_engine(setup, journal_events=4096)
+    eng.warmup()
+    eng.predict(zipfian_trace(N_NODES, 48, alpha=0.9, seed=5))
+    path = tmp_path / "timeline.json"
+    eng.export_chrome_trace(str(path), metadata={"round": 12})
+    doc = json.loads(path.read_text())
+    _validate_trace_events(doc)
+    assert doc["metadata"]["round"] == 12
+    names = {e["name"] for e in doc["traceEvents"]}
+    # stage spans and journal-derived flush slices both made it
+    assert "assemble" in names and "resolve" in names
+    assert any(n.startswith("flush ") for n in names)
+    # flush slices carry the pad-occupancy args the breakdown reports
+    fl = next(e for e in doc["traceEvents"] if e["name"].startswith("flush "))
+    assert {"fid", "n", "bucket"} <= set(fl["args"])
+
+
+def test_chrome_trace_overlapping_spans_get_lanes():
+    """Two overlapping same-stage spans must land on distinct lanes —
+    that is how the timeline SHOWS overlapped in-flight flushes instead
+    of hiding one under the other."""
+    sr = SpanRecorder()
+    sr.record("dispatch", 0.0, 1.0)
+    sr.record("dispatch", 0.5, 1.5)  # overlaps the first
+    sr.record("dispatch", 2.0, 3.0)  # does not
+    evs = chrome_trace_events([("e", sr)])
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 3
+    lanes = {}
+    for e in evs:
+        if e["ph"] == "M" and e["name"] == "thread_name":
+            lanes[e["tid"]] = e["args"]["name"]
+    tracks = sorted(lanes.values())
+    assert "dispatch" in tracks and "dispatch/1" in tracks
+
+
+def test_journal_flush_lanes_show_inflight_overlap():
+    """Synthetic journal with two flushes whose assemble->resolve windows
+    overlap: the export must put them on two flush lanes."""
+    j = EventJournal(capacity=128, clock=lambda: 0.0)
+
+    def emit(t, kind, rid=-1, fid=-1, a=0, b=0):
+        j._events.append((float(t), kind, rid, fid, a, b))
+
+    for fid, (t0, t1) in enumerate([(0, 6), (2, 9)], start=1):
+        emit(t0, "flush", -1, fid, 4, 8)
+        emit(t0 + 1, "seal", -1, fid, 5, 8)
+        emit(t0 + 2, "dispatch", -1, fid, 8)
+        emit(t1 - 1, "execute_done", -1, fid, 1)
+        emit(t1, "resolve", -1, fid, 5)
+    evs = chrome_trace_events([("j", j)])
+    lanes = {
+        e["args"]["name"]
+        for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert "flushes" in lanes and "flushes/1" in lanes
+
+
+def test_export_merges_multiple_sources_one_clock(tmp_path):
+    sr = SpanRecorder()
+    sr.record("exchange", 10.0, 10.5)
+    j = EventJournal(capacity=16, clock=lambda: 10.0)
+    j.emit("submit", 0, -1, 42)
+    doc = export_chrome_trace(str(tmp_path / "m.json"), [("comm", sr), ("jr", j)])
+    _validate_trace_events(doc)
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}
+    procs = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert procs == {"comm", "jr"}

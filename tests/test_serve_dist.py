@@ -364,6 +364,45 @@ def test_aggregate_stats_merges_shard_views(setup):
     assert 0 < agg["topology"][0]["edge_frac"] <= 1.0
 
 
+def test_fleet_observability_merges_deterministically(setup):
+    """Round-12 fleet observability: router + owner journals populate,
+    `fleet_snapshot` carries per-stage breakdowns for every grain,
+    `aggregate_journal` merges deterministically (host-major, emit order
+    within — dispatch-index order for flush events), the fleet registry
+    exposes router AND per-host families, and journaling changes no
+    served bit vs an identical un-journaled engine."""
+    trace = zipfian_trace(N_NODES, 48, alpha=0.9, seed=5)
+    dist = make_dist(setup, hosts=2, journal_events=4096)
+    out = np.asarray(dist.predict(trace))
+    ref = np.asarray(make_dist(setup, hosts=2).predict(trace))
+    assert np.array_equal(out, ref)  # observe-only, router grain included
+    fs = dist.fleet_snapshot()
+    assert fs["router"]["requests"] > 0 and fs["router"]["flushes"] > 0
+    assert fs["router"]["pad_frac"]["n"] == fs["router"]["flushes"]
+    assert set(fs["per_shard"]) == {0, 1}
+    assert any(fs["per_shard"][h]["device_ms"]["n"] > 0 for h in (0, 1))
+    m1 = dist.aggregate_journal()
+    m2 = dist.aggregate_journal()
+    assert m1 == m2 and len(m1) > 0
+    hosts_seen = [e[0] for e in m1]
+    assert hosts_seen == sorted(hosts_seen)  # router (-1) then sorted owners
+    reg = dist.fleet_registry()
+    snap = reg.snapshot()
+    assert snap["quiver_router_requests_total"] == dist.stats.requests
+    assert 'quiver_serve_requests_total{host="0"}' in snap
+    assert 'quiver_serve_requests_total{host="1"}' in snap
+    text = reg.to_prometheus()
+    assert "# TYPE quiver_router_latency_ms histogram" in text
+    # the fleet timeline parses and carries every source
+    doc = dist.export_chrome_trace("")
+    procs = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert {"router.journal", "owner0.journal", "owner1.journal"} <= procs
+
+
 def test_flush_error_resolves_waiters_and_reraises(setup):
     dist = make_dist(setup, hosts=2, exchange="host")
 
