@@ -1065,7 +1065,15 @@ class DistServeEngine:
         every owner engine's (`ServeEngine.register_metrics`) under a
         ``host`` label, registered in sorted-host order — the same
         deterministic merge discipline as `aggregate_stats`, so two
-        expositions of the same state are textually identical."""
+        expositions of the same state are textually identical. With no
+        ``registry`` argument the engine's CACHED fleet registry is
+        returned (adapters are callback-backed readers, so one registry
+        serves every scrape; re-registration re-points, never
+        duplicates)."""
+        if registry is None:
+            if getattr(self, "_fleet_reg", None) is None:
+                self._fleet_reg = MetricsRegistry()
+            registry = self._fleet_reg
         reg = self.register_metrics(registry)
         for h in sorted(self.engines):
             self.engines[h].register_metrics(

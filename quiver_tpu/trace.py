@@ -483,6 +483,35 @@ EVENT_KINDS = (
 _EVENT_APPROX_BYTES = 160
 
 
+def _fold_flush_events(events) -> Dict[int, Dict[str, float]]:
+    """Fold a journal event stream's PER-FLUSH events into one dict per
+    fid — the single state machine both `EventJournal.request_breakdown`
+    and :func:`chrome_trace_events` consume, so a new event kind threads
+    through every consumer at once instead of drifting between hand-rolled
+    copies. Per-request kinds (submit/cache_hit/coalesce/late_admit/
+    assemble) are ignored here; callers fold those themselves."""
+    flushes: Dict[int, Dict[str, float]] = {}
+    for (t, kind, rid, fid, a, b) in events:
+        if fid < 0 or kind in (
+            "submit", "cache_hit", "coalesce", "late_admit", "assemble"
+        ):
+            continue
+        f = flushes.setdefault(fid, {})
+        if kind == "flush":
+            f["assemble_t"], f["n_drained"], f["bucket"] = t, a, b
+        elif kind == "seal":
+            f["seal_t"], f["n_final"], f["bucket"] = t, a, b
+        elif kind == "window_wait":
+            f["window_wait_s"] = a
+        elif kind == "dispatch":
+            f["dispatch_t"] = t
+        elif kind == "execute_done":
+            f["execute_done_t"] = t
+        elif kind == "resolve":
+            f["resolve_t"] = t
+    return flushes
+
+
 def _stage_stats(values: Sequence[float]) -> Dict[str, float]:
     """{"p50", "p99", "mean", "n"} of a value list (empirical percentiles:
     the k-th sorted sample at rank ceil(p/100*n)). The journal is bounded,
@@ -588,13 +617,14 @@ class EventJournal:
         late admission exists to recover), ``window_wait_ms``. Requests
         whose flush rolled off the ring (or never dispatched yet) are
         skipped, not guessed."""
-        flushes: Dict[int, Dict[str, float]] = {}
+        events = self.snapshot()
+        flushes = _fold_flush_events(events)
         reqs: List[Tuple[float, int]] = []  # (submit_t, fid) once linked
         pending_rid: Dict[int, float] = {}  # rid -> earliest submit_t seen
         rid_extra: Dict[int, List[float]] = {}  # rid -> later waiter times
         rid_fid: Dict[int, int] = {}  # rid -> flush once assembled/admitted
         cache_hits = 0
-        for (t, kind, rid, fid, a, b) in self.snapshot():
+        for (t, kind, rid, fid, a, b) in events:
             if kind in ("submit", "coalesce"):
                 linked = rid_fid.get(rid)
                 if linked is not None:
@@ -619,20 +649,6 @@ class EventJournal:
                     reqs.append((t0, fid))
                 for tw in rid_extra.pop(rid, ()):  # coalesced co-waiters
                     reqs.append((tw, fid))
-            else:
-                f = flushes.setdefault(fid, {})
-                if kind == "flush":
-                    f["n_drained"], f["bucket"] = a, b
-                elif kind == "window_wait":
-                    f["window_wait_s"] = a
-                elif kind == "seal":
-                    f["n_final"], f["bucket"] = a, b
-                elif kind == "dispatch":
-                    f["dispatch_t"] = t
-                elif kind == "execute_done":
-                    f["execute_done_t"] = t
-                elif kind == "resolve":
-                    f["resolve_t"] = t
         queue_ms: List[float] = []
         device_ms: List[float] = []
         resolve_ms: List[float] = []
@@ -1043,28 +1059,15 @@ def chrome_trace_events(
     t_min = time_origin
     for pid, (pname, src) in enumerate(sources):
         if isinstance(src, EventJournal):
-            flushes: Dict[int, Dict[str, float]] = {}
-            for (t, kind, rid, fid, a, b) in src.snapshot():
+            events = src.snapshot()
+            flushes = _fold_flush_events(events)
+            for (t, kind, rid, fid, a, b) in events:
                 if not explicit_origin and (t_min is None or t < t_min):
                     t_min = t
                 if kind in ("submit", "cache_hit", "coalesce", "late_admit"):
                     instants.append(
                         (pid, t, kind, {"rid": rid, "node": a, "fid": fid})
                     )
-                elif fid >= 0:
-                    f = flushes.setdefault(fid, {})
-                    if kind == "flush":
-                        f["assemble_t"], f["n_drained"], f["bucket"] = t, a, b
-                    elif kind == "seal":
-                        f["seal_t"], f["n_final"], f["bucket"] = t, a, b
-                    elif kind == "window_wait":
-                        f["window_wait_s"] = a
-                    elif kind == "dispatch":
-                        f["dispatch_t"] = t
-                    elif kind == "execute_done":
-                        f["execute_done_t"] = t
-                    elif kind == "resolve":
-                        f["resolve_t"] = t
             items = []
             for fid, f in sorted(flushes.items()):
                 t0 = f.get("assemble_t", f.get("seal_t"))
