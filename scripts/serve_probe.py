@@ -80,9 +80,12 @@ def git_revision() -> str:
             ["git", "rev-parse", "HEAD"], cwd=cwd,
             capture_output=True, text=True, timeout=10, check=True,
         ).stdout.strip()
+        # tracked changes only: the probe itself writes untracked artifacts
+        # (--timeline lands before this stamp is taken), and an untracked
+        # file does not change what the probe ran
         dirty = subprocess.run(
-            ["git", "status", "--porcelain"], cwd=cwd,
-            capture_output=True, text=True, timeout=10, check=True,
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            cwd=cwd, capture_output=True, text=True, timeout=10, check=True,
         ).stdout.strip()
         return rev + ("-dirty" if dirty else "")
     except Exception:
