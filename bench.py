@@ -977,15 +977,15 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
     # metrics registry ON vs OFF, median-of-3 INTERLEAVED (off/on pairs
     # back to back, so box drift hits both sides equally). The journal is
     # designed to be left on in production; this is the measured price.
-    def _run_saturated(journal_events):
+    def _run_saturated(journal_events, workload=None):
         eng = ServeEngine(
             model, params, make_sampler(), table,
             ServeConfig(max_batch=64, buckets=(64,), max_delay_ms=2.0,
                         cache_entries=1 << 16, max_in_flight=2,
-                        journal_events=journal_events),
+                        journal_events=journal_events, workload=workload),
         )
         eng.warmup()
-        if journal_events:
+        if journal_events or workload is not None:
             eng.register_metrics()  # passive adapters live during the run
         eng.cache.invalidate()
         eng.reset_stats()
@@ -1028,6 +1028,37 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
     except Exception as exc:
         context["serve_obs_overhead_error"] = repr(exc)
         log(f"serve obs overhead leg failed: {exc}")
+
+    # workload-sketch cost on the same saturated leg (round 13, ISSUE 8):
+    # frequency sketches + owner stats + cache taps ON vs OFF, the same
+    # interleaved median-of-3 shape as the journal leg above — the
+    # measured price of leaving the access-skew measurement on in
+    # production (ROADMAP items 2/3 read the sketch; this is what reading
+    # it costs).
+    try:
+        from quiver_tpu.trace import WorkloadConfig
+
+        qps_skew_on, qps_skew_off = [], []
+        for _ in range(3):
+            qps_skew_off.append(round(_run_saturated(0), 1))
+            qps_skew_on.append(round(
+                _run_saturated(0, workload=WorkloadConfig(topk=256)), 1
+            ))
+        med_on = sorted(qps_skew_on)[1]
+        med_off = sorted(qps_skew_off)[1]
+        context["serve_skew_qps_on"] = qps_skew_on
+        context["serve_skew_qps_off"] = qps_skew_off
+        context["serve_skew_overhead_frac"] = round(1.0 - med_on / med_off, 4)
+        log(
+            f"serve workload-sketch overhead: on {med_on:.0f} vs off "
+            f"{med_off:.0f} QPS (median-of-3) -> frac "
+            f"{context['serve_skew_overhead_frac']:+.4f} "
+            f"(spread on {min(qps_skew_on):.0f}-{max(qps_skew_on):.0f}, "
+            f"off {min(qps_skew_off):.0f}-{max(qps_skew_off):.0f})"
+        )
+    except Exception as exc:
+        context["serve_skew_overhead_error"] = repr(exc)
+        log(f"serve workload-sketch overhead leg failed: {exc}")
 
     # distributed serving (round 10): seed-ownership routed engine at
     # hosts=2 over the SAME graph, exchange='host' (one chip — the hops

@@ -26,7 +26,7 @@ from .partition import (
     partition_feature_without_replication,
     quiver_partition_feature,
 )
-from . import comm, pyg, trace
+from . import comm, obs, pyg, trace
 from . import quant
 from . import serve
 from .quant import QuantizedFeature
@@ -53,6 +53,7 @@ __all__ = [
     "TpuComm",
     "comm",
     "getNcclId",
+    "obs",
     "trace",
     "Offset",
     "PartitionInfo",

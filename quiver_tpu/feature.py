@@ -86,6 +86,37 @@ def validate_lookup_ids(
     return ids
 
 
+def attribute_gather_tiers(shard_tensor, rank, stored_ids, counter,
+                           valid=None) -> None:
+    """OBSERVE-ONLY per-tier attribution of a tiered gather (round-13
+    workload telemetry): count how many of ``stored_ids`` resolve in each
+    tier — ``hbm`` (this rank's own device shard), ``ici`` (another
+    chip's shard in the clique stripe), ``host`` (the DRAM tail) — into a
+    tier-aware `trace.HitRateCounter` (``counter.hit(n, tier=...)``).
+
+    Pure counting over the shard book's offsets (one vectorized compare
+    per shard); never touches the gather itself, so attaching a counter
+    changes no gathered byte. ``valid`` masks out pad/invalid lanes —
+    those gather row 0 physically but are not real feature requests, and
+    counting them would inflate the hot tier."""
+    if counter is None or shard_tensor is None:
+        return
+    ids = np.asarray(stored_ids).reshape(-1)
+    if valid is not None:
+        ids = ids[np.asarray(valid).reshape(-1)]
+    if ids.size == 0:
+        return
+    for dev_rank, _, off in shard_tensor.device_shards:
+        n = int(((ids >= off.start) & (ids < off.end)).sum())
+        if n:
+            counter.hit(n, tier="hbm" if dev_rank == rank else "ici")
+    off = shard_tensor.cpu_offset
+    if shard_tensor.cpu_tensor is not None and off is not None:
+        n = int(((ids >= off.start) & (ids < off.end)).sum())
+        if n:
+            counter.hit(n, tier="host")
+
+
 @jax.jit
 def _padded_gather(table: jax.Array, ids: jax.Array) -> jax.Array:
     return jnp.take(table, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
@@ -140,6 +171,11 @@ class Feature:
         self._local_order_applied = False
         self.mmap_handle_ = None  # disk tier (reference feature.py:84-93)
         self.disk_map: Optional[np.ndarray] = None
+        # observe-only workload tap (round 13): when a tier-aware
+        # HitRateCounter is attached, every eager gather attributes its
+        # rows per tier (attribute_gather_tiers) — placement telemetry,
+        # never control flow
+        self.tier_counter = None
 
     # ------------------------------------------------------------------ build
     def from_cpu_tensor(self, cpu_tensor) -> None:
@@ -273,6 +309,11 @@ class Feature:
                 ids = np.where(invalid, 0, ids)
             if self.feature_order is not None:
                 ids = self.feature_order[ids]
+        if self.tier_counter is not None:
+            attribute_gather_tiers(
+                self.shard_tensor, self.rank, ids, self.tier_counter,
+                valid=~invalid,
+            )
         rows = self.shard_tensor[ids]
         if invalid.any():
             rows = rows * jnp.asarray(~invalid, rows.dtype)[:, None]
@@ -289,9 +330,16 @@ class Feature:
         disk_mask = (disk_index < 0) & ~oob
         mem_mask = (disk_index >= 0) & ~oob
         out = np.zeros((ids.shape[0], self.dim), np.float32)
+        tc = self.tier_counter
         if disk_mask.any():
+            if tc is not None:
+                tc.hit(int(disk_mask.sum()), tier="disk")
             out[disk_mask] = np.asarray(self.mmap_handle_[ids[disk_mask]], np.float32)
         if mem_mask.any():
+            if tc is not None:
+                attribute_gather_tiers(
+                    self.shard_tensor, self.rank, disk_index[mem_mask], tc
+                )
             out[mem_mask] = np.asarray(self.shard_tensor[disk_index[mem_mask]])
         return jnp.asarray(out)
 

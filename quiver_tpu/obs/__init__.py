@@ -1,0 +1,37 @@
+"""quiver_tpu.obs — workload telemetry for the serve stack (round 13).
+
+Streaming frequency sketches over the access stream (`SpaceSaving`,
+`CountMinSketch` — bounded memory, deterministic decayed windows ticking
+on the engine's flush index), per-owner load & straggler stats
+(`OwnerLoadStats` over P-squared quantiles), and the observe-only
+`WorkloadMonitor` the engines tap (`ServeConfig.workload` /
+`DistServeConfig.workload`). `WorkloadMonitor.skew_report()` turns the
+measurements into the planning document ROADMAP items 2 (tier promotion)
+and 3 (hot-shard replication) read: head-concentration curve, sketch
+error bounds, predicted LRU hit rate vs cache capacity, owner imbalance.
+
+Everything here is re-exported through `quiver_tpu.trace` (the
+observability umbrella); the observe-only contract — enabling telemetry
+changes no served bit — is pinned in tests/test_skew.py.
+"""
+
+from .sketch import CountMinSketch, SpaceSaving
+from .workload import (
+    CounterSeries,
+    OwnerLoadStats,
+    P2Quantile,
+    WorkloadConfig,
+    WorkloadMonitor,
+    lru_hit_rate_che,
+)
+
+__all__ = [
+    "CountMinSketch",
+    "CounterSeries",
+    "OwnerLoadStats",
+    "P2Quantile",
+    "SpaceSaving",
+    "WorkloadConfig",
+    "WorkloadMonitor",
+    "lru_hit_rate_che",
+]

@@ -1,0 +1,348 @@
+"""Streaming frequency sketches over the serving access stream.
+
+ROADMAP items 2 (adaptive tier promotion) and 3 (hot-shard replication)
+both start from the same question the repo could not answer until now:
+*which rows are hot, and how hot?* The reference answers it offline —
+degree-descending reorder at ingest (`reindex_feature`, the hot-prefix
+placement behind ``cache_policy="p2p_clique_replicate"``) — but serving
+skew is a property of TRAFFIC, not degree, and it drifts. These sketches
+measure it online in bounded memory:
+
+- :class:`SpaceSaving` — the Metwally/Agrawal/El Abbadi top-k heavy-hitter
+  summary: at most ``k`` tracked keys, every key with true count
+  ``> observed/k`` is guaranteed tracked, and each tracked count
+  overestimates by at most its recorded ``err``.
+- :class:`CountMinSketch` — per-key frequency estimates over the WHOLE id
+  space in ``width * depth`` cells: estimates never undercount and
+  overcount by at most ``e/width * observed`` with probability
+  ``1 - e^-depth``. Linear, so fleet merges are exact entrywise sums —
+  bit-identical in any merge order.
+
+Both support **deterministic exponentially-decayed windows**: ``decay()``
+multiplies every cell/count by a fixed factor. The caller ties decay to a
+logical clock — the serve engines tick on FLUSH SEALS (the dispatch
+index), never wall time — so a replayed run decays at exactly the same
+points and the sketch state is bit-stable under replay (the same
+discipline that keeps the dispatch log and sampler key stream
+deterministic).
+
+Thread safety: every mutator takes the sketch's lock. Callers composing
+several sketches behind one tap (:class:`quiver_tpu.obs.WorkloadMonitor`)
+may pass a SHARED lock so one acquisition covers the whole observation.
+
+No imports from the rest of the package: the sketches are leaf
+primitives, which is what lets `quiver_tpu.trace` re-export them without
+an import cycle.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Deterministic universal hashing for CountMinSketch: fixed Mersenne
+# prime, per-row (a, b) drawn from a seeded LCG — no wall-clock, no
+# process salt, so two sketches born with the same (width, depth, seed)
+# hash identically on every platform (the merge precondition).
+_CMS_PRIME = (1 << 61) - 1
+
+
+def _cms_params(depth: int, seed: int) -> List[Tuple[int, int]]:
+    # MMIX LCG constants; good enough to decorrelate rows, fully portable
+    state = (seed * 6364136223846793005 + 1442695040888963407) & ((1 << 64) - 1)
+    out = []
+    for _ in range(depth):
+        state = (state * 6364136223846793005 + 1442695040888963407) & ((1 << 64) - 1)
+        a = (state % (_CMS_PRIME - 1)) + 1
+        state = (state * 6364136223846793005 + 1442695040888963407) & ((1 << 64) - 1)
+        b = state % _CMS_PRIME
+        out.append((a, b))
+    return out
+
+
+class SpaceSaving:
+    """Bounded top-k heavy-hitter summary (Space-Saving).
+
+    At most ``k`` keys are tracked. A new key arriving at capacity evicts
+    the minimum-count entry (ties broken by smallest key — DETERMINISTIC,
+    so two replicas fed the same stream hold bit-identical state) and
+    inherits its count as both starting mass and error bound:
+    ``count - err <= true count <= count`` for every tracked key, with
+    ``err <= observed / k``, and any key whose true count exceeds
+    ``observed / k`` is guaranteed present.
+
+    ``update`` is O(1) amortized for tracked keys and O(k) on an eviction
+    (a min scan over <= k entries — at the serving default k=64..256 that
+    is microseconds, far under one flush; the bench/probe overhead legs
+    measure the all-in price).
+    """
+
+    __slots__ = ("k", "observed", "observed_events", "_counts", "_errs",
+                 "_lock")
+
+    def __init__(self, k: int, lock: Optional[threading.Lock] = None):
+        if k < 1:
+            raise ValueError("SpaceSaving needs k >= 1")
+        self.k = int(k)
+        self.observed = 0.0        # total (decayed) observed weight
+        self.observed_events = 0   # raw update count, never decayed
+        self._counts: Dict[int, float] = {}
+        self._errs: Dict[int, float] = {}
+        self._lock = lock if lock is not None else threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def update(self, key: int, w: float = 1.0) -> None:
+        key = int(key)
+        with self._lock:
+            self.observed += w
+            self.observed_events += 1
+            counts = self._counts
+            if key in counts:
+                counts[key] += w
+                return
+            if len(counts) < self.k:
+                counts[key] = w
+                self._errs[key] = 0.0
+                return
+            mkey = min(counts, key=lambda kk: (counts[kk], kk))
+            mcount = counts.pop(mkey)
+            self._errs.pop(mkey)
+            counts[key] = mcount + w
+            self._errs[key] = mcount
+
+    def decay(self, factor: float) -> None:
+        """Multiply every count/err and the observed total by ``factor``
+        (one decayed-window step). Pure float multiplies on a fixed
+        iteration order — bit-stable under replay."""
+        if not 0.0 < factor <= 1.0:
+            raise ValueError("decay factor must be in (0, 1]")
+        with self._lock:
+            for kk in self._counts:
+                self._counts[kk] *= factor
+            for kk in self._errs:
+                self._errs[kk] *= factor
+            self.observed *= factor
+
+    def estimate(self, key: int) -> float:
+        """Upper-bound count for ``key`` (0 for untracked keys — which is
+        a LOWER bound there; use the Count-Min estimate for untracked
+        mass)."""
+        with self._lock:
+            return self._counts.get(int(key), 0.0)
+
+    def topk(self, n: Optional[int] = None) -> List[Tuple[int, float, float]]:
+        """``[(key, count, err)]`` sorted by (count desc, key asc) —
+        deterministic tie-break so two identical summaries list
+        identically."""
+        with self._lock:
+            items = [
+                (kk, self._counts[kk], self._errs[kk]) for kk in self._counts
+            ]
+        items.sort(key=lambda t: (-t[1], t[0]))
+        return items if n is None else items[: int(n)]
+
+    def head_coverage(self, n: Optional[int] = None) -> float:
+        """Estimated fraction of all observed weight covered by the top
+        ``n`` tracked keys (all tracked keys when ``n`` is None) — the
+        head-concentration number replication/caching policy reads."""
+        top = self.topk(n)
+        with self._lock:
+            total = self.observed
+        if total <= 0:
+            return 0.0
+        return min(sum(c for _, c, _ in top) / total, 1.0)
+
+    def max_err(self) -> float:
+        """Largest per-key overestimate bound among tracked keys."""
+        with self._lock:
+            return max(self._errs.values(), default=0.0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._errs.clear()
+            self.observed = 0.0
+            self.observed_events = 0
+
+    # -- fleet aggregation -------------------------------------------------
+
+    @classmethod
+    def merge_all(cls, summaries: Sequence["SpaceSaving"],
+                  k: Optional[int] = None) -> "SpaceSaving":
+        """ONE canonical merge over the whole fleet — the aggregation API.
+
+        For every key in any summary: count = sum of per-summary counts,
+        err = sum of per-summary errs, where a summary NOT tracking the
+        key contributes its minimum tracked count to the err (it may have
+        seen and evicted up to that many occurrences — the standard
+        mergeable-summaries bound). The union is then truncated to ``k``
+        by (count desc, key asc).
+
+        Merging ALL summaries in one call is deliberately
+        order-independent: the result depends only on the multiset of
+        inputs (shuffling the argument list yields a bit-identical
+        summary — pinned in tests/test_skew.py). A pairwise fold
+        (``a.merge(b)`` then ``.merge(c)``) truncates between steps and
+        can drop mass order-dependently; use it only for incremental
+        two-party merges.
+        """
+        if not summaries:
+            raise ValueError("merge_all needs at least one summary")
+        k = int(k) if k is not None else max(s.k for s in summaries)
+        snaps = []
+        for s in summaries:
+            with s._lock:
+                snaps.append((
+                    dict(s._counts), dict(s._errs), s.observed,
+                    s.observed_events,
+                ))
+        mins = [
+            min(counts.values()) if len(counts) >= s.k else 0.0
+            for s, (counts, _, _, _) in zip(summaries, snaps)
+        ]
+        keys = set()
+        for counts, _, _, _ in snaps:
+            keys.update(counts)
+        merged: List[Tuple[int, float, float]] = []
+        for kk in keys:
+            c = e = 0.0
+            for (counts, errs, _, _), mn in zip(snaps, mins):
+                if kk in counts:
+                    c += counts[kk]
+                    e += errs[kk]
+                else:
+                    e += mn
+            merged.append((kk, c, e))
+        merged.sort(key=lambda t: (-t[1], t[0]))
+        out = cls(k)
+        for kk, c, e in merged[:k]:
+            out._counts[kk] = c
+            out._errs[kk] = e
+        out.observed = sum(o for _, _, o, _ in snaps)
+        out.observed_events = sum(n for _, _, _, n in snaps)
+        return out
+
+    def merge(self, other: "SpaceSaving") -> "SpaceSaving":
+        """Pairwise convenience over :meth:`merge_all` (same bounds;
+        fold order matters at truncation — prefer one ``merge_all`` over
+        the whole fleet). Returns self for chaining."""
+        if not isinstance(other, SpaceSaving):
+            raise TypeError(f"cannot merge {type(other).__name__}")
+        m = SpaceSaving.merge_all([self, other], k=self.k)
+        with self._lock:
+            self._counts = m._counts
+            self._errs = m._errs
+            self.observed = m.observed
+            self.observed_events = m.observed_events
+        return self
+
+
+class CountMinSketch:
+    """Count-Min frequency sketch over integer keys.
+
+    ``depth`` rows of ``width`` float cells; ``estimate`` is the row
+    minimum. Never undercounts; overcounts by at most
+    ``epsilon * observed`` (``epsilon = e / width``) with probability
+    ``1 - delta`` (``delta = e^-depth``) — :meth:`error_bound` reports
+    both. Hashing is seeded and platform-independent, so sketches born
+    with the same ``(width, depth, seed)`` are mergeable; ``merge`` is an
+    exact entrywise sum (the sketch is linear), hence bit-identical in
+    ANY merge order — the fleet-aggregation property the distributed
+    serve engine relies on.
+    """
+
+    __slots__ = ("width", "depth", "seed", "observed", "observed_events",
+                 "_rows", "_params", "_lock")
+
+    def __init__(self, width: int = 2048, depth: int = 4, seed: int = 0,
+                 lock: Optional[threading.Lock] = None):
+        if width < 1 or depth < 1:
+            raise ValueError("CountMinSketch needs width >= 1 and depth >= 1")
+        self.width = int(width)
+        self.depth = int(depth)
+        self.seed = int(seed)
+        self.observed = 0.0
+        self.observed_events = 0
+        self._rows = [[0.0] * self.width for _ in range(self.depth)]
+        self._params = _cms_params(self.depth, self.seed)
+        self._lock = lock if lock is not None else threading.Lock()
+
+    def _cells(self, key: int) -> List[int]:
+        return [
+            ((a * key + b) % _CMS_PRIME) % self.width
+            for a, b in self._params
+        ]
+
+    def update(self, key: int, w: float = 1.0) -> None:
+        key = int(key)
+        cells = self._cells(key)
+        with self._lock:
+            self.observed += w
+            self.observed_events += 1
+            for row, c in zip(self._rows, cells):
+                row[c] += w
+
+    def estimate(self, key: int) -> float:
+        cells = self._cells(int(key))
+        with self._lock:
+            return min(row[c] for row, c in zip(self._rows, cells))
+
+    def decay(self, factor: float) -> None:
+        """One decayed-window step (same contract as
+        `SpaceSaving.decay`): every cell and the observed total scale by
+        ``factor`` — deterministic, replay-bit-stable."""
+        if not 0.0 < factor <= 1.0:
+            raise ValueError("decay factor must be in (0, 1]")
+        with self._lock:
+            for row in self._rows:
+                for i in range(self.width):
+                    row[i] *= factor
+            self.observed *= factor
+
+    def error_bound(self) -> Dict[str, float]:
+        """``{"epsilon", "delta", "abs_err"}``: estimates exceed true
+        counts by at most ``abs_err = epsilon * observed`` with
+        probability ``1 - delta``."""
+        eps = math.e / self.width
+        with self._lock:
+            obs = self.observed
+        return {
+            "epsilon": eps,
+            "delta": math.exp(-self.depth),
+            "abs_err": eps * obs,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            for row in self._rows:
+                for i in range(self.width):
+                    row[i] = 0.0
+            self.observed = 0.0
+            self.observed_events = 0
+
+    def merge(self, other: "CountMinSketch") -> "CountMinSketch":
+        """Entrywise sum (exact — the sketch is linear, so any merge
+        order yields bit-identical cells). Requires identical
+        (width, depth, seed); merging differently-hashed sketches would
+        silently mis-bin, so it raises instead. Returns self."""
+        if not isinstance(other, CountMinSketch):
+            raise TypeError(f"cannot merge {type(other).__name__}")
+        if (self.width, self.depth, self.seed) != (
+            other.width, other.depth, other.seed
+        ):
+            raise ValueError(
+                "CountMinSketch.merge needs identical (width, depth, seed): "
+                f"self ({self.width}, {self.depth}, {self.seed}) vs "
+                f"other ({other.width}, {other.depth}, {other.seed})"
+            )
+        with self._lock:
+            with other._lock:
+                for mine, theirs in zip(self._rows, other._rows):
+                    for i in range(self.width):
+                        mine[i] += theirs[i]
+                self.observed += other.observed
+                self.observed_events += other.observed_events
+        return self

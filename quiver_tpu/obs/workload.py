@@ -1,0 +1,691 @@
+"""Workload telemetry: the observe-only monitor the serve stack taps.
+
+One :class:`WorkloadMonitor` per engine answers the questions ROADMAP
+items 2 and 3 need answered before they can be built: *which rows are hot
+and how hot* (frequency sketches over the access stream), *how unequal is
+owner load and who is the straggler* (per-owner streaming quantiles +
+imbalance metrics), and *what would a cache / a replica set buy*
+(:meth:`WorkloadMonitor.skew_report` — head-concentration curve, sketch
+error bounds, predicted LRU hit rate vs capacity via the Che
+approximation).
+
+Contract (the round-12 rule, restated): **observe-only**. Nothing in the
+engines reads the monitor to make a decision; enabling it changes no
+served logit bit and no dispatch-log byte (pinned in
+tests/test_skew.py). Decay ticks ride the engine's FLUSH SEALS (dispatch
+index), never wall time, so a replayed run reproduces the sketch state
+bit for bit. Taps are lock-cheap: one shared uncontended lock covers both
+sketches per observation, owner stats take one lock per flush (not per
+request).
+
+This module imports nothing from the rest of the package at module level
+(lazy imports inside methods only) so `quiver_tpu.trace` can re-export it
+without a cycle.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .sketch import CountMinSketch, SpaceSaving
+
+
+class P2Quantile:
+    """Streaming quantile via the P-squared algorithm (Jain & Chlamtac
+    1985): five markers, O(1) memory and update, no stored samples — the
+    right shape for per-owner latency tails that must stay bounded over
+    weeks of serving. Accurate to a few percent on unimodal data once a
+    few dozen samples have landed; exact below five samples (they are
+    kept verbatim until the markers initialize)."""
+
+    __slots__ = ("p", "count", "_q", "_n", "_np", "_dn")
+
+    def __init__(self, p: float):
+        if not 0.0 < p < 1.0:
+            raise ValueError("P2Quantile wants p in (0, 1)")
+        self.p = float(p)
+        self.count = 0
+        self._q: List[float] = []   # marker heights (first 5 raw samples)
+        self._n: List[float] = []
+        self._np: List[float] = []
+        self._dn = [0.0, p / 2.0, p, (1.0 + p) / 2.0, 1.0]
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        self.count += 1
+        q = self._q
+        if self.count <= 5:
+            q.append(x)
+            if self.count == 5:
+                q.sort()
+                self._n = [1.0, 2.0, 3.0, 4.0, 5.0]
+                p = self.p
+                self._np = [1.0, 1.0 + 2.0 * p, 1.0 + 4.0 * p,
+                            3.0 + 2.0 * p, 5.0]
+            return
+        n = self._n
+        if x < q[0]:
+            q[0] = x
+            k = 0
+        elif x >= q[4]:
+            q[4] = x
+            k = 3
+        else:
+            k = 0
+            while k < 3 and not (q[k] <= x < q[k + 1]):
+                k += 1
+        for i in range(k + 1, 5):
+            n[i] += 1.0
+        for i in range(5):
+            self._np[i] += self._dn[i]
+        for i in (1, 2, 3):
+            d = self._np[i] - n[i]
+            if (d >= 1.0 and n[i + 1] - n[i] > 1.0) or (
+                d <= -1.0 and n[i - 1] - n[i] < -1.0
+            ):
+                d = 1.0 if d > 0 else -1.0
+                # parabolic (P2) update, linear fallback when it would
+                # break marker monotonicity
+                qp = q[i] + d / (n[i + 1] - n[i - 1]) * (
+                    (n[i] - n[i - 1] + d) * (q[i + 1] - q[i])
+                    / (n[i + 1] - n[i])
+                    + (n[i + 1] - n[i] - d) * (q[i] - q[i - 1])
+                    / (n[i] - n[i - 1])
+                )
+                if q[i - 1] < qp < q[i + 1]:
+                    q[i] = qp
+                else:
+                    j = i + (1 if d > 0 else -1)
+                    q[i] = q[i] + d * (q[j] - q[i]) / (n[j] - n[i])
+                n[i] += d
+
+    @property
+    def value(self) -> float:
+        """Current quantile estimate (empirical below 5 samples)."""
+        if self.count == 0:
+            return 0.0
+        if self.count < 5:
+            vals = sorted(self._q)
+            idx = min(
+                len(vals) - 1,
+                max(0, math.ceil(self.p * len(vals)) - 1),
+            )
+            return vals[idx]
+        return self._q[2]
+
+    def copy(self) -> "P2Quantile":
+        """Independent snapshot of the estimator (marker state copied —
+        merges/reports must never alias a LIVE estimator, or later
+        updates on one side silently mutate the other)."""
+        out = P2Quantile(self.p)
+        out.count = self.count
+        out._q = list(self._q)
+        out._n = list(self._n)
+        out._np = list(self._np)
+        return out
+
+
+class OwnerLoadStats:
+    """Per-owner load + latency telemetry for the routed serve fleet.
+
+    One entry per owner host: routed sub-batch counts/seed totals and
+    streaming P-squared p50/p99 over that owner's flush/exchange
+    latencies. ``imbalance()`` condenses load inequality (max/mean
+    owned-load ratio, top-owner concentration); ``straggler()`` names the
+    owner whose latency tail is worst relative to the fleet median — the
+    two numbers hedged dispatch (ROADMAP item 3b) will key off, measured
+    here first. Thread-safe; updated per FLUSH, not per request."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owners: Dict[int, Dict[str, object]] = {}
+
+    def _entry(self, owner: int) -> Dict[str, object]:
+        e = self._owners.get(owner)
+        if e is None:
+            e = {
+                "seeds": 0, "batches": 0, "lat_count": 0,
+                "lat_sum_s": 0.0, "lat_max_s": 0.0,
+                "p50": P2Quantile(0.5), "p99": P2Quantile(0.99),
+            }
+            self._owners[owner] = e
+        return e
+
+    def __len__(self) -> int:
+        return len(self._owners)
+
+    def observe_batch(self, owner: int, seeds: int) -> None:
+        with self._lock:
+            e = self._entry(int(owner))
+            e["seeds"] += int(seeds)
+            e["batches"] += 1
+
+    def observe_latency(self, owner: int, seconds: float) -> None:
+        with self._lock:
+            e = self._entry(int(owner))
+            e["lat_count"] += 1
+            e["lat_sum_s"] += seconds
+            if seconds > e["lat_max_s"]:
+                e["lat_max_s"] = seconds
+            e["p50"].update(seconds * 1e3)
+            e["p99"].update(seconds * 1e3)
+
+    def seeds_by_owner(self) -> Dict[int, int]:
+        with self._lock:
+            return {h: e["seeds"] for h, e in self._owners.items()}
+
+    def imbalance(self) -> Dict[str, float]:
+        """``max_mean_ratio`` (hottest owner's seed load over the mean —
+        1.0 is perfectly balanced, H is one-owner-takes-all at H hosts)
+        and ``top_share`` (hottest owner's fraction of all routed
+        seeds)."""
+        loads = self.seeds_by_owner()
+        total = sum(loads.values())
+        if not loads or total <= 0:
+            return {"owners": len(loads), "max_mean_ratio": 0.0,
+                    "top_share": 0.0}
+        mx = max(loads.values())
+        return {
+            "owners": len(loads),
+            "max_mean_ratio": mx / (total / len(loads)),
+            "top_share": mx / total,
+        }
+
+    def straggler(self) -> Dict[str, object]:
+        """The worst-tail owner: its p99 latency and the ratio to the
+        fleet's median per-owner p99 (1.0 = no straggler)."""
+        with self._lock:
+            tails = {
+                h: e["p99"].value
+                for h, e in self._owners.items()
+                if e["lat_count"] > 0
+            }
+        if not tails:
+            return {"owner": None, "p99_ms": 0.0, "vs_median": 0.0}
+        worst = max(sorted(tails), key=lambda h: tails[h])
+        med = sorted(tails.values())[len(tails) // 2]
+        return {
+            "owner": worst,
+            "p99_ms": tails[worst],
+            "vs_median": tails[worst] / med if med > 0 else 0.0,
+        }
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            per = {
+                h: {
+                    "seeds": e["seeds"],
+                    "batches": e["batches"],
+                    "flushes_timed": e["lat_count"],
+                    "lat_mean_ms": (
+                        e["lat_sum_s"] / e["lat_count"] * 1e3
+                        if e["lat_count"] else 0.0
+                    ),
+                    "lat_p50_ms": e["p50"].value,
+                    "lat_p99_ms": e["p99"].value,
+                    "lat_max_ms": e["lat_max_s"] * 1e3,
+                }
+                for h, e in self._owners.items()
+            }
+        return {
+            "per_owner": {str(h): per[h] for h in sorted(per)},
+            "imbalance": self.imbalance(),
+            "straggler": self.straggler(),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._owners.clear()
+
+    def merge(self, other: "OwnerLoadStats") -> "OwnerLoadStats":
+        """Fold ``other``'s owners in: counts/sums/max add exactly; the
+        P-squared quantile markers do NOT merge (no sufficient
+        statistics), so on an owner-id collision the estimator with MORE
+        samples is kept — fleet merges here are per-owner-disjoint in
+        practice (each host reports its own owners). Returns self."""
+        with other._lock:
+            # SNAPSHOT the quantile estimators: adopting other's live
+            # P2Quantile objects would alias marker state across
+            # monitors (a later update on either side would mutate both)
+            theirs = {
+                h: dict(e, p50=e["p50"].copy(), p99=e["p99"].copy())
+                for h, e in other._owners.items()
+            }
+        with self._lock:
+            for h, oe in theirs.items():
+                e = self._owners.get(h)
+                if e is None:
+                    self._owners[h] = dict(oe)
+                    continue
+                if oe["lat_count"] > e["lat_count"]:
+                    e["p50"], e["p99"] = oe["p50"], oe["p99"]
+                e["seeds"] += oe["seeds"]
+                e["batches"] += oe["batches"]
+                e["lat_count"] += oe["lat_count"]
+                e["lat_sum_s"] += oe["lat_sum_s"]
+                e["lat_max_s"] = max(e["lat_max_s"], oe["lat_max_s"])
+        return self
+
+
+class CounterSeries:
+    """Bounded recorder of named (t, value) samples — the COUNTER LANE of
+    the Chrome-trace export (`trace.chrome_trace_events` renders each
+    name as a ``ph: "C"`` track, so hot-share / owner-imbalance evolve as
+    a graph under the flush lanes). Same bounded-deque + atomic-append
+    discipline as `trace.SpanRecorder`; ``counter_samples()`` is the
+    duck-typed source hook the exporter looks for."""
+
+    def __init__(self, maxlen: int = 65536):
+        import collections
+
+        self._samples = collections.deque(maxlen=maxlen)
+
+    def record(self, name: str, t: float, value: float) -> None:
+        self._samples.append((name, float(t), float(value)))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def __bool__(self) -> bool:
+        return bool(self._samples)
+
+    def clear(self) -> None:
+        self._samples.clear()
+
+    def counter_samples(self) -> Tuple:
+        """Consistent (name, t, value) tuple copy (retry-on-mutation, the
+        `trace._snapshot_deque` discipline)."""
+        from ..trace import _snapshot_deque
+
+        return _snapshot_deque(self._samples)
+
+
+def lru_hit_rate_che(
+    top: Sequence[Tuple[int, float, float]],
+    observed: float,
+    capacity: int,
+) -> float:
+    """Predicted FINITE-TRACE LRU hit rate at ``capacity`` rows from a
+    sketch's ``[(key, count, err)]`` head, via the Che approximation.
+
+    Solve for the characteristic time ``T`` where expected LRU occupancy
+    fills the cache — ``sum_i (1 - exp(-p_i T)) = C`` over tracked items
+    plus the untracked tail modeled as singletons — then count each
+    item's NON-COMPULSORY requests (``count - 1``; a finite trace always
+    pays the first miss) as hits with probability ``1 - exp(-p_i T)``.
+    As ``T -> inf`` (capacity covers the working set) this converges to
+    the perfect-LFU bound ``sum max(count-1, 0) / observed``.
+
+    Head counts are ERR-CORRECTED (``count - err``, the summary's lower
+    bound on truth) and the shaved-off err mass becomes the untracked
+    TAIL, modeled as singletons: Space-Saving preserves total mass
+    (``sum(count) == observed``), and the errs are exactly the churn a
+    low-skew stream hid inside the surviving head — so corrected head +
+    err tail conserves mass with no double count. Tail singletons occupy
+    cache slots (pushing ``T`` down) but contribute no hits — a
+    lower-bound tilt, the honest direction for capacity planning. A
+    heavy-skew stream has near-zero errs and degenerates to the pure
+    head model; a near-uniform stream's prediction collapses toward the
+    compulsory-miss floor instead of parroting the tracked head's LFU
+    bound."""
+    if capacity <= 0 or observed <= 0:
+        return 0.0
+    counts = [max(c - e, 0.0) for _, c, e in top if c - e > 0]
+    # untracked mass == the shaved errs (mass conservation: every evicted
+    # occurrence lives inside some survivor's count, floored by its err);
+    # model it as that many singleton items
+    tail_n = min(
+        observed - sum(counts), observed
+    ) if observed > sum(counts) else 0.0
+    n_items = len(counts) + tail_n
+
+    def occupancy(t: float) -> float:
+        occ = sum(1.0 - math.exp(-(c / observed) * t) for c in counts)
+        if tail_n:
+            occ += tail_n * (1.0 - math.exp(-t / observed))
+        return occ
+
+    def hits(t: float) -> float:
+        return sum(
+            max(c - 1.0, 0.0) * (1.0 - math.exp(-(c / observed) * t))
+            for c in counts
+        )
+
+    if n_items <= capacity:
+        # everything fits: only compulsory first misses remain (LFU bound)
+        return sum(max(c - 1.0, 0.0) for c in counts) / observed
+    lo, hi = 0.0, observed
+    while occupancy(hi) < capacity and hi < observed * 1e6:
+        hi *= 2.0
+    for _ in range(80):
+        mid = (lo + hi) / 2.0
+        if occupancy(mid) < capacity:
+            lo = mid
+        else:
+            hi = mid
+    return hits((lo + hi) / 2.0) / observed
+
+
+@dataclass
+class WorkloadConfig:
+    """Knobs for a `WorkloadMonitor` (pass via
+    ``ServeConfig.workload`` / ``DistServeConfig.workload``; None = no
+    monitor, zero cost).
+
+    topk          : Space-Saving capacity — tracked heavy-hitter keys.
+    cms_width/cms_depth/seed : Count-Min shape; epsilon = e/width,
+                    delta = e^-depth. Fleet merges need identical values.
+    decay         : per-window multiplier applied to both sketches at
+                    each decay tick (1.0 = never forget).
+    decay_every   : flush seals between decay ticks (0 = no decay). Ticks
+                    ride the engine's dispatch index, never wall time —
+                    replayed runs decay at identical points.
+    counter_samples : CounterSeries capacity for the Chrome-trace counter
+                    lane (0 disables the lane; sketches still run).
+    """
+
+    topk: int = 128
+    cms_width: int = 2048
+    cms_depth: int = 4
+    seed: int = 0
+    decay: float = 0.5
+    decay_every: int = 0
+    counter_samples: int = 4096
+
+
+class WorkloadMonitor:
+    """The serve stack's workload telemetry hub: every observe-only tap
+    lands here.
+
+    Taps (all added by the engines when ``config.workload`` is set; see
+    docs/api.md "Workload telemetry"):
+
+    - ``observe_seed(node)`` — per submitted seed
+      (`ServeEngine.submit` / `DistServeEngine.submit`): feeds the
+      Space-Saving top-k and the Count-Min sketch under ONE shared lock.
+    - ``observe_cache(node, hit)`` — `EmbeddingCache` get outcomes
+      (the engine attaches the monitor to its cache).
+    - ``gathers`` — a tier-aware `trace.HitRateCounter` the tiered
+      features (`Feature`/`QuantizedFeature`) attribute gathered rows
+      into per tier (hbm/ici/host/disk).
+    - ``observe_flush(owner, seeds, seconds)`` — per dispatched flush:
+      owner sub-batch width + latency into `OwnerLoadStats`
+      (owner 0 for a single-host engine; real host ids at the router).
+    - ``tick()`` — per flush SEAL, under the engine's sequencing lock:
+      advances the decayed window deterministically and samples the
+      counter lane.
+
+    `skew_report()` condenses all of it into the capacity/replication
+    planning document; `register_metrics` adapts the live state into a
+    `trace.MetricsRegistry`; fleet aggregation rides `merge_all`.
+    """
+
+    def __init__(self, config: Optional[WorkloadConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from ..trace import HitRateCounter
+
+        self.config = config or WorkloadConfig()
+        cfg = self.config
+        self.clock = clock
+        self._lock = threading.Lock()       # monitor-local counters
+        self._sketch_lock = threading.Lock()  # shared by both sketches
+        self.topk = SpaceSaving(cfg.topk, lock=self._sketch_lock)
+        self.cms = CountMinSketch(
+            cfg.cms_width, cfg.cms_depth, cfg.seed, lock=self._sketch_lock
+        )
+        self.gathers = HitRateCounter()
+        self.owners = OwnerLoadStats()
+        self.counters = (
+            CounterSeries(cfg.counter_samples)
+            if cfg.counter_samples > 0 else None
+        )
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.ticks = 0
+        self.decay_ticks = 0
+
+    # -- taps --------------------------------------------------------------
+
+    def observe_seed(self, node: int, w: float = 1.0) -> None:
+        self.topk.update(node, w)
+        self.cms.update(node, w)
+
+    def observe_cache(self, node: int, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+
+    def observe_flush(self, owner: int, seeds: int,
+                      seconds: Optional[float] = None) -> None:
+        self.owners.observe_batch(owner, seeds)
+        if seconds is not None:
+            self.owners.observe_latency(owner, seconds)
+
+    def tick(self) -> None:
+        """One flush seal. Callers invoke this under the engine's
+        sequencing lock, so tick order == dispatch-index order and the
+        decayed window is replay-deterministic."""
+        cfg = self.config
+        with self._lock:
+            self.ticks += 1
+            due = bool(
+                cfg.decay_every and self.ticks % cfg.decay_every == 0
+            )
+            if due:
+                self.decay_ticks += 1
+        if due:
+            self.topk.decay(cfg.decay)
+            self.cms.decay(cfg.decay)
+        cs = self.counters
+        if cs is not None:
+            t = self.clock()
+            cs.record("workload.observed_seeds", t,
+                      self.topk.observed_events)
+            cs.record("workload.head_coverage", t,
+                      self.topk.head_coverage())
+            imb = self.owners.imbalance()
+            if imb["owners"] > 1:
+                cs.record("workload.owner_max_mean_ratio", t,
+                          imb["max_mean_ratio"])
+
+    # -- reports -----------------------------------------------------------
+
+    def skew_report(
+        self,
+        capacities: Sequence[int] = (),
+        top_ks: Sequence[int] = (1, 8, 16, 64),
+    ) -> Dict[str, object]:
+        """The capacity/replication planning document (schema pinned in
+        docs/api.md "Workload telemetry"):
+
+        - ``top_coverage`` — head-concentration curve: estimated request
+          share of the hottest k rows, per k (feeds
+          `scaling.skew_table`'s replication pricing);
+        - ``error_bound`` — Count-Min (epsilon, delta, abs_err),
+          Space-Saving max per-key overestimate and the guarantee
+          threshold (every key above ``observed/topk`` is tracked);
+        - ``predicted_hit_rate`` — finite-trace LRU hit rate per
+          requested cache capacity (`lru_hit_rate_che`), with the
+          perfect-LFU upper bound beside it — prices `EmbeddingCache`
+          sizing and item-2 tier promotion BEFORE they are built;
+        - ``owners`` — per-owner load, imbalance, straggler;
+        - ``cache`` / ``tiers`` — measured cache outcomes and per-tier
+          gather attribution, for predicted-vs-measured closes.
+        """
+        top = self.topk.topk()
+        observed = self.topk.observed
+        cov = {
+            str(k): (
+                min(sum(c for _, c, _ in top[: int(k)]) / observed, 1.0)
+                if observed > 0 else 0.0
+            )
+            for k in top_ks
+        }
+        predicted = {
+            str(int(c)): round(lru_hit_rate_che(top, observed, int(c)), 4)
+            for c in capacities
+        }
+        lfu = {
+            str(int(c)): round(
+                sum(max(cc - 1.0, 0.0) for _, cc, _ in top[: int(c)])
+                / observed, 4
+            ) if observed > 0 else 0.0
+            for c in capacities
+        }
+        with self._lock:
+            hits, misses = self.cache_hits, self.cache_misses
+            ticks, dticks = self.ticks, self.decay_ticks
+        gathers = self.gathers.snapshot()
+        return {
+            "observed_events": self.topk.observed_events,
+            "observed_weight": round(observed, 4),
+            "distinct_tracked": len(self.topk),
+            "ticks": ticks,
+            "decay_ticks": dticks,
+            "top_coverage": cov,
+            "top_rows": [
+                (int(k), round(c, 4), round(e, 4)) for k, c, e in top[:64]
+            ],
+            "error_bound": {
+                "count_min": self.cms.error_bound(),
+                "space_saving_max_err": round(self.topk.max_err(), 4),
+                "space_saving_guarantee_threshold": (
+                    round(observed / self.topk.k, 4)
+                ),
+            },
+            "predicted_hit_rate": predicted,
+            "predicted_hit_rate_lfu_bound": lfu,
+            "cache": {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+            },
+            "tiers": gathers.get("tiers", {}),
+            "owners": self.owners.snapshot(),
+        }
+
+    def register_metrics(self, registry, prefix: str = "quiver_workload",
+                         labels: Optional[Dict[str, str]] = None,
+                         owners: Sequence[int] = ()):
+        """Adapt the monitor's live state into a `trace.MetricsRegistry`
+        (callback-backed, read at exposition time — same discipline as
+        the engine adapters). ``owners`` pre-registers per-owner families
+        (``host`` label) for hosts known up front; owners observed later
+        appear on the next ``register_metrics`` call."""
+        from ..trace import register_hit_rate
+
+        reg = registry
+        reg.counter_fn(f"{prefix}_observed_seeds_total",
+                       lambda: self.topk.observed_events,
+                       "seed submissions observed by the sketches", labels)
+        reg.gauge_fn(f"{prefix}_observed_weight",
+                     lambda: self.topk.observed,
+                     "decayed observed weight in the current window", labels)
+        reg.gauge_fn(f"{prefix}_distinct_tracked",
+                     lambda: len(self.topk),
+                     "keys tracked by the Space-Saving summary", labels)
+        reg.gauge_fn(f"{prefix}_head_coverage",
+                     lambda: self.topk.head_coverage(),
+                     "request share of the tracked head", labels)
+        reg.counter_fn(f"{prefix}_ticks_total", lambda: self.ticks,
+                       "flush-seal ticks observed", labels)
+        reg.counter_fn(f"{prefix}_decay_ticks_total",
+                       lambda: self.decay_ticks,
+                       "decayed-window boundaries crossed", labels)
+        reg.counter_fn(f"{prefix}_cache_hits_total",
+                       lambda: self.cache_hits,
+                       "embedding-cache hits seen by the tap", labels)
+        reg.counter_fn(f"{prefix}_cache_misses_total",
+                       lambda: self.cache_misses,
+                       "embedding-cache misses seen by the tap", labels)
+        register_hit_rate(reg, f"{prefix}_gather", lambda: self.gathers,
+                          labels, tiers=("hbm", "ici", "host", "disk"))
+        owner_ids = sorted(
+            set(int(h) for h in owners) | set(self.owners.seeds_by_owner())
+        )
+        for h in owner_ids:
+            lab = dict(labels or {}, owner=str(h))
+            reg.counter_fn(
+                f"{prefix}_owner_seeds_total",
+                (lambda h=h: self.owners.seeds_by_owner().get(h, 0)),
+                "seeds routed to owner", lab,
+            )
+            reg.gauge_fn(
+                f"{prefix}_owner_flush_p99_ms",
+                (lambda h=h: self.owners.snapshot()["per_owner"]
+                 .get(str(h), {}).get("lat_p99_ms", 0.0)),
+                "owner flush latency p99", lab,
+            )
+        reg.gauge_fn(f"{prefix}_owner_max_mean_ratio",
+                     lambda: self.owners.imbalance()["max_mean_ratio"],
+                     "hottest owner load over mean owner load", labels)
+        reg.gauge_fn(f"{prefix}_owner_top_share",
+                     lambda: self.owners.imbalance()["top_share"],
+                     "hottest owner's share of routed seeds", labels)
+        return reg
+
+    def snapshot(self) -> Dict[str, object]:
+        return self.skew_report()
+
+    def clear(self) -> None:
+        self.topk.clear()
+        self.cms.clear()
+        # reset IN PLACE: the tiered features hold a reference to this
+        # counter (feature.tier_counter), so swapping the object would
+        # silently detach their tap
+        self.gathers.reset()
+        self.owners.clear()
+        if self.counters is not None:
+            self.counters.clear()
+        with self._lock:
+            self.cache_hits = self.cache_misses = 0
+            self.ticks = self.decay_ticks = 0
+
+    # -- fleet aggregation -------------------------------------------------
+
+    @classmethod
+    def merge_all(cls, monitors: Sequence["WorkloadMonitor"],
+                  ) -> "WorkloadMonitor":
+        """One merged monitor over the fleet: Count-Min cells sum exactly
+        (any order, bit-identical), Space-Saving heads merge via the
+        canonical `SpaceSaving.merge_all` (order-independent by
+        construction), cache/tier counters add, owner stats union. The
+        result is a REPORTING object — it has no taps wired and its
+        counter lane is empty."""
+        if not monitors:
+            raise ValueError("merge_all needs at least one monitor")
+        out = cls(monitors[0].config, clock=monitors[0].clock)
+        out.topk = SpaceSaving.merge_all(
+            [m.topk for m in monitors], k=out.config.topk
+        )
+        for m in monitors:
+            out.cms.merge(m.cms)
+            out.gathers.merge(m.gathers)
+            out.owners.merge(m.owners)
+            with m._lock:
+                out.cache_hits += m.cache_hits
+                out.cache_misses += m.cache_misses
+                out.ticks += m.ticks
+                out.decay_ticks += m.decay_ticks
+        return out
+
+    def merge(self, other: "WorkloadMonitor") -> "WorkloadMonitor":
+        """Pairwise fold of ``other`` into self (see `merge_all` for the
+        canonical fleet merge). Returns self."""
+        m = WorkloadMonitor.merge_all([self, other])
+        self.topk = m.topk
+        self.cms = m.cms
+        self.gathers = m.gathers
+        self.owners = m.owners
+        with self._lock:
+            self.cache_hits = m.cache_hits
+            self.cache_misses = m.cache_misses
+            self.ticks = m.ticks
+            self.decay_ticks = m.decay_ticks
+        return self

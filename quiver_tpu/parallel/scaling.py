@@ -674,6 +674,127 @@ def format_serve_markdown(rows: Sequence[ServePrediction]) -> str:
     return "\n".join(lines)
 
 
+class SkewPrediction(NamedTuple):
+    top_k: int                 # rows replicated on every host
+    coverage: float            # measured request share of those rows
+    replica_bytes_per_host: float  # feature bytes the replica set costs
+    exchange_seed_frac: float  # seeds still crossing the exchange
+    exchange_bytes_frac: float # collective payload vs no replication
+    exchange_s: float          # exchange time per routed flush, replicated
+    routed_flush_s: float      # shard dispatch + exchange, replicated
+    qps_uplift: float          # aggregate QPS multiplier vs no replication
+
+
+def skew_table(
+    coverage: Sequence[Tuple[int, float]],
+    hosts: int,
+    bucket: int,
+    out_dim: int,
+    dispatch_s: float,
+    feature_dim: int = 100,
+    feature_bytes_per_elem: float = 4.0,
+    bandwidths: Optional[Dict[str, float]] = None,
+) -> List[SkewPrediction]:
+    """Predicted hot-shard REPLICATION benefit from a MEASURED
+    head-concentration curve — the `scaling` face of the round-13
+    frequency sketch, feeding ROADMAP item 3a before it is built.
+
+    ``coverage`` is [(k, frac)]: the request share of the hottest ``k``
+    rows, straight from ``WorkloadMonitor.skew_report()['top_coverage']``
+    (or an analytic Zipf curve for what-if rows). Replicating those ``k``
+    rows' results on every host means that share of seeds is served
+    locally and never crosses the serve exchange; a routed bucket-B flush
+    then ships only ``(1-frac)*B`` seeds, so the static per-owner lane
+    budget shrinks from ``pow2(B)`` to ``pow2(ceil((1-frac)*B))`` and the
+    exchange term of `serve_table`'s routed-flush model shrinks with it
+    (ids out + logits back, priced against ``dcn_bytes_per_s``; the
+    model matches the engine's measured ``exchange_id_bytes`` /
+    ``exchange_logit_bytes`` counters shape for shape). Aggregate device
+    work is unchanged — hot seeds still compute somewhere — so
+    ``qps_uplift`` isolates what replication buys on the WIRE and at the
+    straggler boundary: (dispatch + exchange_full) / (dispatch +
+    exchange_replicated). ``replica_bytes_per_host`` prices what it
+    costs: k feature rows per host at the stated width.
+
+    ``dispatch_s`` is the per-shard dispatch time at ``bucket/hosts``
+    width (measure it: bench.py ``serve_fused_step_s`` scaled, or the
+    probe's measured costs); ``hosts=1`` rows are legal and show uplift
+    1.0 — replication buys nothing without an exchange to avoid.
+    """
+    if hosts < 1:
+        raise ValueError("hosts must be >= 1")
+    bw = dict(DEFAULT_BANDWIDTHS)
+    if bandwidths:
+        bw.update(bandwidths)
+
+    def pow2(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    def exchange_s_for(lanes: int) -> float:
+        if hosts == 1:
+            return 0.0
+        xbytes = hosts * hosts * lanes * (4 + 4 * out_dim)
+        return xbytes / bw["dcn_bytes_per_s"]
+
+    base_lanes = pow2(bucket)
+    base_x = exchange_s_for(base_lanes)
+    base_t = dispatch_s + base_x
+    rows: List[SkewPrediction] = []
+    for k, frac in coverage:
+        frac = min(max(float(frac), 0.0), 1.0)
+        routed = max(int(math.ceil((1.0 - frac) * bucket)), 0)
+        lanes = pow2(routed) if routed else 0
+        x_s = exchange_s_for(lanes) if routed else 0.0
+        t = dispatch_s + x_s
+        rows.append(
+            SkewPrediction(
+                top_k=int(k),
+                coverage=frac,
+                replica_bytes_per_host=(
+                    float(k) * feature_dim * feature_bytes_per_elem
+                ),
+                exchange_seed_frac=routed / bucket if bucket else 0.0,
+                # zero baseline (hosts=1: no exchange exists) -> nothing
+                # is paid, so the honest fraction is 0, not 100%
+                exchange_bytes_frac=(
+                    exchange_s_for(lanes) / base_x if base_x else 0.0
+                ),
+                exchange_s=x_s,
+                routed_flush_s=t,
+                qps_uplift=base_t / t if t > 0 else 1.0,
+            )
+        )
+    return rows
+
+
+def format_skew_markdown(rows: Sequence[SkewPrediction]) -> str:
+    lines = [
+        "| replicated top-k | coverage | replica KB/host | exchange seeds | exchange bytes | exchange ms | routed flush ms | QPS uplift |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r.top_k} | {r.coverage:.0%} "
+            f"| {r.replica_bytes_per_host/1e3:.1f} "
+            f"| {r.exchange_seed_frac:.0%} | {r.exchange_bytes_frac:.0%} "
+            f"| {r.exchange_s*1e3:.3f} | {r.routed_flush_s*1e3:.2f} "
+            f"| {r.qps_uplift:.2f}x |"
+        )
+    lines.append("")
+    lines.append(
+        "Coverage from a measured head-concentration curve "
+        "(WorkloadMonitor.skew_report — the round-13 frequency sketch); "
+        "replicating the top-k keeps that request share off the serve "
+        "exchange, shrinking the static lane budget pow2(bucket) -> "
+        "pow2((1-coverage)*bucket). Device work is unchanged — the uplift "
+        "is the wire term only (ROADMAP item 3a's predicted benefit)."
+    )
+    return "\n".join(lines)
+
+
 def format_markdown(rows: Sequence[LayoutPrediction], step_s_1chip: float,
                     bandwidths: Optional[Dict[str, float]] = None) -> str:
     bw = dict(DEFAULT_BANDWIDTHS)

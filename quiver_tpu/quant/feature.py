@@ -80,6 +80,10 @@ class QuantizedFeature:
         self._scale_dev = None
         self._zero_dev = None
         self._order_dev = None
+        # observe-only workload tap (round 13): same contract as
+        # Feature.tier_counter — eager gathers attribute rows per tier of
+        # the INNER (encoded) shard book
+        self.tier_counter = None
 
     # ------------------------------------------------------------------ build
     def from_cpu_tensor(self, cpu_tensor) -> None:
@@ -213,6 +217,13 @@ class QuantizedFeature:
         invalid = (ids < 0) | (ids >= self._n)
         safe = np.where(invalid, 0, ids)
         stored = self.feature_order[safe] if self.feature_order is not None else safe
+        if self.tier_counter is not None:
+            from ..feature import attribute_gather_tiers
+
+            attribute_gather_tiers(
+                self.inner.shard_tensor, self.rank, stored,
+                self.tier_counter, valid=~invalid,
+            )
         q = self.inner.shard_tensor[stored]
         if self._scale_np is not None:
             s = jnp.asarray(self._scale_np[stored])

@@ -44,6 +44,11 @@ class EmbeddingCache:
         self.capacity = int(capacity)
         self.counters = counters if counters is not None else HitRateCounter()
         self.invalidations = 0
+        # observe-only workload tap (round 13): when the owning engine
+        # attaches its WorkloadMonitor here, every get() outcome feeds
+        # monitor.observe_cache(node, hit) — the cache half of the access
+        # sketch's evidence. Never read by the cache itself.
+        self.workload = None
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
 
@@ -54,19 +59,26 @@ class EmbeddingCache:
         """Value for ``node_id`` at exactly ``version``, else None. A hit
         refreshes LRU recency; a stale-versioned entry counts as a miss AND
         an eviction (it is dropped on touch)."""
+        wl = self.workload
         with self._lock:
             ent = self._entries.get(node_id)
             if ent is None:
                 self.counters.miss()
+                if wl is not None:
+                    wl.observe_cache(node_id, False)
                 return None
             ver, value = ent
             if ver != version:
                 del self._entries[node_id]
                 self.counters.evict()
                 self.counters.miss()
+                if wl is not None:
+                    wl.observe_cache(node_id, False)
                 return None
             self._entries.move_to_end(node_id)
             self.counters.hit()
+            if wl is not None:
+                wl.observe_cache(node_id, True)
             return value
 
     def put(self, node_id: Hashable, version: int, value: np.ndarray) -> None:

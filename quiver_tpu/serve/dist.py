@@ -78,6 +78,8 @@ from ..trace import (
     LatencyHistogram,
     MetricsRegistry,
     SpanRecorder,
+    WorkloadConfig,
+    WorkloadMonitor,
     export_chrome_trace as _export_chrome_trace,
     register_hit_rate,
 )
@@ -380,6 +382,17 @@ class DistServeConfig:
                      within — the same discipline as the stats merges).
                      Observe-only, same contract as
                      `ServeConfig.journal_events`.
+    workload       : a `trace.WorkloadConfig` enables round-13 workload
+                     telemetry at the ROUTER (access-frequency sketches
+                     over every submitted seed, per-owner routed
+                     sub-batch widths + flush/exchange latency quantiles,
+                     imbalance + straggler stats) and — via the default
+                     shard config — at every owner engine (owner-side
+                     sketches, cache taps, tier attribution).
+                     `workload_report()` / `fleet_registry()` are the
+                     read side. Observe-only, replay-deterministic decay
+                     ticks on the router's dispatch index, same contract
+                     as `ServeConfig.workload`.
     """
 
     hosts: int = 2
@@ -397,6 +410,7 @@ class DistServeConfig:
     feature_residency: str = "closure"
     late_admission: bool = True
     journal_events: int = 0
+    workload: Optional[WorkloadConfig] = None
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -410,6 +424,7 @@ class DistServeConfig:
             record_dispatches=self.record_dispatches,
             late_admission=self.late_admission,
             journal_events=self.journal_events,
+            workload=self.workload,
         )
 
 
@@ -531,11 +546,21 @@ class DistServeEngine:
         )
         self._next_rid = 0     # journal request ids (guarded by _lock)
         self._flush_index = 0  # router dispatch-log index (guarded by _seq)
+        # round-13 router-side workload telemetry (observe-only): the
+        # router sees EVERY submitted seed, so its sketch is the fleet's
+        # access-frequency view; per-owner load/latency land here too
+        self.workload = (
+            WorkloadMonitor(self.config.workload, clock=self._clock)
+            if self.config.workload is not None
+            else None
+        )
         rc = self.config.router_cache_entries
         self.cache = EmbeddingCache(
             self.config.cache_entries if rc is None else rc,
             counters=self.stats.router_cache,
         )
+        if self.workload is not None:
+            self.cache.workload = self.workload
         self.params_version = 0
         self.dispatch_log: List[Tuple[np.ndarray, List[Tuple[int, np.ndarray]]]] = []
         self._pending: Dict[int, _Slot] = {}
@@ -722,8 +747,11 @@ class DistServeEngine:
         now = self._clock()
         need_flush = False
         jr = self.journal
+        wl = self.workload
         with self._lock:
             self.stats.requests += 1
+            if wl is not None:
+                wl.observe_seed(key)  # observe-only frequency tap
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
                 self.stats.latency.record_ms((self._clock() - now) * 1e3)
@@ -814,6 +842,10 @@ class DistServeEngine:
         with self._lock:
             self._open = None
         self._flush_index += 1
+        if self.workload is not None:
+            # decay tick on the router's dispatch index (caller holds
+            # _seq) — replay-deterministic, never wall time
+            self.workload.tick()
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
             arr = np.asarray(fl.keys, np.int64)
@@ -836,6 +868,7 @@ class DistServeEngine:
         # a = bucket per the EVENT_KINDS vocabulary; the router's "bucket"
         # is its admission cap (it pads nothing)
         self.journal.emit("dispatch", -1, fl.fid, fl.bucket)
+        wl = self.workload
         out = np.zeros((len(fl.keys), self.out_dim), np.float32)
         if self.exchange_mode == "collective":
             by_host = {h: (ids, pos) for h, ids, pos in fl.split}
@@ -843,9 +876,18 @@ class DistServeEngine:
                 by_host[h][0] if h in by_host else np.array([], np.int64)
                 for h in range(self.hosts)
             ]
+            t_x0 = self._clock() if wl is not None else 0.0
             res = self.comm.exchange_serve(
                 host2ids, out_dim=self.out_dim, budget=self._budget
             )
+            if wl is not None:
+                # one exchange round-trip covers every owner: its
+                # duration is each participating owner's flush latency at
+                # the router grain (per-owner separation needs host mode
+                # or the owners' own monitors)
+                dt = self._clock() - t_x0
+                for h, ids, _ in fl.split:
+                    wl.observe_flush(h, len(ids), dt)
             L = self._budget
             with self._lock:
                 self.stats.exchange_id_bytes += self.hosts * self.hosts * L * 4
@@ -856,7 +898,13 @@ class DistServeEngine:
                 out[pos] = res[h]
         else:
             for h, ids, pos in fl.split:
+                t_h0 = self._clock() if wl is not None else 0.0
                 out[pos] = np.asarray(self.engines[h].predict(ids))
+                if wl is not None:
+                    # host mode calls owners sequentially, so each
+                    # owner's leg is individually timed — TRUE per-owner
+                    # straggler evidence
+                    wl.observe_flush(h, len(ids), self._clock() - t_h0)
         out.setflags(write=False)
         # one routed round-trip = one "execute" at the router grain
         self.journal.emit("execute_done", -1, fl.fid, len(fl.split))
@@ -997,6 +1045,8 @@ class DistServeEngine:
             self.cache.counters = self.stats.router_cache
             if self.journal.enabled:
                 self.journal.clear()
+            if self.workload is not None:
+                self.workload.clear()
         for eng in self.engines.values():
             eng.reset_stats()
 
@@ -1057,6 +1107,11 @@ class DistServeEngine:
         reg.histogram(f"{prefix}_latency_ms",
                       "end-to-end routed request latency", labels,
                       fn=lambda: self.stats.latency)
+        if self.workload is not None:
+            self.workload.register_metrics(
+                reg, prefix=f"{prefix}_workload", labels=labels,
+                owners=range(self.hosts),
+            )
         return reg
 
     def fleet_registry(self, registry: Optional[MetricsRegistry] = None,
@@ -1111,6 +1166,52 @@ class DistServeEngine:
             "metrics": self.fleet_registry().snapshot(),
         }
 
+    def workload_report(self, capacities: Sequence[int] = (),
+                        ) -> Dict[str, object]:
+        """The fleet's skew/imbalance planning document (round 13;
+        requires ``DistServeConfig.workload``):
+
+        - ``router`` — the ROUTER monitor's `skew_report`: since the
+          router observes every submitted seed, this is the fleet's
+          access-frequency truth (head-concentration curve, predicted
+          hit rate vs capacity) plus per-owner routed load, imbalance
+          and straggler stats;
+        - ``per_shard`` — each owner engine's own report (owner-side
+          cache outcomes, tier attribution);
+        - ``shards_merged`` — `WorkloadMonitor.merge_all` over the owner
+          monitors in sorted-host order: the multi-process deployment
+          shape, where no single router sees every seed and the fleet
+          view IS the merge (order-independent by construction — pinned
+          in tests/test_skew.py). NOT router + owners: the router
+          already counted every seed the owners saw, and summing the two
+          would double-count.
+        """
+        if self.workload is None:
+            raise ValueError(
+                "workload telemetry is off — pass "
+                "DistServeConfig(workload=WorkloadConfig(...))"
+            )
+        owner_monitors = [
+            self.engines[h].workload
+            for h in sorted(self.engines)
+            if self.engines[h].workload is not None
+        ]
+        out: Dict[str, object] = {
+            "router": self.workload.skew_report(capacities=capacities),
+            "per_shard": {
+                str(h): self.engines[h].workload.skew_report(
+                    capacities=capacities
+                )
+                for h in sorted(self.engines)
+                if self.engines[h].workload is not None
+            },
+        }
+        if owner_monitors:
+            out["shards_merged"] = WorkloadMonitor.merge_all(
+                owner_monitors
+            ).skew_report(capacities=capacities)
+        return out
+
     def export_chrome_trace(self, path: str, extra_sources: Sequence = (),
                             metadata: Optional[Dict[str, object]] = None,
                             ) -> Dict[str, object]:
@@ -1121,11 +1222,15 @@ class DistServeEngine:
         sources: List = [("router.spans", self.stats.spans)]
         if self.journal.enabled:
             sources.append(("router.journal", self.journal))
+        if self.workload is not None and self.workload.counters is not None:
+            sources.append(("router.workload", self.workload.counters))
         for h in sorted(self.engines):
             eng = self.engines[h]
             sources.append((f"owner{h}.spans", eng.stats.spans))
             if eng.journal.enabled:
                 sources.append((f"owner{h}.journal", eng.journal))
+            if eng.workload is not None and eng.workload.counters is not None:
+                sources.append((f"owner{h}.workload", eng.workload.counters))
         rec = comm_mod.EXCHANGE_SPANS
         if rec is not None and len(rec):
             sources.append(("comm.exchange", rec))

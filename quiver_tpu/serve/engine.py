@@ -110,6 +110,8 @@ from ..trace import (
     LatencyHistogram,
     MetricsRegistry,
     SpanRecorder,
+    WorkloadConfig,
+    WorkloadMonitor,
     export_chrome_trace as _export_chrome_trace,
     register_hit_rate,
 )
@@ -191,6 +193,20 @@ class ServeConfig:
                      tests/test_obs.py); cost is one deque append per
                      event, cheap enough to leave on (bench.py
                      ``serve_obs_overhead_frac``).
+    workload       : a `trace.WorkloadConfig` enables the round-13
+                     workload telemetry (None = off, zero cost): a
+                     `trace.WorkloadMonitor` taps every submitted seed
+                     (frequency sketches), every `EmbeddingCache` get
+                     outcome, per-flush width/latency, and — when the
+                     feature is a tiered `Feature`/`QuantizedFeature` —
+                     per-tier gather attribution. Decay ticks ride flush
+                     SEALS (the dispatch index, under the sequencing
+                     lock), never wall time, so sketch state is
+                     replay-bit-stable. Same OBSERVE-ONLY contract as the
+                     journal: enabling it changes no served bit (pinned
+                     in tests/test_skew.py; measured price: bench.py
+                     ``serve_skew_overhead_frac``).
+                     ``engine.workload.skew_report()`` is the read side.
     """
 
     max_batch: int = 64
@@ -204,6 +220,7 @@ class ServeConfig:
     dispatch_mode: str = "auto"
     late_admission: bool = True
     journal_events: int = 0
+    workload: Optional[WorkloadConfig] = None
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -441,8 +458,27 @@ class ServeEngine:
             else NULL_JOURNAL
         )
         self._next_rid = 0  # journal request ids (guarded by _lock)
+        # round-13 workload telemetry (ServeConfig.workload; observe-only)
+        self.workload = (
+            WorkloadMonitor(self.config.workload, clock=self._clock)
+            if self.config.workload is not None
+            else None
+        )
         self.cache = EmbeddingCache(self.config.cache_entries,
                                     counters=self.stats.cache)
+        if self.workload is not None:
+            self.cache.workload = self.workload
+        if hasattr(feature, "tier_counter"):
+            # tiered features attribute gathered rows per tier into the
+            # monitor (Feature/QuantizedFeature; raw tables and in-jit
+            # fused gathers are single-tier by construction). The LAST
+            # engine built over a feature owns its tap: a workload-less
+            # engine explicitly DETACHES any stale counter a previous
+            # engine left behind, so a reused feature never pays the
+            # attribution scan for (or counts into) a dead monitor.
+            feature.tier_counter = (
+                self.workload.gathers if self.workload is not None else None
+            )
         self.params_version = 0
         self.dispatch_log: List[Tuple[np.ndarray, int]] = []
         # queue state: _pending holds slots not yet flushed (insertion order
@@ -484,8 +520,11 @@ class ServeEngine:
         now = self._clock()
         need_flush = False
         jr = self.journal
+        wl = self.workload
         with self._lock:
             self.stats.requests += 1
+            if wl is not None:
+                wl.observe_seed(key)  # observe-only frequency tap
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
                 self.stats.latency.record_ms((self._clock() - now) * 1e3)
@@ -596,6 +635,10 @@ class ServeEngine:
         with self._lock:
             self._open = None
         self._dispatch_index += 1
+        if self.workload is not None:
+            # decay-window tick on the dispatch index (caller holds _seq,
+            # so tick order == seal order — replay-deterministic)
+            self.workload.tick()
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
             fl.seeds = np.asarray(fl.keys, dtype=np.int64)
@@ -734,7 +777,12 @@ class ServeEngine:
                     logits = self._dispatch(fl)
                 except BaseException as exc:
                     fl.error = exc
-                self.stats.spans.record("dispatch", t0, self._clock())
+                t1 = self._clock()
+                self.stats.spans.record("dispatch", t0, t1)
+                if self.workload is not None:
+                    # per-flush width + latency (owner 0: this engine is
+                    # the only "owner" at single-host grain)
+                    self.workload.observe_flush(0, len(fl.keys), t1 - t0)
             self._resolve(fl, logits)  # records its own post-lock span
             if fl.error is not None:
                 raise fl.error
@@ -768,6 +816,10 @@ class ServeEngine:
             self.cache.counters = self.stats.cache
             if self.journal.enabled:
                 self.journal.clear()
+            if self.workload is not None:
+                # same straddle rule as the journal: sketch/owner state
+                # from before the reset would skew every report after it
+                self.workload.clear()
 
     # -- observability surface --------------------------------------------
 
@@ -823,6 +875,10 @@ class ServeEngine:
         reg.histogram(f"{prefix}_latency_ms",
                       "end-to-end request latency (submit -> resolve)",
                       labels, fn=lambda: self.stats.latency)
+        if self.workload is not None:
+            self.workload.register_metrics(
+                reg, prefix=f"{prefix}_workload", labels=labels, owners=(0,)
+            )
         return reg
 
     def export_chrome_trace(self, path: str, extra_sources: Sequence = (),
@@ -839,6 +895,10 @@ class ServeEngine:
         sources: List = [("serve.spans", self.stats.spans)]
         if self.journal.enabled:
             sources.append(("serve.journal", self.journal))
+        if self.workload is not None and self.workload.counters is not None:
+            # the round-13 counter lane: sampled workload series (head
+            # coverage, observed seeds) graph under the flush lanes
+            sources.append(("serve.workload", self.workload.counters))
         sources.extend(extra_sources)
         return _export_chrome_trace(path, sources, metadata)
 

@@ -400,31 +400,67 @@ class LatencyHistogram:
 
 
 class HitRateCounter:
-    """Hit/miss/eviction counters for the serving caches (thread-safe)."""
+    """Hit/miss/eviction counters for the serving caches (thread-safe).
+
+    Round 13 adds optional PER-TIER attribution (``hit(n, tier="hbm")``):
+    the aggregate fields keep their exact round-8 semantics — every
+    existing merge/snapshot consumer is untouched — while ``tiers`` holds
+    a per-tier {hits, misses, evictions} breakdown on the side, so cache
+    hits vs HBM / ICI-stripe / host-tail / disk gathers are
+    distinguishable in snapshots and Prometheus (`register_hit_rate`
+    ``tiers=``). A tier-attributed count ALWAYS lands in the aggregate
+    too (the tier split is a refinement, never a fork)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # tier -> [hits, misses, evictions]; empty until a tier= call
+        self.tiers: Dict[str, List[int]] = {}
 
-    def hit(self, n: int = 1) -> None:
+    def _tier(self, tier: str) -> List[int]:
+        t = self.tiers.get(tier)
+        if t is None:
+            t = self.tiers[tier] = [0, 0, 0]
+        return t
+
+    def hit(self, n: int = 1, tier: Optional[str] = None) -> None:
         with self._lock:
             self.hits += n
+            if tier is not None:
+                self._tier(tier)[0] += n
 
-    def miss(self, n: int = 1) -> None:
+    def miss(self, n: int = 1, tier: Optional[str] = None) -> None:
         with self._lock:
             self.misses += n
+            if tier is not None:
+                self._tier(tier)[1] += n
 
-    def evict(self, n: int = 1) -> None:
+    def evict(self, n: int = 1, tier: Optional[str] = None) -> None:
         with self._lock:
             self.evictions += n
+            if tier is not None:
+                self._tier(tier)[2] += n
+
+    def tier_counts(self, tier: str) -> Dict[str, int]:
+        with self._lock:
+            h, m, e = self.tiers.get(tier, (0, 0, 0))
+        return {"hits": h, "misses": m, "evictions": e}
+
+    def reset(self) -> None:
+        """Zero every count IN PLACE (holders keep their reference — the
+        workload monitor's clear() relies on this, since tiered features
+        hold the counter as their tap)."""
+        with self._lock:
+            self.hits = self.misses = self.evictions = 0
+            self.tiers.clear()
 
     def merge(self, other: "HitRateCounter") -> "HitRateCounter":
         """Fold ``other``'s counts into this counter (cross-shard cache
         stats for the distributed serve engine; multi-run aggregation for
-        probes). Same lock-order note as `LatencyHistogram.merge`. Returns
-        self for chaining."""
+        probes), per-tier breakdowns included. Same lock-order note as
+        `LatencyHistogram.merge`. Returns self for chaining."""
         if not isinstance(other, HitRateCounter):
             raise TypeError(f"cannot merge {type(other).__name__}")
         with self._lock:
@@ -432,6 +468,11 @@ class HitRateCounter:
                 self.hits += other.hits
                 self.misses += other.misses
                 self.evictions += other.evictions
+                for tier, (h, m, e) in other.tiers.items():
+                    t = self._tier(tier)
+                    t[0] += h
+                    t[1] += m
+                    t[2] += e
         return self
 
     @property
@@ -444,12 +485,22 @@ class HitRateCounter:
         return self.hits / t if t else 0.0
 
     def snapshot(self) -> Dict[str, float]:
-        return {
+        out = {
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
             "hit_rate": self.hit_rate,
         }
+        with self._lock:
+            if self.tiers:
+                # only when tier attribution is in use: existing consumers
+                # comparing snapshots of untiered counters see the exact
+                # round-8 dict
+                out["tiers"] = {
+                    t: {"hits": v[0], "misses": v[1], "evictions": v[2]}
+                    for t, v in sorted(self.tiers.items())
+                }
+        return out
 
 
 # -- request-scoped lifecycle journal -----------------------------------------
@@ -988,13 +1039,18 @@ class MetricsRegistry:
 
 def register_hit_rate(registry: MetricsRegistry, name: str,
                       counter,
-                      labels: Optional[Dict[str, str]] = None) -> None:
+                      labels: Optional[Dict[str, str]] = None,
+                      tiers: Sequence[str] = ()) -> None:
     """Adapt a live `HitRateCounter` into ``registry`` as
     ``<name>_{hits,misses,evictions}_total`` + ``<name>_hit_rate`` —
     callback-backed, so the counter keeps counting into itself and the
     registry reads it at snapshot time. ``counter`` may be the counter
     itself or a zero-arg resolver (engines whose ``reset_stats`` swaps
-    the stats object pass a resolver so the registry follows the swap)."""
+    the stats object pass a resolver so the registry follows the swap).
+    ``tiers`` additionally registers the per-tier attribution families
+    (``<name>_tier_{hits,misses}_total`` under a ``tier`` label) for the
+    named tiers — HBM vs ICI vs host-tail vs disk gathers become separate
+    Prometheus series (round-13 tier attribution)."""
     get = counter if callable(counter) else (lambda: counter)
     registry.counter_fn(f"{name}_hits_total", lambda: get().hits,
                         "cache hits", labels)
@@ -1004,6 +1060,18 @@ def register_hit_rate(registry: MetricsRegistry, name: str,
                         "cache evictions", labels)
     registry.gauge_fn(f"{name}_hit_rate", lambda: get().hit_rate,
                       "hits / (hits + misses)", labels)
+    for tier in tiers:
+        lab = dict(labels or {}, tier=str(tier))
+        registry.counter_fn(
+            f"{name}_tier_hits_total",
+            (lambda tier=tier: get().tier_counts(tier)["hits"]),
+            "per-tier attributed hits (rows served from this tier)", lab,
+        )
+        registry.counter_fn(
+            f"{name}_tier_misses_total",
+            (lambda tier=tier: get().tier_counts(tier)["misses"]),
+            "per-tier attributed misses", lab,
+        )
 
 
 # -- Chrome-trace (Perfetto) export -------------------------------------------
@@ -1037,11 +1105,16 @@ def chrome_trace_events(
     """Merge span/journal sources into Chrome ``trace_events`` dicts.
 
     ``sources`` is [(process_name, source)] where a source is a
-    `SpanRecorder` (or any iterable of (stage, t0, t1) triples) or an
-    `EventJournal`. Each source becomes one pid; stage names (and journal
-    flush lanes) become named tids. All sources must share one monotonic
-    clock (the serve stack's engines/journals/comm spans all do);
-    ``time_origin`` (default: earliest timestamp seen) rebases ts to 0.
+    `SpanRecorder` (or any iterable of (stage, t0, t1) triples), an
+    `EventJournal`, or a COUNTER source — any object with a
+    ``counter_samples()`` method yielding (name, t, value) tuples
+    (`quiver_tpu.obs.CounterSeries`): each counter name renders as a
+    Chrome ``ph: "C"`` track, so sampled series (workload head coverage,
+    owner imbalance) graph alongside the flush lanes. Each source becomes
+    one pid; stage names (and journal flush lanes) become named tids. All
+    sources must share one monotonic clock (the serve stack's
+    engines/journals/comm spans all do); ``time_origin`` (default:
+    earliest timestamp seen) rebases ts to 0.
 
     Journal rendering: per-flush lifecycle becomes complete ("X") slices —
     ``flush <fid>`` spanning seal->resolve on a per-overlap lane (so
@@ -1052,6 +1125,7 @@ def chrome_trace_events(
     spans_by_pid: List[Tuple[int, str, List[Tuple[str, float, float]]]] = []
     instants: List[Tuple[int, float, str, Dict[str, object]]] = []
     flush_slices: List[Tuple[int, float, float, str, Dict[str, object], int]] = []
+    counter_rows: List[Tuple[int, float, str, float]] = []
     # an EXPLICIT origin is honored verbatim (callers aligning several
     # exports on one shared clock); only when absent is the earliest
     # timestamp used
@@ -1099,6 +1173,14 @@ def chrome_trace_events(
                 )
                 for sname, st0, st1 in subs:
                     flush_slices.append((pid, st0, st1, sname, {}, lane))
+            spans_by_pid.append((pid, pname, []))
+        elif hasattr(src, "counter_samples"):
+            # the counter lane (round 13): sampled (name, t, value) series
+            # rendered as Chrome "C" counter tracks
+            for cname, t, v in src.counter_samples():
+                if not explicit_origin and (t_min is None or t < t_min):
+                    t_min = t
+                counter_rows.append((pid, t, cname, v))
             spans_by_pid.append((pid, pname, []))
         else:
             triples = [tuple(s) for s in src]
@@ -1159,6 +1241,12 @@ def chrome_trace_events(
             "pid": pid, "tid": tid_for(pid, "requests"), "cat": "request",
             "args": args,
         })
+    for pid, t, cname, v in counter_rows:
+        events.append({
+            "name": cname, "ph": "C", "ts": us(t),
+            "pid": pid, "tid": tid_for(pid, cname), "cat": "counter",
+            "args": {"value": v},
+        })
     return events
 
 
@@ -1183,6 +1271,25 @@ def export_chrome_trace(
         with open(path, "w") as fh:
             json.dump(doc, fh)
     return doc
+
+
+# -- workload telemetry (quiver_tpu.obs) re-export ----------------------------
+# The round-13 sketches/monitor live in their own subsystem but are part
+# of the one observability surface this module is; re-exporting here keeps
+# "import the trace module, get the telemetry" true. obs imports nothing
+# from trace at module level (lazy method-local imports only), so this
+# bottom-of-module import is cycle-safe in either import order.
+
+from .obs import (  # noqa: E402
+    CounterSeries,
+    CountMinSketch,
+    OwnerLoadStats,
+    P2Quantile,
+    SpaceSaving,
+    WorkloadConfig,
+    WorkloadMonitor,
+    lru_hit_rate_che,
+)
 
 
 # -- jax profiler pass-throughs ----------------------------------------------

@@ -41,6 +41,16 @@ def main():
     # routed engine — per-shard dispatch + DCN exchange term
     ap.add_argument("--serve-hosts", default="1,2,4,8")
     ap.add_argument("--serve-out-dim", type=int, default=47)
+    # hot-shard replication what-if (round 13): head-concentration curve
+    # source — a SERVE_r06 skew artifact's measured top_coverage, or an
+    # analytic Zipf(alpha) curve when no artifact is given
+    ap.add_argument("--skew", default=None,
+                    help="SERVE_r06.json skew artifact to read the "
+                         "measured head-concentration curve from")
+    ap.add_argument("--skew-alpha", type=float, default=1.3,
+                    help="analytic Zipf alpha for the replication table "
+                         "when no --skew artifact is given")
+    ap.add_argument("--skew-nodes", type=int, default=100_000)
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
 
@@ -78,10 +88,12 @@ def main():
         format_markdown,
         format_quant_markdown,
         format_serve_markdown,
+        format_skew_markdown,
         products_scaling_table,
         quant_fetch_table,
         serve_table,
         sharded_fetch_table,
+        skew_table,
     )
 
     bw = {"ici_bytes_per_s": args.ici_gbps * 1e9, "dcn_bytes_per_s": args.dcn_gbps * 1e9}
@@ -208,11 +220,68 @@ def main():
         "core).\n\n"
         + format_serve_markdown(dist_rows)
     )
+    # hot-shard replication table (round 13, ROADMAP item 3a): predicted
+    # wire-side benefit of replicating the measured hot head on every
+    # host, from the frequency sketch's head-concentration curve
+    per_seed = (serve_cost[0] + serve_cost[1]) / max(serve_cost[2], 1)
+    skew_bucket = 256
+    skew_hosts = max(
+        [int(h) for h in args.serve_hosts.split(",")] or [2]
+    )
+    if args.skew:
+        with open(args.skew) as fh:
+            skew_doc = json.load(fh)
+        pts = [p for p in skew_doc.get("points", [])
+               if p.get("skew_report")]
+        pt = max(pts, key=lambda p: p.get("alpha", 0)) if pts else None
+        cov_map = (pt or {}).get("skew_report", {}).get("top_coverage", {})
+        cov = sorted((int(k), float(v)) for k, v in cov_map.items())
+        skew_source = (
+            f"{args.skew} measured top_coverage (alpha="
+            f"{(pt or {}).get('alpha')})"
+        )
+    else:
+        import math as _math
+
+        harm = sum(r ** -args.skew_alpha
+                   for r in range(1, args.skew_nodes + 1))
+        cov, acc = [], 0.0
+        ks = (64, 256, 1024, 4096)
+        it = iter(ks)
+        nxt = next(it)
+        for r in range(1, max(ks) + 1):
+            acc += r ** -args.skew_alpha / harm
+            if r == nxt:
+                cov.append((r, acc))
+                nxt = next(it, None)
+                if nxt is None:
+                    break
+        skew_source = (
+            f"analytic Zipf(alpha={args.skew_alpha}) over "
+            f"{args.skew_nodes} nodes"
+        )
+    skew_rows = skew_table(
+        cov, hosts=skew_hosts, bucket=skew_bucket,
+        out_dim=args.serve_out_dim,
+        dispatch_s=per_seed * -(-skew_bucket // skew_hosts),
+        bandwidths={"dcn_bytes_per_s": args.dcn_gbps * 1e9},
+    )
+    skew_md = (
+        "## Hot-shard replication: predicted benefit from the measured "
+        "access skew (round 13)\n\n"
+        f"Coverage source: {skew_source}; hosts={skew_hosts}, global "
+        f"bucket {skew_bucket}.\nMeasured counterpart: "
+        "scripts/serve_probe.py --skew -> SERVE_r06.json "
+        "(sketch-vs-exact overlap,\npredicted-vs-measured hit rate, "
+        "owner imbalance).\n\n"
+        + format_skew_markdown(skew_rows)
+    )
     print(md, file=sys.stderr)
     print("\n" + fetch_md, file=sys.stderr)
     print("\n" + quant_md, file=sys.stderr)
     print("\n" + serve_md, file=sys.stderr)
     print("\n" + serve_dist_md, file=sys.stderr)
+    print("\n" + skew_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -226,7 +295,8 @@ def main():
         with open(args.out, "w") as fh:
             fh.write(
                 header + md + "\n\n" + fetch_md + "\n\n" + quant_md
-                + "\n\n" + serve_md + "\n\n" + serve_dist_md + "\n"
+                + "\n\n" + serve_md + "\n\n" + serve_dist_md
+                + "\n\n" + skew_md + "\n"
             )
     print(json.dumps({
         "step_s_1chip": step_s,
@@ -244,6 +314,8 @@ def main():
         "serve": [r._asdict() for r in serve_rows],
         "serve_one_vs_two_dispatch": [r._asdict() for r in serve_dispatch_rows],
         "serve_dist": [r._asdict() for r in dist_rows],
+        "skew_source": skew_source,
+        "skew_replication": [r._asdict() for r in skew_rows],
     }))
 
 
