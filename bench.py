@@ -647,6 +647,57 @@ def bench_e2e(context, bd, tiles, seeds_all, table, iters=None, classes=47, caps
         )
 
 
+def bench_tier_rows(context, n=8192, dim=100, reps=5):
+    """Round-14 per-row tier gather costs — the MEASURED inputs of
+    `scaling.tier_table` (``tier_hbm_row_s`` / ``tier_host_row_s`` /
+    ``tier_disk_row_s``): one adaptive `tiers.TierStore` over a synthetic
+    [n, dim] table, a 256-row gather timed per tier. The disk number is
+    the POOLED flat-file read on this box's page cache — real cold
+    storage is slower; `scripts/serve_probe.py --tiers` carries the
+    simulated-latency comparison, this leg prices the mechanism."""
+    import tempfile
+
+    from quiver_tpu.pipeline import AsyncReadPool
+    from quiver_tpu.tiers import TIER_DISK, TIER_HBM, TIER_HOST, TierStore
+
+    rng = np.random.default_rng(17)
+    arr = rng.standard_normal((n, dim)).astype(np.float32)
+    store = TierStore.build(
+        arr, os.path.join(tempfile.mkdtemp(prefix="qt_bench_tiers_"), "t"),
+        hbm_rows=n // 8, host_rows=n // 4,
+        read_pool=AsyncReadPool(4, chunk_rows=128),
+    )
+
+    for tier, key in ((TIER_HBM, "tier_hbm_row_s"),
+                      (TIER_HOST, "tier_host_row_s"),
+                      (TIER_DISK, "tier_disk_row_s")):
+        res = store.placement.residents(tier)
+        batch = np.tile(res, -(-256 // max(res.size, 1)))[:256]
+        np.asarray(store.gather(batch))  # warm (compile + page cache)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(store.gather(batch))
+        context[key] = (time.perf_counter() - t0) / reps / batch.size
+    # tier_table's disk input is the SINGLE-THREAD read cost (the model
+    # divides by the pool width itself); measure it on the bare backing
+    # read, no pool in the loop
+    disk_ids = store.placement.residents(TIER_DISK)[:256]
+    store.backing.read_block(disk_ids)  # warm page cache
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        store.backing.read_block(disk_ids)
+    context["tier_disk_row_single_s"] = (
+        (time.perf_counter() - t0) / reps / disk_ids.size
+    )
+    log(
+        "tier per-row gather: hbm "
+        f"{context['tier_hbm_row_s']*1e6:.2f} us, host "
+        f"{context['tier_host_row_s']*1e6:.2f} us, disk(pooled page-cache) "
+        f"{context['tier_disk_row_s']*1e6:.2f} us, disk(single-thread) "
+        f"{context['tier_disk_row_single_s']*1e6:.2f} us"
+    )
+
+
 def bench_tiered_pipeline(
     context, indptr_np, indices_np, caps, batches=4, batch=1024, dim=100, classes=47
 ):
@@ -1300,6 +1351,13 @@ def main():
             log("budget exhausted before serve bench")
     except Exception as exc:
         log(f"serve bench failed: {exc}")
+    try:
+        if remaining() > 30:
+            bench_tier_rows(context)
+        else:
+            log("budget exhausted before tier-row bench")
+    except Exception as exc:
+        log(f"tier-row bench failed: {exc}")
 
     seps_fused = results.get("fused", 0.0)
     print(

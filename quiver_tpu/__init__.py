@@ -26,13 +26,15 @@ from .partition import (
     partition_feature_without_replication,
     quiver_partition_feature,
 )
-from . import comm, obs, pyg, trace
+from . import comm, obs, pyg, tiers, trace
 from . import quant
 from . import serve
+from .tiers import DiskShard, PlacementPlan, TierPlacement, TierStore
 from .quant import QuantizedFeature
 from .serve import DistServeConfig, DistServeEngine, ServeConfig, ServeEngine
 from .comm import HostRankTable, NcclComm, TpuComm, getNcclId
 from .pipeline import (
+    AsyncReadPool,
     TieredBatch,
     TieredFeaturePipeline,
     TrainPipeline,
@@ -79,6 +81,12 @@ __all__ = [
     "reindex_by_config",
     "reindex_feature",
     "show_tensor_info",
+    "AsyncReadPool",
+    "DiskShard",
+    "PlacementPlan",
+    "TierPlacement",
+    "TierStore",
+    "tiers",
     "TieredBatch",
     "TieredFeaturePipeline",
     "TrainPipeline",

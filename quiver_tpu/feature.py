@@ -115,6 +115,13 @@ def attribute_gather_tiers(shard_tensor, rank, stored_ids, counter,
         n = int(((ids >= off.start) & (ids < off.end)).sum())
         if n:
             counter.hit(n, tier="host")
+    off = getattr(shard_tensor, "disk_offset", None)
+    if getattr(shard_tensor, "disk_shard", None) is not None and off is not None:
+        # the round-14 flat-file tail: REAL disk-hit counts (the "disk"
+        # label register_hit_rate has carried since round 13, now fed)
+        n = int(((ids >= off.start) & (ids < off.end)).sum())
+        if n:
+            counter.hit(n, tier="disk")
 
 
 @jax.jit
@@ -138,6 +145,26 @@ class Feature:
     device_cache_size : per-chip hot bytes (int or "200M"/"4G" strings)
     cache_policy : "device_replicate" | "p2p_clique_replicate" | "ici_replicate"
     csr_topo : optional CSRTopo — enables degree-ordered hot placement
+
+    Round 14 (disk tier — docs/api.md "Tiered storage"):
+
+    host_memory_budget : host-DRAM byte budget for the middle tier when a
+        disk tier is configured (int or "200M" strings; 0 = no DRAM tier
+        — HBM misses go straight to disk). WITHOUT ``disk_path`` this
+        knob is ignored and the host tail is unbounded (the legacy
+        3-tier layout).
+    disk_path : flat-file ``.npy`` path for the 4th tier. Static mode
+        spills rows beyond ``device_cache_size + host_memory_budget``
+        there; adaptive mode writes the FULL stored table (the backing
+        file placement moves never have to rewrite).
+    adaptive_tiers : overlay a `tiers.TierStore` placement map instead
+        of the static shard book — rows then promote/demote between
+        disk <-> DRAM <-> HBM in fenced batches (the serve engines'
+        ``adapt_tiers``/``apply_placement``). Placement is bit-neutral:
+        gathers return identical bytes under any placement.
+    disk_read_workers : `pipeline.AsyncReadPool` width for disk reads
+        (used when no ``read_pool`` is passed).
+    read_pool : share an existing `AsyncReadPool` across features.
     """
 
     def __init__(
@@ -148,11 +175,26 @@ class Feature:
         cache_policy: str = "device_replicate",
         csr_topo: Optional[CSRTopo] = None,
         dtype=np.float32,
+        host_memory_budget: Union[int, str] = 0,
+        disk_path: Optional[str] = None,
+        adaptive_tiers: bool = False,
+        disk_read_workers: int = 4,
+        read_pool=None,
     ):
         if cache_policy == "ici_replicate":
             cache_policy = "p2p_clique_replicate"
         if cache_policy not in ("device_replicate", "p2p_clique_replicate"):
             raise ValueError(f"unknown cache_policy: {cache_policy}")
+        if adaptive_tiers and disk_path is None:
+            raise ValueError(
+                "adaptive_tiers needs a disk_path (the full-table backing "
+                "file is what makes placement moves bit-neutral)"
+            )
+        if disk_path is not None and cache_policy != "device_replicate":
+            raise ValueError(
+                "disk tiers support cache_policy='device_replicate' only "
+                "(the clique stripe has no per-rank disk story yet)"
+            )
         # dtype of the in-memory tiers: bfloat16 doubles the rows every HBM
         # byte buys (the reference is float32-only, quiver_feature.cu:65-69).
         # The mmap disk tier keeps its on-disk dtype.
@@ -171,11 +213,23 @@ class Feature:
         self._local_order_applied = False
         self.mmap_handle_ = None  # disk tier (reference feature.py:84-93)
         self.disk_map: Optional[np.ndarray] = None
+        # round-14 disk tier + adaptive placement
+        self.host_memory_budget = parse_size(host_memory_budget)
+        self.disk_path = disk_path
+        self.adaptive_tiers = bool(adaptive_tiers)
+        self.disk_read_workers = int(disk_read_workers)
+        self.read_pool = read_pool
+        self.tier_store = None  # tiers.TierStore when adaptive
+        self._inv_order: Optional[np.ndarray] = None
         # observe-only workload tap (round 13): when a tier-aware
         # HitRateCounter is attached, every eager gather attributes its
         # rows per tier (attribute_gather_tiers) — placement telemetry,
         # never control flow
         self.tier_counter = None
+        # round-14 row-access tap: a callable fed every VALID gathered
+        # STORED row id (`WorkloadMonitor.observe_rows`) — the gather-
+        # frequency sketch the tier planner reads. Observe-only too.
+        self.row_tap = None
 
     # ------------------------------------------------------------------ build
     def from_cpu_tensor(self, cpu_tensor) -> None:
@@ -200,6 +254,11 @@ class Feature:
             arr, order = reindex_feature(self.csr_topo, arr, ratio)
             self.feature_order = order
             self.csr_topo.feature_order = order
+            self._inv_order = None
+
+        if self.disk_path is not None:
+            self._build_disk_tiers(arr, cache_rows)
+            return
 
         st = ShardTensor(self.rank, ShardTensorConfig({}), dtype=self.dtype)
         if self.cache_policy == "device_replicate":
@@ -225,6 +284,46 @@ class Feature:
                 cursor += rows
             if cursor < self._n:
                 st.append(arr[cursor:], CPU_DEVICE)
+        self.shard_tensor = st
+
+    def _build_disk_tiers(self, arr: np.ndarray, cache_rows: int) -> None:
+        """4-tier build (round 14): HBM prefix -> DRAM middle (bounded by
+        ``host_memory_budget``) -> flat-file disk tail. ``arr`` is the
+        STORED order (degree-reordered when a csr_topo is attached), so
+        the prefix placement is the hot head either way. Adaptive mode
+        overlays a `tiers.TierStore` with the IDENTICAL initial
+        placement — a frozen adaptive store and a static one serve
+        bit-identical bytes from the same tiers."""
+        row_bytes = self._dim * self.dtype.itemsize
+        host_rows = 0
+        if self.host_memory_budget > 0:
+            host_rows = min(
+                self.host_memory_budget // row_bytes, self._n - cache_rows
+            )
+        if self.read_pool is None:
+            from .pipeline import AsyncReadPool
+
+            self.read_pool = AsyncReadPool(self.disk_read_workers)
+        if self.adaptive_tiers:
+            from .tiers import TierStore
+
+            self.tier_store = TierStore.build(
+                arr, self.disk_path, hbm_rows=cache_rows,
+                host_rows=host_rows, rank=self.rank,
+                read_pool=self.read_pool,
+            )
+            self.shard_tensor = None
+            return
+        st = ShardTensor(self.rank, ShardTensorConfig({}), dtype=self.dtype)
+        if cache_rows > 0:
+            st.append(arr[:cache_rows], self.rank)
+        if host_rows > 0:
+            st.append(arr[cache_rows : cache_rows + host_rows], CPU_DEVICE)
+        if cache_rows + host_rows < self._n:
+            st.append_disk(
+                arr[cache_rows + host_rows :], self.disk_path,
+                read_pool=self.read_pool,
+            )
         self.shard_tensor = st
 
     @classmethod
@@ -294,6 +393,20 @@ class Feature:
         are read from the mmap and merged (reference feature.py:309-333)."""
         if self.mmap_handle_ is not None:
             return self._getitem_with_disk(node_idx)
+        ids, invalid = self._map_ids(node_idx)
+        if self.tier_counter is not None:
+            self._attribute(ids, valid=~invalid)
+        if self.row_tap is not None:
+            self.row_tap(ids[~invalid])
+        rows = self.gather_stored(ids)
+        if invalid.any():
+            rows = rows * jnp.asarray(~invalid, rows.dtype)[:, None]
+        return rows
+
+    def _map_ids(self, node_idx):
+        """(stored_rows, invalid_mask) for a lookup batch — the id remap
+        every gather path shares. Invalid lanes map to stored row 0 and
+        are zeroed by the caller."""
         ids = np.asarray(node_idx).astype(np.int64).reshape(-1)
         if self._local_order_applied:
             # distributed path: ids are GLOBAL but self._n is the LOCAL row
@@ -309,15 +422,63 @@ class Feature:
                 ids = np.where(invalid, 0, ids)
             if self.feature_order is not None:
                 ids = self.feature_order[ids]
-        if self.tier_counter is not None:
-            attribute_gather_tiers(
-                self.shard_tensor, self.rank, ids, self.tier_counter,
-                valid=~invalid,
-            )
-        rows = self.shard_tensor[ids]
-        if invalid.any():
-            rows = rows * jnp.asarray(~invalid, rows.dtype)[:, None]
-        return rows
+        return ids, invalid
+
+    def _attribute(self, stored: np.ndarray, valid: np.ndarray) -> None:
+        """Observe-only per-tier attribution of a gather (round 13/14):
+        static shard books count by offset range; adaptive stores by the
+        LIVE placement map (hbm/host/disk as placed right now)."""
+        tc = self.tier_counter
+        if self.tier_store is not None:
+            split = self.tier_store.tier_split(stored[valid])
+            for tier, n in split.items():
+                if n:
+                    tc.hit(n, tier=tier)
+            return
+        attribute_gather_tiers(
+            self.shard_tensor, self.rank, stored, tc, valid=valid
+        )
+
+    def gather_stored(self, stored) -> jax.Array:
+        """Gather by STORED row id through whichever store backs this
+        feature (static shard book or adaptive tier store) — the surface
+        `QuantizedFeature` and the tests' oracles share."""
+        if self.tier_store is not None:
+            return self.tier_store.gather(stored)
+        return self.shard_tensor[stored]
+
+    def tier_bytes(self) -> Dict[str, int]:
+        """Live per-tier byte footprint (adaptive stores report the
+        CURRENT placement — a demotion batch shrinks ``device``
+        immediately; the honest-accounting pin in tests/test_tiers.py)."""
+        if self.tier_store is not None:
+            return self.tier_store.tier_bytes()
+        if self.shard_tensor is not None:
+            return self.shard_tensor.tier_bytes()
+        return {}
+
+    def stored_rows_of(self, node_ids) -> np.ndarray:
+        """Node id -> stored row (-1 for out-of-range / unowned ids) —
+        how the tier planner maps sketch keys into placement space."""
+        ids = np.asarray(node_ids).astype(np.int64).reshape(-1)
+        stored, invalid = self._map_ids(ids)
+        return np.where(invalid, -1, stored)
+
+    def node_ids_of_stored(self, stored) -> np.ndarray:
+        """Stored row -> node id (inverse of ``feature_order``; identity
+        without a reorder) — how a placement batch names the embedding-
+        cache entries it must invalidate."""
+        stored = np.asarray(stored, np.int64).reshape(-1)
+        if self.feature_order is None:
+            return stored
+        if self._inv_order is None:
+            order = self.feature_order
+            valid = order >= 0
+            size = int(order[valid].max()) + 1 if valid.any() else 0
+            inv = np.full(size, -1, np.int64)
+            inv[order[valid]] = np.nonzero(valid)[0]
+            self._inv_order = inv
+        return self._inv_order[stored]
 
     def _getitem_with_disk(self, node_idx) -> jax.Array:
         """Disk-mask merge (reference feature.py:309-333): ``disk_map`` splits
@@ -398,6 +559,7 @@ class Feature:
         order[local_order] = np.arange(local_order.shape[0], dtype=np.int64)
         self.feature_order = order
         self._order_dev = None
+        self._inv_order = None
         self._local_order_applied = True
 
     # ------------------------------------------------------- ipc-compat shims

@@ -31,6 +31,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from .sketch import CountMinSketch, SpaceSaving
 
 
@@ -379,6 +381,15 @@ class WorkloadConfig:
     topk          : Space-Saving capacity — tracked heavy-hitter keys.
     cms_width/cms_depth/seed : Count-Min shape; epsilon = e/width,
                     delta = e^-depth. Fleet merges need identical values.
+    row_topk      : 0 (off) or the capacity of a SECOND sketch pair over
+                    GATHERED feature rows (round 14): seeds measure what
+                    clients ask, rows measure what the tiers actually
+                    serve (seeds + sampled neighbors — the traffic tier
+                    placement must optimize). Keys are STORED row ids
+                    (the features tap post-remap), so the tier planner
+                    consumes them without a node mapping. Costs one
+                    sketch update per gathered row — leave off unless a
+                    tier consumer reads it.
     decay         : per-window multiplier applied to both sketches at
                     each decay tick (1.0 = never forget).
     decay_every   : flush seals between decay ticks (0 = no decay). Ticks
@@ -392,6 +403,7 @@ class WorkloadConfig:
     cms_width: int = 2048
     cms_depth: int = 4
     seed: int = 0
+    row_topk: int = 0
     decay: float = 0.5
     decay_every: int = 0
     counter_samples: int = 4096
@@ -437,6 +449,18 @@ class WorkloadMonitor:
         self.cms = CountMinSketch(
             cfg.cms_width, cfg.cms_depth, cfg.seed, lock=self._sketch_lock
         )
+        # round-14 row-access sketches (WorkloadConfig.row_topk): what
+        # the TIERS serve — stored-row keyed, fed by the features' gather
+        # tap, read by the tier planner. None = off, zero cost.
+        self.row_sketch = (
+            SpaceSaving(cfg.row_topk, lock=self._sketch_lock)
+            if cfg.row_topk > 0 else None
+        )
+        self.row_cms = (
+            CountMinSketch(cfg.cms_width, cfg.cms_depth, cfg.seed + 1,
+                           lock=self._sketch_lock)
+            if cfg.row_topk > 0 else None
+        )
         self.gathers = HitRateCounter()
         self.owners = OwnerLoadStats()
         self.counters = (
@@ -453,6 +477,43 @@ class WorkloadMonitor:
     def observe_seed(self, node: int, w: float = 1.0) -> None:
         self.topk.update(node, w)
         self.cms.update(node, w)
+
+    def observe_rows(self, stored_ids) -> None:
+        """Per-gather row tap (round 14): every VALID gathered feature
+        row, keyed by STORED row id (the tiered features call this with
+        pad/invalid lanes already masked). No-op unless
+        ``WorkloadConfig.row_topk`` enabled the row sketches.
+
+        The batch is pre-aggregated (one WEIGHTED update per distinct
+        row) so the hot serve path pays O(distinct) sketch updates, not
+        O(rows): ``observed`` weight counts every row exactly, while
+        ``observed_events`` counts the aggregated updates (= distinct
+        rows per gather) — read weights, not event counts, for row
+        traffic shares."""
+        rs = self.row_sketch
+        if rs is None:
+            return
+        ids = np.asarray(stored_ids, dtype=np.int64).reshape(-1)
+        if ids.size == 0:
+            return
+        uniq, counts = np.unique(ids, return_counts=True)
+        for sid, c in zip(uniq.tolist(), counts.tolist()):
+            rs.update(sid, float(c))
+            self.row_cms.update(sid, float(c))
+
+    def row_promotion_candidates(
+        self, limit: Optional[int] = None, min_weight: float = 0.0
+    ) -> List[Tuple[int, float]]:
+        """`promotion_candidates` over the ROW sketch: ``[(stored_row,
+        err-corrected weight)]`` hottest-first — the tier planner's
+        preferred input (gather traffic, not just seed traffic)."""
+        if self.row_sketch is None:
+            return []
+        return [
+            (int(k), float(max(c - e, 0.0)))
+            for k, c, e in self.row_sketch.topk(limit)
+            if c - e >= min_weight and c - e > 0
+        ]
 
     def observe_cache(self, node: int, hit: bool) -> None:
         with self._lock:
@@ -482,6 +543,9 @@ class WorkloadMonitor:
         if due:
             self.topk.decay(cfg.decay)
             self.cms.decay(cfg.decay)
+            if self.row_sketch is not None:
+                self.row_sketch.decay(cfg.decay)
+                self.row_cms.decay(cfg.decay)
         cs = self.counters
         if cs is not None:
             t = self.clock()
@@ -495,6 +559,26 @@ class WorkloadMonitor:
                           imb["max_mean_ratio"])
 
     # -- reports -----------------------------------------------------------
+
+    def promotion_candidates(
+        self, limit: Optional[int] = None, min_weight: float = 0.0
+    ) -> List[Tuple[int, float]]:
+        """The sketch's answer to "which rows should the fast tiers
+        hold": ``[(node_id, weight)]`` sorted hottest-first, weights
+        ERR-CORRECTED (``count - err`` — the Space-Saving lower bound on
+        truth, so a churn-inflated key cannot buy a promotion its real
+        traffic didn't earn). Entries below ``min_weight`` are dropped;
+        ``limit`` caps the list (None = the whole tracked head). This is
+        the read side of ROADMAP item 2's promote/demote consumer
+        (`ServeEngine.adapt_tiers`); the planner maps node ids into
+        stored-row space and prices eviction victims against the
+        Count-Min estimate."""
+        out = [
+            (int(k), float(max(c - e, 0.0)))
+            for k, c, e in self.topk.topk(limit)
+            if c - e >= min_weight and c - e > 0
+        ]
+        return out
 
     def skew_report(
         self,
@@ -542,7 +626,25 @@ class WorkloadMonitor:
             hits, misses = self.cache_hits, self.cache_misses
             ticks, dticks = self.ticks, self.decay_ticks
         gathers = self.gathers.snapshot()
+        rows = None
+        if self.row_sketch is not None:
+            rows = {
+                # events = aggregated (per-gather-distinct) updates;
+                # weight = true row count — read weight for traffic shares
+                "observed_events": self.row_sketch.observed_events,
+                "observed_weight": round(self.row_sketch.observed, 4),
+                "distinct_tracked": len(self.row_sketch),
+                "top_coverage": {
+                    str(k): round(self.row_sketch.head_coverage(int(k)), 4)
+                    for k in top_ks
+                },
+                "top_rows": [
+                    (int(k), round(c, 4), round(e, 4))
+                    for k, c, e in self.row_sketch.topk(64)
+                ],
+            }
         return {
+            "row_sketch": rows,
             "observed_events": self.topk.observed_events,
             "observed_weight": round(observed, 4),
             "distinct_tracked": len(self.topk),
@@ -636,6 +738,9 @@ class WorkloadMonitor:
     def clear(self) -> None:
         self.topk.clear()
         self.cms.clear()
+        if self.row_sketch is not None:
+            self.row_sketch.clear()
+            self.row_cms.clear()
         # reset IN PLACE: the tiered features hold a reference to this
         # counter (feature.tier_counter), so swapping the object would
         # silently detach their tap
@@ -664,6 +769,16 @@ class WorkloadMonitor:
         out.topk = SpaceSaving.merge_all(
             [m.topk for m in monitors], k=out.config.topk
         )
+        with_rows = [m for m in monitors if m.row_sketch is not None]
+        if out.row_sketch is not None and with_rows:
+            # merge whichever monitors DO track rows (a shard built with
+            # row_topk=0 contributes nothing, it never dropped any) —
+            # requiring all-of-them would silently discard fleet row data
+            out.row_sketch = SpaceSaving.merge_all(
+                [m.row_sketch for m in with_rows], k=out.config.row_topk
+            )
+            for m in with_rows:
+                out.row_cms.merge(m.row_cms)
         for m in monitors:
             out.cms.merge(m.cms)
             out.gathers.merge(m.gathers)
@@ -681,6 +796,12 @@ class WorkloadMonitor:
         m = WorkloadMonitor.merge_all([self, other])
         self.topk = m.topk
         self.cms = m.cms
+        if m.row_sketch is not None:
+            # never replace accumulated row state with a fresh empty
+            # sketch (m's row pair is None/empty when self has row_topk=0
+            # — there is nothing to adopt then)
+            self.row_sketch = m.row_sketch
+            self.row_cms = m.row_cms
         self.gathers = m.gathers
         self.owners = m.owners
         with self._lock:

@@ -770,6 +770,113 @@ def skew_table(
     return rows
 
 
+class TierPrediction(NamedTuple):
+    mix: str
+    hbm_frac: float
+    host_frac: float
+    disk_frac: float
+    gather_s: float        # host-side tiered gather per flush
+    h2d_bytes: float       # cold rows shipped per flush (host + disk)
+    flush_s: float         # gather + device dispatch (split path: serial)
+    qps: float             # bucket / flush_s
+    slowdown_vs_hbm: float # flush_s over the all-HBM flush_s
+
+
+def tier_table(
+    mixes: Sequence[Tuple[str, float, float, float]],
+    bucket: int,
+    dispatch_s: float,
+    hbm_row_s: float,
+    host_row_s: float,
+    disk_row_s: float,
+    feature_dim: int = 100,
+    bytes_per_elem: float = 4.0,
+    read_workers: int = 4,
+) -> List[TierPrediction]:
+    """Price disk/DRAM/HBM HIT MIXES for the round-14 tiered serve path
+    — the `scaling` face of the disk tier, answering "what does a
+    placement (or a predicted hit-rate curve) cost per flush" BEFORE a
+    run commits to it.
+
+    ``mixes`` is ``[(name, f_hbm, f_host, f_disk)]`` — fractions of a
+    bucket-``B`` flush's feature rows resolving in each tier. Feed it
+    MEASURED attribution (``WorkloadMonitor.skew_report()['tiers']``
+    normalized, or `Feature.tier_bytes` ratios) for placement-vs-
+    placement comparisons, or the Che-predicted hit rate at a candidate
+    DRAM capacity (``predicted_hit_rate``) for what-if rows.
+
+    Per-row tier costs are MEASURED inputs (bench.py legs or the
+    probe's in-run timings — this model invents no constants):
+    ``hbm_row_s`` the amortized jitted-take cost, ``host_row_s`` the
+    native DRAM gather + H2D share, and ``disk_row_s`` the
+    SINGLE-THREAD flat-file read per row (bench.py
+    ``tier_disk_row_single_s``; NOT the pooled ``tier_disk_row_s``,
+    which already amortizes the workers — feeding it here would
+    double-discount the disk term). Disk reads fan out over the
+    `AsyncReadPool`'s ``read_workers``, so the model divides the
+    single-thread cost by the pool width. The tiered
+    gather is host-mediated (split dispatch path), so a flush costs
+    ``gather + dispatch`` serially — the honest upper bound the probe's
+    measured p99 is compared against.
+    """
+    if bucket < 1:
+        raise ValueError("bucket must be >= 1")
+    if read_workers < 1:
+        raise ValueError("read_workers must be >= 1")
+    base = dispatch_s + bucket * hbm_row_s
+    rows: List[TierPrediction] = []
+    for name, f_hbm, f_host, f_disk in mixes:
+        fracs = (float(f_hbm), float(f_host), float(f_disk))
+        if any(f < 0 for f in fracs) or abs(sum(fracs) - 1.0) > 1e-6:
+            raise ValueError(
+                f"mix {name!r} fractions must be >= 0 and sum to 1: {fracs}"
+            )
+        f_hbm, f_host, f_disk = fracs
+        gather_s = bucket * (
+            f_hbm * hbm_row_s
+            + f_host * host_row_s
+            + f_disk * disk_row_s / read_workers
+        )
+        h2d = bucket * (f_host + f_disk) * feature_dim * bytes_per_elem
+        flush_s = dispatch_s + gather_s
+        rows.append(
+            TierPrediction(
+                mix=str(name),
+                hbm_frac=f_hbm,
+                host_frac=f_host,
+                disk_frac=f_disk,
+                gather_s=gather_s,
+                h2d_bytes=h2d,
+                flush_s=flush_s,
+                qps=bucket / flush_s if flush_s > 0 else 0.0,
+                slowdown_vs_hbm=flush_s / base if base > 0 else 0.0,
+            )
+        )
+    return rows
+
+
+def format_tier_markdown(rows: Sequence[TierPrediction]) -> str:
+    lines = [
+        "| mix | hbm | dram | disk | gather ms | H2D KB | flush ms | QPS bound | vs all-HBM |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r.mix} | {r.hbm_frac:.0%} | {r.host_frac:.0%} "
+            f"| {r.disk_frac:.0%} | {r.gather_s*1e3:.3f} "
+            f"| {r.h2d_bytes/1e3:.1f} | {r.flush_s*1e3:.2f} "
+            f"| {r.qps:.0f} | {r.slowdown_vs_hbm:.2f}x |"
+        )
+    lines.append("")
+    lines.append(
+        "Hit mixes priced with MEASURED per-row tier costs (bench/probe "
+        "inputs; disk term divided by the read pool width). Feed measured "
+        "attribution (skew_report tiers) or Che-predicted hit rates at a "
+        "candidate capacity — the round-14 placement planning table."
+    )
+    return "\n".join(lines)
+
+
 def format_skew_markdown(rows: Sequence[SkewPrediction]) -> str:
     lines = [
         "| replicated top-k | coverage | replica KB/host | exchange seeds | exchange bytes | exchange ms | routed flush ms | QPS uplift |",

@@ -41,6 +41,122 @@ from .pyg.sage_sampler import DenseSample, GraphSageSampler
 from .trace import SpanRecorder, trace_scope
 
 
+class AsyncReadPool:
+    """Bounded worker pool for cold-tier DISK reads (round 14).
+
+    The train pipeline's stage pools are one-worker-per-stage because the
+    stages are inherently serial; disk reads are the opposite — each
+    chunk is an independent page-cache/disk access, so a batch split
+    across ``workers`` threads overlaps the page faults (the C read loop
+    and the memmap fault path both release the GIL). `gather` is the
+    synchronous surface the tier stores call per batch; `submit` returns
+    a future for prefetch-shaped callers.
+
+    Error contract (the mirror of this module's mid-epoch fix, round 7):
+    a failing chunk read CANCELS every queued sibling chunk, observes
+    every future (no "exception was never retrieved" at GC), and
+    re-raises the first failure by submission order at the caller — a
+    deterministic raise, never a hang. The pool survives the failure and
+    keeps serving subsequent gathers.
+    """
+
+    def __init__(self, workers: int = 4, chunk_rows: int = 4096,
+                 name: str = "qt-diskread"):
+        if workers < 1:
+            raise ValueError("AsyncReadPool needs >= 1 worker")
+        if chunk_rows < 1:
+            raise ValueError("chunk_rows must be >= 1")
+        self.workers = int(workers)
+        self.chunk_rows = int(chunk_rows)
+        self._pool = concurrent.futures.ThreadPoolExecutor(workers, name)
+        # plain ints under the GIL (same discipline as ServeStats fields)
+        self.reads = 0       # chunk reads issued
+        self.gathers = 0     # gather() batches served
+        self.rows = 0
+        self.bytes = 0
+        self.errors = 0
+        self.seconds = 0.0
+
+    def _chunks(self, ids: np.ndarray):
+        n = ids.shape[0]
+        per = max(
+            self.chunk_rows if n > self.workers * self.chunk_rows
+            else -(-n // self.workers),
+            1,
+        )
+        return [ids[i : i + per] for i in range(0, n, per)]
+
+    def gather(self, read_block, local_ids: np.ndarray) -> np.ndarray:
+        """``read_block(ids_chunk) -> rows`` fanned across the workers;
+        returns the concatenated rows in input order."""
+        import time as _time
+
+        ids = np.asarray(local_ids, np.int64).reshape(-1)
+        t0 = _time.monotonic()
+        self.gathers += 1
+        if ids.shape[0] == 0:
+            return read_block(ids)
+        chunks = self._chunks(ids)
+        if len(chunks) == 1:
+            # no pool hop for a batch one worker would serve anyway
+            self.reads += 1
+            out = read_block(chunks[0])
+            self.rows += out.shape[0]
+            self.bytes += out.nbytes
+            self.seconds += _time.monotonic() - t0
+            return out
+        futs = [self._pool.submit(read_block, c) for c in chunks]
+        self.reads += len(futs)
+        error: Optional[BaseException] = None
+        parts = []
+        for f in futs:
+            if error is not None:
+                # first failure wins: cancel what has not started and
+                # observe the rest so nothing logs at GC
+                f.cancel()
+                f.add_done_callback(
+                    lambda fut: fut.cancelled() or fut.exception()
+                )
+                continue
+            try:
+                parts.append(f.result())
+            except BaseException as exc:
+                error = exc
+        if error is not None:
+            self.errors += 1
+            raise error
+        out = np.concatenate(parts, axis=0)
+        self.rows += out.shape[0]
+        self.bytes += out.nbytes
+        self.seconds += _time.monotonic() - t0
+        return out
+
+    def submit(self, read_block, local_ids: np.ndarray):
+        """Async single-chunk read (prefetch-shaped callers); the future
+        resolves to the rows or raises the read's error."""
+        return self._pool.submit(read_block, np.asarray(local_ids, np.int64))
+
+    def stats(self) -> dict:
+        return {
+            "workers": self.workers,
+            "gathers": self.gathers,
+            "reads": self.reads,
+            "rows": self.rows,
+            "bytes": self.bytes,
+            "errors": self.errors,
+            "seconds": self.seconds,
+        }
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait, cancel_futures=True)
+
+    def __enter__(self) -> "AsyncReadPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
 class TieredBatch(NamedTuple):
     """Device-ready inputs for one pipelined step."""
 
@@ -88,6 +204,14 @@ class TieredFeaturePipeline:
     """
 
     def __init__(self, feature: Feature, device=None):
+        if getattr(feature, "tier_store", None) is not None:
+            # adaptive disk-backed features have no shard book at all —
+            # name the real reason before the generic not-built error
+            raise ValueError(
+                "the train pipeline does not span the disk tier (its cold "
+                "stage gathers the host tail only); adaptive disk-backed "
+                "features serve through the engines' tiered __getitem__ path"
+            )
         st = feature.shard_tensor
         if st is None:
             raise ValueError("feature not built; call from_cpu_tensor first")
@@ -95,6 +219,12 @@ class TieredFeaturePipeline:
             raise ValueError(
                 "tiered pipeline expects one hot shard + optional host tail; "
                 "use the mesh-sharded gather for clique-striped features"
+            )
+        if getattr(st, "disk_shard", None) is not None:
+            raise ValueError(
+                "the train pipeline does not span the disk tier (its cold "
+                "stage gathers the host tail only); disk-backed features "
+                "serve through the engines' tiered __getitem__ path"
             )
         self.feature = feature
         self.device = device or jax.local_devices()[0]

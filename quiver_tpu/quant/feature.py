@@ -62,8 +62,22 @@ class QuantizedFeature:
         device_cache_size: Union[int, str] = 0,
         cache_policy: str = "device_replicate",
         csr_topo: Optional[CSRTopo] = None,
+        host_memory_budget: Union[int, str] = 0,
+        disk_path: Optional[str] = None,
+        adaptive_tiers: bool = False,
+        disk_read_workers: int = 4,
+        read_pool=None,
     ):
         self.codec = get_codec(codec)
+        # round-14 disk tier: passed straight to the inner Feature, so the
+        # spilled tail (and the adaptive backing file) hold ENCODED rows —
+        # cold rows are int8 on disk AND on the wire. The fp32 side tables
+        # stay device-resident over all N (unchanged accounting below).
+        self.host_memory_budget = host_memory_budget
+        self.disk_path = disk_path
+        self.adaptive_tiers = bool(adaptive_tiers)
+        self.disk_read_workers = int(disk_read_workers)
+        self.read_pool = read_pool
         self.rank = rank
         self.device_list = list(device_list) if device_list else [rank]
         self.device_cache_size = parse_size(device_cache_size)
@@ -72,6 +86,7 @@ class QuantizedFeature:
         self.cache_policy = cache_policy
         self.csr_topo = csr_topo
         self.feature_order: Optional[np.ndarray] = None
+        self._inv_order: Optional[np.ndarray] = None
         self.inner: Optional[Feature] = None
         self._n = 0
         self._dim: Optional[int] = None
@@ -84,6 +99,8 @@ class QuantizedFeature:
         # Feature.tier_counter — eager gathers attribute rows per tier of
         # the INNER (encoded) shard book
         self.tier_counter = None
+        # round-14 row-access tap (see Feature.row_tap)
+        self.row_tap = None
 
     # ------------------------------------------------------------------ build
     def from_cpu_tensor(self, cpu_tensor) -> None:
@@ -130,6 +147,7 @@ class QuantizedFeature:
             arr, order = reindex_feature(self.csr_topo, arr, ratio)
             self.feature_order = order
             self.csr_topo.feature_order = order
+            self._inv_order = None
         enc = self.codec.encode(arr)
         # the inner Feature re-derives cache_rows from ITS row bytes, so
         # hand it exactly cache_rows * payload bytes (csr_topo=None: the
@@ -141,6 +159,11 @@ class QuantizedFeature:
             cache_policy=self.cache_policy,
             csr_topo=None,
             dtype=self.codec.storage_dtype,
+            host_memory_budget=self.host_memory_budget,
+            disk_path=self.disk_path,
+            adaptive_tiers=self.adaptive_tiers,
+            disk_read_workers=self.disk_read_workers,
+            read_pool=self.read_pool,
         )
         inner.from_cpu_tensor(enc.payload)
         self.inner = inner
@@ -154,6 +177,41 @@ class QuantizedFeature:
     @property
     def shard_tensor(self):
         return None if self.inner is None else self.inner.shard_tensor
+
+    @property
+    def tier_store(self):
+        """The inner store's adaptive `tiers.TierStore` (None when
+        static) — placement moves operate on ENCODED rows."""
+        return None if self.inner is None else self.inner.tier_store
+
+    def tier_bytes(self):
+        """Live ENCODED-payload bytes per tier (see
+        `Feature.tier_bytes`); side tables are reported separately by
+        :meth:`side_table_bytes` — together they are the full device
+        charge, and demotions shrink the payload term immediately."""
+        return {} if self.inner is None else self.inner.tier_bytes()
+
+    def stored_rows_of(self, node_ids) -> np.ndarray:
+        """Node id -> stored (encoded) row; -1 out of range. The outer
+        wrapper owns the reorder, so the map lives HERE, not on the
+        inner Feature (whose order is None by construction)."""
+        ids = np.asarray(node_ids).astype(np.int64).reshape(-1)
+        oob = (ids < 0) | (ids >= self._n)
+        stored = np.where(oob, 0, ids)
+        if self.feature_order is not None:
+            stored = self.feature_order[stored]
+        return np.where(oob, -1, stored)
+
+    def node_ids_of_stored(self, stored) -> np.ndarray:
+        """Stored row -> node id (inverse of the outer reorder)."""
+        stored = np.asarray(stored, np.int64).reshape(-1)
+        if self.feature_order is None:
+            return stored
+        if getattr(self, "_inv_order", None) is None:
+            inv = np.full(self._n, -1, np.int64)
+            inv[self.feature_order] = np.arange(self._n, dtype=np.int64)
+            self._inv_order = inv
+        return self._inv_order[stored]
 
     @property
     def dtype(self):
@@ -172,7 +230,10 @@ class QuantizedFeature:
 
     @property
     def hot_rows(self) -> int:
-        """Rows resident in this handle's HBM shards (the hot prefix)."""
+        """Rows resident in this handle's HBM shards (the hot prefix;
+        LIVE placement count for adaptive stores)."""
+        if self.tier_store is not None:
+            return self.tier_store.placement.counts()["hbm"]
         st = self.shard_tensor
         if st is None:
             return 0
@@ -218,13 +279,21 @@ class QuantizedFeature:
         safe = np.where(invalid, 0, ids)
         stored = self.feature_order[safe] if self.feature_order is not None else safe
         if self.tier_counter is not None:
-            from ..feature import attribute_gather_tiers
+            if self.tier_store is not None:
+                split = self.tier_store.tier_split(stored[~invalid])
+                for tier, nn in split.items():
+                    if nn:
+                        self.tier_counter.hit(nn, tier=tier)
+            else:
+                from ..feature import attribute_gather_tiers
 
-            attribute_gather_tiers(
-                self.inner.shard_tensor, self.rank, stored,
-                self.tier_counter, valid=~invalid,
-            )
-        q = self.inner.shard_tensor[stored]
+                attribute_gather_tiers(
+                    self.inner.shard_tensor, self.rank, stored,
+                    self.tier_counter, valid=~invalid,
+                )
+        if self.row_tap is not None:
+            self.row_tap(stored[~invalid])
+        q = self.inner.gather_stored(stored)
         if self._scale_np is not None:
             s = jnp.asarray(self._scale_np[stored])
             z = jnp.asarray(self._zero_np[stored])
@@ -279,8 +348,8 @@ class QuantizedFeature:
         invalid = (ids < 0) | (ids >= self._n)
         safe = np.where(invalid, 0, ids)
         stored = self.feature_order[safe] if self.feature_order is not None else safe
-        st = self.inner.shard_tensor
-        q = np.asarray(st[stored])  # gather through the tiers, then host math
+        # gather through the tiers (disk/adaptive included), then host math
+        q = np.asarray(self.inner.gather_stored(stored))
         enc = QuantizedRows(
             q,
             None if self._scale_np is None else self._scale_np[stored],

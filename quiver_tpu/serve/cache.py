@@ -100,3 +100,18 @@ class EmbeddingCache:
             self._entries.clear()
             self.invalidations += 1
             return n
+
+    def invalidate_keys(self, node_ids) -> int:
+        """Drop the entries for specific nodes (round 14: a placement
+        batch invalidates the MOVED rows only — placement is bit-neutral
+        for the logits, but the conservative drop keeps the cache's
+        contents arguable from the current placement alone). Returns how
+        many entries were actually dropped."""
+        n = 0
+        with self._lock:
+            for k in node_ids:
+                if self._entries.pop(k, None) is not None:
+                    n += 1
+            if n:
+                self.invalidations += 1
+        return n

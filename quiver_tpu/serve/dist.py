@@ -241,6 +241,26 @@ class _ShardFeature:
     def dim(self) -> int:
         return self._dist.feature.dim
 
+    @property
+    def tier_counter(self):
+        """Delegate the observe-only tier tap to the LOCAL feature shard
+        (round 14): the owner engine's workload monitor then attributes
+        the owned-rows gather per tier — hbm/host/disk of the shard's
+        own store; exchanged halo rows are the peer's tiers to count."""
+        return self._dist.feature.tier_counter
+
+    @tier_counter.setter
+    def tier_counter(self, counter) -> None:
+        self._dist.feature.tier_counter = counter
+
+    @property
+    def row_tap(self):
+        return self._dist.feature.row_tap
+
+    @row_tap.setter
+    def row_tap(self, tap) -> None:
+        self._dist.feature.row_tap = tap
+
     def __getitem__(self, n_id):
         ids = np.clip(np.asarray(n_id), 0, self._n - 1)
         return self._dist[ids]
@@ -411,6 +431,14 @@ class DistServeConfig:
     late_admission: bool = True
     journal_events: int = 0
     workload: Optional[WorkloadConfig] = None
+    # round-14 adaptive tier knobs, inherited by every owner engine via
+    # the default shard config (same semantics as the ServeConfig
+    # fields); `DistServeEngine.adapt_tiers` drives one fenced pass per
+    # owner, `start()` runs it fleet-wide when tier_adapt_every_s > 0
+    tier_promote_batch: int = 64
+    tier_promote_min: float = 2.0
+    tier_hysteresis: float = 1.25
+    tier_adapt_every_s: float = 0.0
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -425,6 +453,9 @@ class DistServeConfig:
             late_admission=self.late_admission,
             journal_events=self.journal_events,
             workload=self.workload,
+            tier_promote_batch=self.tier_promote_batch,
+            tier_promote_min=self.tier_promote_min,
+            tier_hysteresis=self.tier_hysteresis,
         )
 
 
@@ -546,6 +577,7 @@ class DistServeEngine:
         )
         self._next_rid = 0     # journal request ids (guarded by _lock)
         self._flush_index = 0  # router dispatch-log index (guarded by _seq)
+        self.tier_adapt_errors = 0  # failed fleet tier-adaptation passes
         # round-13 router-side workload telemetry (observe-only): the
         # router sees EVERY submitted seed, so its sketch is the fleet's
         # access-frequency view; per-owner load/latency land here too
@@ -598,6 +630,7 @@ class DistServeEngine:
         sampler_kw: Optional[dict] = None,
         out_dim: Optional[int] = None,
         mesh=None,
+        feature_kw: Optional[dict] = None,
     ) -> "DistServeEngine":
         """Partition ``csr_topo``/``feat`` by seed ownership and assemble
         the router + H shard engines in one process (the hermetic pod
@@ -641,6 +674,14 @@ class DistServeEngine:
         residency = config.feature_residency
         if residency not in ("closure", "exchange"):
             raise ValueError(f"unknown feature_residency {residency!r}")
+        if feature_kw and residency != "exchange":
+            # tiered owner features (disk/adaptive knobs) gather host-side
+            # through Feature; the closure residency is a dense in-jit
+            # table by construction, so the knobs would be silently dead
+            raise ValueError(
+                "feature_kw (tiered owner features) requires "
+                "feature_residency='exchange'"
+            )
         # feature-exchange budget ("exchange" residency only): a shard
         # forward gathers up to the final padded n_id width of the largest
         # bucket, all of which could be remote in the worst case
@@ -680,7 +721,13 @@ class DistServeEngine:
                 shard_feat = ClosureFeature(feat[closure_ids], local_map)
             else:
                 owned = np.nonzero(global2host == h)[0]
-                f = Feature(rank=0, device_list=[0], device_cache_size=0)
+                fkw = dict(feature_kw or {})
+                if fkw.get("disk_path"):
+                    # per-owner flat files: "{host}" in the template keeps
+                    # H shards from clobbering one backing file
+                    fkw["disk_path"] = fkw["disk_path"].format(host=h)
+                f = Feature(rank=0, device_list=[0],
+                            **{"device_cache_size": 0, **fkw})
                 f.from_cpu_tensor(feat[owned])
                 f.set_local_order(owned)
                 if mode == "collective":
@@ -1011,6 +1058,37 @@ class DistServeEngine:
                 for slot in self._pending.values():
                     slot.version = self.params_version
 
+    def adapt_tiers(self) -> Dict[int, Dict[str, object]]:
+        """One fleet-wide promote/demote pass (round 14): fence the
+        ROUTER (no routed flush in the air — the same drain as
+        `update_params`), then run each owner engine's `adapt_tiers`
+        under it; every owner fences its own in-flight flushes too, so
+        no flush anywhere straddles a placement batch. Owners whose
+        feature has no adaptive store (or no workload sketch) are
+        skipped. Per-owner summaries keyed by host, deterministic order.
+        NOTE the owner engines' own background consumers stay OFF in
+        dist mode (``tier_adapt_every_s`` is not inherited by the shard
+        config) — the router is the single adaptation driver, which is
+        what keeps fleet passes fenced against routed flushes."""
+        out: Dict[int, Dict[str, object]] = {}
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                for h in sorted(self.engines):
+                    eng = self.engines[h]
+                    if eng._tier_feature is None or eng.workload is None:
+                        continue
+                    out[h] = eng.adapt_tiers()
+        return out
+
+    @property
+    def placement_version(self) -> int:
+        """Sum of the owner engines' fenced placement batches (a fleet
+        placement-progress gauge, not a coherence version — shards move
+        rows independently)."""
+        return sum(e.placement_version for e in self.engines.values())
+
     def warmup(self) -> Dict[int, Dict[int, float]]:
         """Pre-trace every shard engine's bucket programs (twin samplers
         where supported, so no shard's key stream moves). Returns
@@ -1089,6 +1167,13 @@ class DistServeEngine:
                      "router result-cache resident rows", labels)
         reg.gauge_fn(f"{prefix}_params_version", lambda: self.params_version,
                      "current weights version", labels)
+        reg.gauge_fn(f"{prefix}_placement_version",
+                     lambda: self.placement_version,
+                     "fenced tier-placement batches across the fleet",
+                     labels)
+        reg.gauge_fn(f"{prefix}_tier_adapt_errors",
+                     lambda: self.tier_adapt_errors,
+                     "failed fleet tier-adaptation passes", labels)
         for h in sorted(self.engines):
             reg.counter_fn(
                 f"{prefix}_sub_batches_total",
@@ -1249,9 +1334,25 @@ class DistServeEngine:
             )
             for i in range(self.config.max_in_flight)
         ]
+        if self.config.tier_adapt_every_s > 0 and any(
+            e._tier_feature is not None and e.workload is not None
+            for e in self.engines.values()
+        ):
+            self._threads.append(
+                threading.Thread(
+                    target=self._tier_loop,
+                    name="quiver-dist-serve-tiers",
+                    daemon=True,
+                )
+            )
         for t in self._threads:
             t.start()
         return self
+
+    def _tier_loop(self) -> None:
+        from ..tiers import tier_daemon_loop
+
+        tier_daemon_loop(self)
 
     def stop(self, drain: bool = True) -> None:
         self._running = False

@@ -207,6 +207,25 @@ class ServeConfig:
                      in tests/test_skew.py; measured price: bench.py
                      ``serve_skew_overhead_frac``).
                      ``engine.workload.skew_report()`` is the read side.
+    tier_promote_batch : max row MOVES per adaptation pass (round 14;
+                     bounds the apply batch's disk read + device
+                     row-scatter, so a pass can never stall the fence
+                     for long). Only read when the engine's feature has
+                     an adaptive `tiers.TierStore` under it.
+    tier_promote_min : minimum err-corrected sketch weight a row needs
+                     to be CONSIDERED for promotion (the absolute floor
+                     of the planner's hysteresis band — one-hit wonders
+                     never buy a slot).
+    tier_hysteresis : a candidate must beat its eviction victim's
+                     estimate by this factor (keeps near-tied rows from
+                     ping-ponging between adaptation passes).
+    tier_adapt_every_s : background promote/demote consumer period in
+                     seconds (`start()` spawns it when > 0 and the
+                     feature is adaptive + workload telemetry is on;
+                     0 = manual `adapt_tiers()` only — what the
+                     deterministic tests drive). Placement application
+                     is ALWAYS fenced like `update_params` regardless
+                     of who calls it.
     """
 
     max_batch: int = 64
@@ -221,6 +240,10 @@ class ServeConfig:
     late_admission: bool = True
     journal_events: int = 0
     workload: Optional[WorkloadConfig] = None
+    tier_promote_batch: int = 64
+    tier_promote_min: float = 2.0
+    tier_hysteresis: float = 1.25
+    tier_adapt_every_s: float = 0.0
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -322,6 +345,9 @@ class ServeStats:
     dispatch_calls: int = 0
     execute_calls: int = 0
     late_admitted: int = 0
+    tier_promoted: int = 0      # rows moved UP a tier (round 14)
+    tier_demoted: int = 0       # rows moved DOWN a tier
+    placement_batches: int = 0  # fenced placement applies
     inflight_peak: int = 0
     dispatch_buckets: Dict[int, int] = field(default_factory=dict)
     cache: HitRateCounter = field(default_factory=HitRateCounter)
@@ -350,6 +376,9 @@ class ServeStats:
         self.dispatch_calls += other.dispatch_calls
         self.execute_calls += other.execute_calls
         self.late_admitted += other.late_admitted
+        self.tier_promoted += other.tier_promoted
+        self.tier_demoted += other.tier_demoted
+        self.placement_batches += other.placement_batches
         self.inflight_peak = max(self.inflight_peak, other.inflight_peak)
         for b, n in other.dispatch_buckets.copy().items():
             self.dispatch_buckets[b] = self.dispatch_buckets.get(b, 0) + n
@@ -368,6 +397,9 @@ class ServeStats:
             "dispatch_calls": self.dispatch_calls,
             "execute_calls": self.execute_calls,
             "late_admitted": self.late_admitted,
+            "tier_promoted": self.tier_promoted,
+            "tier_demoted": self.tier_demoted,
+            "placement_batches": self.placement_batches,
             "inflight_peak": self.inflight_peak,
             "dispatch_buckets": dict(self.dispatch_buckets),
             "cache": self.cache.snapshot(),
@@ -479,6 +511,24 @@ class ServeEngine:
             feature.tier_counter = (
                 self.workload.gathers if self.workload is not None else None
             )
+        if hasattr(feature, "row_tap"):
+            # round-14 row-access sketch tap (WorkloadConfig.row_topk):
+            # same last-engine-owns-the-tap rule as tier_counter
+            feature.row_tap = (
+                self.workload.observe_rows
+                if self.workload is not None
+                and self.workload.row_sketch is not None
+                else None
+            )
+        # round-14 adaptive tiers: the feature owning a TierStore under
+        # the serve wrappers, or None (static placement — nothing to
+        # adapt). placement_version counts fenced placement batches, the
+        # exact analog of params_version for tier moves.
+        from ..tiers import find_tiered_feature
+
+        self._tier_feature = find_tiered_feature(feature)
+        self.placement_version = 0
+        self.tier_adapt_errors = 0  # failed background adapt passes
         self.params_version = 0
         self.dispatch_log: List[Tuple[np.ndarray, int]] = []
         # queue state: _pending holds slots not yet flushed (insertion order
@@ -840,7 +890,8 @@ class ServeEngine:
         reg = registry if registry is not None else MetricsRegistry()
         for f in ("requests", "coalesced", "dispatches", "dispatched_seeds",
                   "padded_seeds", "dispatch_calls", "execute_calls",
-                  "late_admitted"):
+                  "late_admitted", "tier_promoted", "tier_demoted",
+                  "placement_batches"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"ServeStats.{f}", labels)
@@ -861,6 +912,22 @@ class ServeEngine:
         reg.gauge_fn(f"{prefix}_params_version",
                      lambda: self.params_version,
                      "current weights version", labels)
+        reg.gauge_fn(f"{prefix}_placement_version",
+                     lambda: self.placement_version,
+                     "fenced tier-placement batches applied", labels)
+        reg.gauge_fn(f"{prefix}_tier_adapt_errors",
+                     lambda: self.tier_adapt_errors,
+                     "failed background tier-adaptation passes", labels)
+        if self._tier_feature is not None:
+            reg.gauge_fn(
+                f"{prefix}_tier_hbm_rows",
+                lambda: self._tier_feature.tier_store.placement.counts()["hbm"],
+                "rows resident in HBM under the adaptive placement", labels)
+            reg.gauge_fn(
+                f"{prefix}_tier_host_rows",
+                lambda: self._tier_feature.tier_store.placement.counts()["host"],
+                "rows resident in host DRAM under the adaptive placement",
+                labels)
         reg.gauge_fn(f"{prefix}_journal_events", lambda: len(self.journal),
                      "lifecycle events in the journal ring", labels)
         for b in self._buckets:
@@ -981,6 +1048,129 @@ class ServeEngine:
                 self.cache.invalidate()
                 for slot in self._pending.values():
                     slot.version = self.params_version
+    # -- adaptive tier placement (round 14) --------------------------------
+
+    def apply_placement(self, plan) -> Dict[str, object]:
+        """Move rows between disk <-> DRAM <-> HBM behind the SAME fence
+        as `update_params`: block new assembles (the sequencing lock),
+        drain every in-flight flush, apply the batch, bump
+        ``placement_version``, and invalidate the moved rows' embedding-
+        cache entries. No flush ever straddles a placement batch, so a
+        frozen placement replays bit-identically — and because every
+        row's bytes live on the disk backing permanently, the move
+        itself changes no gathered byte (the bit-parity pin in
+        tests/test_tiers.py). Returns the `TierStore.apply` summary."""
+        feat = self._tier_feature
+        if feat is None:
+            raise ValueError(
+                "no adaptive tier store under this engine's feature "
+                "(build it with Feature(disk_path=..., adaptive_tiers=True))"
+            )
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                summary = feat.tier_store.apply(plan)
+                self.placement_version += 1
+                self.stats.tier_promoted += summary["promoted_rows"]
+                self.stats.tier_demoted += summary["demoted_rows"]
+                self.stats.placement_batches += 1
+                moved = summary["moved_stored"]
+                if moved.size:
+                    nodes = feat.node_ids_of_stored(moved)
+                    summary["cache_invalidated"] = self.cache.invalidate_keys(
+                        int(x) for x in nodes[nodes >= 0]
+                    )
+                else:
+                    summary["cache_invalidated"] = 0
+        return summary
+
+    def adapt_tiers(self, max_moves: Optional[int] = None) -> Dict[str, object]:
+        """ONE sketch-driven promote/demote pass: read the live frequency
+        sketch (`WorkloadMonitor.promotion_candidates`, err-corrected),
+        map the hot head into stored-row space, price current residents
+        against the Count-Min estimate, plan a bounded batch
+        (`tiers.plan_adaptive` — hysteresis keeps near-ties from
+        ping-ponging), and apply it behind the placement fence. Safe to
+        call any time; a no-move plan skips the fence entirely. This is
+        the consumer ROADMAP item 2 names — `start()` runs it on a timer
+        when ``tier_adapt_every_s`` > 0, tests call it synchronously."""
+        from ..tiers import plan_adaptive
+
+        feat = self._tier_feature
+        if feat is None:
+            raise ValueError(
+                "no adaptive tier store under this engine's feature"
+            )
+        if self.workload is None:
+            raise ValueError(
+                "tier adaptation reads the frequency sketch — pass "
+                "ServeConfig(workload=WorkloadConfig(...))"
+            )
+        wl = self.workload
+        store = feat.tier_store
+        empty = {"moves": 0, "promoted_rows": 0, "demoted_rows": 0,
+                 "version": store.placement_version,
+                 "counts": store.placement.counts()}
+        if wl.row_sketch is not None:
+            # preferred input: the ROW sketch measures what the tiers
+            # actually serve (seeds + sampled neighbors), already keyed
+            # by stored row
+            cand = wl.row_promotion_candidates(
+                min_weight=self.config.tier_promote_min
+            )
+            if not cand:
+                return empty
+            stored = np.asarray([k for k, _ in cand], np.int64)
+            weights = np.asarray([w for _, w in cand], np.float64)
+            ok = (stored >= 0) & (stored < store.n_rows)
+            rcms = wl.row_cms
+
+            def resident_weight(stored_ids: np.ndarray) -> np.ndarray:
+                return np.asarray(
+                    [rcms.estimate(int(s)) for s in stored_ids], np.float64
+                )
+        else:
+            # fallback: the seed sketch (what clients ASK), mapped into
+            # stored-row space — blind to neighbor gathers, so prefer
+            # row_topk when tier adaptation is the point
+            cand = wl.promotion_candidates(
+                min_weight=self.config.tier_promote_min
+            )
+            if not cand:
+                return empty
+            nodes = np.asarray([k for k, _ in cand], np.int64)
+            weights = np.asarray([w for _, w in cand], np.float64)
+            stored = feat.stored_rows_of(nodes)
+            ok = stored >= 0  # unowned/out-of-range keys (dist shards)
+            cms = wl.cms
+
+            def resident_weight(stored_ids: np.ndarray) -> np.ndarray:
+                res_nodes = feat.node_ids_of_stored(stored_ids)
+                return np.asarray(
+                    [cms.estimate(int(x)) if x >= 0 else 0.0
+                     for x in res_nodes],
+                    np.float64,
+                )
+
+        plan = plan_adaptive(
+            store.placement, stored[ok], weights[ok],
+            resident_weight=resident_weight,
+            max_moves=max_moves or self.config.tier_promote_batch,
+            min_weight=self.config.tier_promote_min,
+            hysteresis=self.config.tier_hysteresis,
+        )
+        if not len(plan):
+            return {"moves": 0, "promoted_rows": 0, "demoted_rows": 0,
+                    "version": store.placement_version,
+                    "counts": store.placement.counts()}
+        return self.apply_placement(plan)
+
+    def _tier_loop(self) -> None:
+        from ..tiers import tier_daemon_loop
+
+        tier_daemon_loop(self)
+
     # -- background flushers ----------------------------------------------
 
     def start(self) -> "ServeEngine":
@@ -999,6 +1189,20 @@ class ServeEngine:
             )
             for i in range(self.config.max_in_flight)
         ]
+        if (
+            self.config.tier_adapt_every_s > 0
+            and self._tier_feature is not None
+            and self.workload is not None
+        ):
+            # the round-14 promote/demote consumer: reads the sketch on a
+            # timer, applies bounded fenced batches (see adapt_tiers)
+            self._threads.append(
+                threading.Thread(
+                    target=self._tier_loop,
+                    name="quiver-serve-tiers",
+                    daemon=True,
+                )
+            )
         for t in self._threads:
             t.start()
         return self

@@ -120,6 +120,11 @@ class ShardTensor:
         self.device_shards: List[tuple] = []  # (device_rank, jax.Array, Offset)
         self.cpu_tensor: Optional[np.ndarray] = None
         self.cpu_offset: Optional[Offset] = None
+        # 4th tier (round 14): flat-file row shard below host DRAM,
+        # read through an optional AsyncReadPool (pipeline.py)
+        self.disk_shard = None  # tiers.DiskShard
+        self.disk_offset: Optional[Offset] = None
+        self.read_pool = None
         self._n_rows = 0
         self._dim: Optional[int] = None
 
@@ -130,6 +135,8 @@ class ShardTensor:
         arr = np.asarray(tensor)
         if arr.ndim != 2:
             raise ValueError("ShardTensor shards must be 2-D")
+        if self.disk_shard is not None:
+            raise ValueError("the disk shard must be the final tier")
         if self._dim is None:
             self._dim = arr.shape[1]
         elif arr.shape[1] != self._dim:
@@ -148,6 +155,32 @@ class ShardTensor:
             )
             self.device_shards.append((device, dev_arr, off))
         self._n_rows = off.end
+
+    def append_disk(self, tensor, path: str, read_pool=None) -> None:
+        """Spill ``tensor`` as the FINAL tier — a flat-file ``.npy`` row
+        shard at ``path`` (round 14; the reference's mmap'd disk slice,
+        feature.py:84-93, as a first-class shard-book tier). Rows are
+        written at the STORE dtype, so a quantized store spills int8.
+        Reads go through ``read_pool`` (`pipeline.AsyncReadPool`) when
+        attached, else one synchronous page-cache gather."""
+        from .tiers import DiskShard  # lazy: tiers imports this module
+
+        arr = np.ascontiguousarray(
+            np.asarray(tensor).astype(self.dtype, copy=False)
+        )
+        if arr.ndim != 2:
+            raise ValueError("ShardTensor shards must be 2-D")
+        if self.disk_shard is not None:
+            raise ValueError("disk shard already set")
+        if self._dim is None:
+            self._dim = arr.shape[1]
+        elif arr.shape[1] != self._dim:
+            raise ValueError("shard dim mismatch")
+        self.disk_shard = DiskShard.create(path, arr)
+        self.disk_offset = Offset(self._n_rows, self._n_rows + arr.shape[0])
+        self._n_rows = self.disk_offset.end
+        if read_pool is not None:
+            self.read_pool = read_pool
 
     @classmethod
     def new_from_cpu_tensor(
@@ -199,7 +232,10 @@ class ShardTensor:
         host = 0 if self.cpu_tensor is None else (
             (self.cpu_offset.end - self.cpu_offset.start) * row
         )
-        return {"device": dev, "host": host, "row": row}
+        disk = 0 if self.disk_shard is None else (
+            (self.disk_offset.end - self.disk_offset.start) * row
+        )
+        return {"device": dev, "host": host, "disk": disk, "row": row}
 
     # ----------------------------------------------------------------- gather
     def __getitem__(self, ids) -> jax.Array:
@@ -250,6 +286,20 @@ class ShardTensor:
                 )
                 rows = jax.device_put(jnp.asarray(rows_np), target)
                 out = _scatter_rows(out, jnp.asarray(pos), rows)
+        if self.disk_shard is not None:
+            off = self.disk_offset
+            sel = np.nonzero((ids_np >= off.start) & (ids_np < off.end))[0]
+            if sel.size:
+                # disk tier: pooled flat-file gather, then ONE padded H2D
+                b = _bucket(sel.shape[0])
+                pos = np.full(b, n, np.int32)
+                pos[: sel.shape[0]] = sel
+                rows_np = np.zeros((b, self._dim), self.dtype)
+                rows_np[: sel.size] = self.disk_shard.read_rows(
+                    ids_np[sel] - off.start, pool=self.read_pool
+                )
+                rows = jax.device_put(jnp.asarray(rows_np), target)
+                out = _scatter_rows(out, jnp.asarray(pos), rows)
         return out
 
     # ------------------------------------------------------- ipc-compat shims
@@ -260,7 +310,8 @@ class ShardTensor:
             dict(device=d, array=np.asarray(t), offset=(o.start, o.end))
             for d, t, o in self.device_shards
         ]
-        return items, self.cpu_tensor, self.config, str(self.dtype)
+        disk_path = None if self.disk_shard is None else self.disk_shard.path
+        return items, self.cpu_tensor, self.config, str(self.dtype), disk_path
 
     @classmethod
     def new_from_share_ipc(cls, ipc_handle, current_device: int = 0) -> "ShardTensor":
@@ -270,4 +321,14 @@ class ShardTensor:
             self.append(item["array"], item["device"])
         if cpu_tensor is not None:
             self.append(cpu_tensor, CPU_DEVICE)
+        if len(rest) > 1 and rest[1] is not None:
+            # the disk tier re-opens by PATH (the flat file is the shared
+            # medium — no bytes ride the handle)
+            from .tiers import DiskShard
+
+            self.disk_shard = DiskShard(rest[1])
+            self.disk_offset = Offset(
+                self._n_rows, self._n_rows + self.disk_shard.shape[0]
+            )
+            self._n_rows = self.disk_offset.end
         return self

@@ -44,6 +44,10 @@ def main():
     # hot-shard replication what-if (round 13): head-concentration curve
     # source — a SERVE_r06 skew artifact's measured top_coverage, or an
     # analytic Zipf(alpha) curve when no artifact is given
+    ap.add_argument("--tier", default=None,
+                    help="TIER_r01.json tiers artifact to read measured "
+                         "row costs + hit mixes from (default: analytic "
+                         "placeholder costs, labeled)")
     ap.add_argument("--skew", default=None,
                     help="SERVE_r06.json skew artifact to read the "
                          "measured head-concentration curve from")
@@ -89,11 +93,13 @@ def main():
         format_quant_markdown,
         format_serve_markdown,
         format_skew_markdown,
+        format_tier_markdown,
         products_scaling_table,
         quant_fetch_table,
         serve_table,
         sharded_fetch_table,
         skew_table,
+        tier_table,
     )
 
     bw = {"ici_bytes_per_s": args.ici_gbps * 1e9, "dcn_bytes_per_s": args.dcn_gbps * 1e9}
@@ -276,12 +282,55 @@ def main():
         "owner imbalance).\n\n"
         + format_skew_markdown(skew_rows)
     )
+    # -- round-14: disk/DRAM/HBM hit-mix pricing (tier_table) ------------
+    if args.tier:
+        with open(args.tier) as fh:
+            tier_doc = json.load(fh)
+        cost = tier_doc["measured_row_costs_s"]
+        t_cfg = tier_doc["config"]
+        mixes = [("all_hbm", 1.0, 0.0, 0.0)]
+        for label in ("static", "adaptive"):
+            m = tier_doc[label]["runs"][-1]["gather_mix"]
+            hbm, host = m.get("hbm", 0.0), m.get("host", 0.0)
+            mixes.append((f"{label}_measured", hbm, host,
+                          max(1.0 - hbm - host, 0.0)))
+        workers = t_cfg.get("read_workers", 4)
+        tier_rows = tier_table(
+            mixes, bucket=t_cfg.get("max_batch", 32),
+            dispatch_s=cost["dispatch_s"], hbm_row_s=cost["hbm"],
+            host_row_s=cost["host"],
+            disk_row_s=cost["disk_pooled"] * workers,
+            feature_dim=t_cfg.get("dim", 100), read_workers=workers,
+        )
+        tier_source = f"{args.tier} measured row costs + hit mixes"
+    else:
+        # labeled placeholders: page-cache-class host/disk split with a
+        # 100 us cold-read per row — swap for bench.py tier_*_row_s /
+        # TIER_r01.json measurements via --tier
+        tier_rows = tier_table(
+            [("all_hbm", 1.0, 0.0, 0.0),
+             ("static_cold", 0.06, 0.14, 0.80),
+             ("adapted", 0.26, 0.19, 0.55)],
+            bucket=32, dispatch_s=3.5e-3, hbm_row_s=4e-6,
+            host_row_s=6e-6, disk_row_s=1e-4, feature_dim=100,
+            read_workers=4,
+        )
+        tier_source = "analytic placeholder costs (pass --tier TIER_r01.json)"
+    tier_md = (
+        "## Tiered storage: disk/DRAM/HBM hit-mix pricing (round 14)\n\n"
+        f"Cost source: {tier_source}.\nMeasured counterpart: "
+        "scripts/serve_probe.py --tiers -> TIER_r01.json (static vs\n"
+        "sketch-driven adaptive placement, median-of-3, simulated cold-"
+        "read latency\nlabeled in config).\n\n"
+        + format_tier_markdown(tier_rows)
+    )
     print(md, file=sys.stderr)
     print("\n" + fetch_md, file=sys.stderr)
     print("\n" + quant_md, file=sys.stderr)
     print("\n" + serve_md, file=sys.stderr)
     print("\n" + serve_dist_md, file=sys.stderr)
     print("\n" + skew_md, file=sys.stderr)
+    print("\n" + tier_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -296,7 +345,7 @@ def main():
             fh.write(
                 header + md + "\n\n" + fetch_md + "\n\n" + quant_md
                 + "\n\n" + serve_md + "\n\n" + serve_dist_md
-                + "\n\n" + skew_md + "\n"
+                + "\n\n" + skew_md + "\n\n" + tier_md + "\n"
             )
     print(json.dumps({
         "step_s_1chip": step_s,

@@ -66,11 +66,28 @@ head-concentration curve feeds `scaling.skew_table` — the predicted
 hot-shard replication benefit for ROADMAP item 3, priced from
 measurement.
 
+Round 14 adds the DISK-TIER leg (ISSUE 9, ``--tiers`` ->
+TIER_r01.json): a dedicated 4800-node community graph whose feature
+table is 6.7x the configured host-DRAM budget (disk holding the rest),
+served static-placement vs SKETCH-ADAPTED placement (the row-access
+sketch + `ServeEngine.adapt_tiers` fenced batches) under an alpha-1.3
+Zipf trace whose hotness is PERMUTED off the stored prefix. In-run
+asserts: capacity ratio >= 5x, disk-tier gathers bit-equal the in-DRAM
+oracle (fp32 exact, int8 codec-exact), and adaptive beats static on
+saturated QPS or p99 (median-of-3 interleaved, spreads reported).
+Cold-read latency is SIMULATED per row (labeled in the artifact —
+this box's page cache makes flat-file reads DRAM-speed) and applied
+identically to both placements; measured per-row tier costs price
+`scaling.tier_table` rows carried in the artifact.
+
 Usage: JAX_PLATFORMS=cpu python scripts/serve_probe.py [--requests 400]
        [--hosts 1,2] [--repeats 3] [--out SERVE_r05.json]
        [--timeline SERVE_r05_timeline.json]
        JAX_PLATFORMS=cpu python scripts/serve_probe.py --skew
        [--skew-requests 3000] [--skew-cache 64] [--out SERVE_r06.json]
+       JAX_PLATFORMS=cpu python scripts/serve_probe.py --tiers
+       [--tier-requests 600] [--tier-disk-us-per-row 20]
+       [--out TIER_r01.json]
 """
 
 import argparse
@@ -135,6 +152,16 @@ def main():
                     help="write the Chrome-trace (Perfetto) timeline of "
                          "the instrumented run here")
     ap.add_argument("--journal-events", type=int, default=65536)
+    ap.add_argument("--tiers", action="store_true",
+                    help="round-14 disk-tier leg: static vs sketch-driven "
+                         "adaptive placement -> TIER_r01.json")
+    ap.add_argument("--tier-requests", type=int, default=600)
+    ap.add_argument("--tier-hbm-rows", type=int, default=480)
+    ap.add_argument("--tier-host-rows", type=int, default=720)
+    ap.add_argument("--tier-disk-us-per-row", type=float, default=20.0,
+                    help="SIMULATED per-row cold-read latency (this box's "
+                         "page cache makes flat-file reads DRAM-speed; "
+                         "production disk is not; 0 = raw page cache)")
     ap.add_argument("--skew", action="store_true",
                     help="run the round-13 workload-skew leg instead of "
                          "the fused/split sweep (-> SERVE_r06.json)")
@@ -264,6 +291,305 @@ def main():
                     )
                     parity_rows += 1
         return dist, trace, wall, parity_rows
+
+    # -- round-14 disk-tier leg (--tiers -> TIER_r01.json) -------------------
+    if args.tiers:
+        import tempfile
+
+        from quiver_tpu import Feature, QuantizedFeature
+        from quiver_tpu.inference import _cached_apply, forward_logits, sample_batch
+        from quiver_tpu.parallel.scaling import format_tier_markdown, tier_table
+        from quiver_tpu.pipeline import AsyncReadPool
+        from quiver_tpu.tiers import TIER_DISK, TIER_HBM, TIER_HOST
+
+        # a DEDICATED graph, 10x the sweep graph: the tier claim needs
+        # each flush's n_id to touch a SMALL fraction of the table (on
+        # the 480-node sweep graph one flush gathers most of the graph,
+        # so row access is flat and placement cannot matter). 32 small
+        # communities x 150 nodes with modest degree + a [4, 4] fanout:
+        # a Zipf head seed's sampled 2-hop closure is a few dozen rows
+        # inside its community, so gather traffic has a row-level head
+        # compact enough for the fast tiers to HOLD — the regime tier
+        # placement exists for (row skew, not just seed skew).
+        t_edges, tfeat, tn = community_graph(
+            n_comm=32, per_comm=150, intra=6, dim=32, seed=5
+        )
+        ttopo = CSRTopo(edge_index=t_edges)
+        T_SIZES = [4, 4]
+
+        def make_tier_sampler():
+            return GraphSageSampler(ttopo, sizes=T_SIZES, mode="TPU", seed=SEED)
+
+        ROWB = tfeat.shape[1] * 4
+        HBM_B = args.tier_hbm_rows * ROWB
+        HOST_B = args.tier_host_rows * ROWB
+        READ_WORKERS = 4
+        tdir = tempfile.mkdtemp(prefix="qt_tiers_")
+        rng = np.random.default_rng(7)
+        # decorrelate the Zipf head from the stored prefix: without a
+        # csr_topo reorder the static prefix is id-order, so a permuted
+        # trace makes the head land anywhere — the placement-misalignment
+        # every static tiering suffers when traffic drifts from ingest
+        # assumptions, and exactly what the sketch-driven consumer fixes
+        perm = rng.permutation(tn)
+        trace = perm[zipfian_trace(tn, args.tier_requests, alpha=1.3,
+                                   seed=31)].astype(np.int64)
+        warm_n = len(trace) // 3
+        sim_s = args.tier_disk_us_per_row * 1e-6
+
+        def build_feature(name, adaptive):
+            f = Feature(
+                rank=0, device_cache_size=HBM_B, host_memory_budget=HOST_B,
+                disk_path=os.path.join(tdir, name), adaptive_tiers=adaptive,
+                read_pool=AsyncReadPool(READ_WORKERS, chunk_rows=128),
+            )
+            f.from_cpu_tensor(tfeat)
+            return f
+
+        def wrap_sim(f):
+            """Add the simulated per-row cold-read latency to the disk
+            tier's read_block (per chunk, so pool workers overlap the
+            sleeps — modeled IO queue depth). Identical wrapper on both
+            placements: the comparison isolates WHERE rows live."""
+            obj = (f.tier_store.backing if f.tier_store is not None
+                   else f.shard_tensor.disk_shard)
+            orig = obj.read_block
+
+            def slow(ids):
+                if sim_s > 0 and ids.size:
+                    time.sleep(sim_s * ids.size)
+                return orig(ids)
+
+            obj.read_block = slow
+
+        # capacity acceptance: stored bytes >= 5x the DRAM budget
+        table_bytes = tn * ROWB
+        capacity_ratio = table_bytes / HOST_B
+        assert capacity_ratio >= 5.0, (
+            f"table {table_bytes}B is only {capacity_ratio:.1f}x the "
+            f"host budget {HOST_B}B — raise n or shrink the budget"
+        )
+
+        # bit-parity acceptance: disk-tier gathers == in-DRAM gathers
+        full = Feature(rank=0, device_cache_size=0)
+        full.from_cpu_tensor(tfeat)
+        ids = rng.integers(0, tn, 512)
+        fa0 = build_feature("parity_a.npy", True)
+        fs0 = build_feature("parity_s.npy", False)
+        want = np.asarray(full[ids])
+        assert np.array_equal(np.asarray(fa0[ids]), want), "adaptive parity"
+        assert np.array_equal(np.asarray(fs0[ids]), want), "static parity"
+        fq = QuantizedFeature(
+            "int8", device_cache_size=8 * tn + HBM_B // 4,
+            host_memory_budget=HOST_B // 4,
+            disk_path=os.path.join(tdir, "q.npy"), adaptive_tiers=True,
+        )
+        fq.from_cpu_tensor(tfeat)
+        assert np.array_equal(np.asarray(fq[ids]), fq.decode_rows(ids)), (
+            "int8 disk tier not codec-exact"
+        )
+        parity = {"fp32_rows": int(ids.size) * 2, "int8_rows": int(ids.size)}
+
+        # measured per-row tier costs (tier_table inputs), sim installed
+        wrap_sim(fa0)
+        store0 = fa0.tier_store
+
+        def time_rows(tier, reps=5):
+            res = store0.placement.residents(tier)
+            batch = np.tile(res, -(-256 // max(res.size, 1)))[:256]
+            np.asarray(store0.gather(batch))  # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                np.asarray(store0.gather(batch))
+            return (time.perf_counter() - t0) / reps / batch.size
+
+        hbm_row_s = time_rows(TIER_HBM)
+        host_row_s = time_rows(TIER_HOST)
+        disk_row_s = time_rows(TIER_DISK)
+
+        # measured per-flush device dispatch (full-DRAM forward at the
+        # probe bucket — the all-HBM reference term of the cost model)
+        apply = _cached_apply(model)
+        ds_b = sample_batch(make_tier_sampler(), np.zeros(args.max_batch, np.int64))
+        np.asarray(forward_logits(apply, params, full, ds_b))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            np.asarray(forward_logits(apply, params, full, ds_b))
+        dispatch_s = (time.perf_counter() - t0) / 10
+
+        def run_serve(adaptive, label):
+            """One saturated closed-loop run. cache_entries=0: the
+            embedding cache would serve the Zipf head host-side and hide
+            the tier path this leg measures (cache sizing is SERVE_r06's
+            question). Adaptive runs warm the sketch on the first third,
+            apply fenced adapt passes until the plan is empty, then
+            measure with the placement frozen."""
+            f = build_feature(f"{label}.npy", adaptive)
+            wrap_sim(f)
+            eng = ServeEngine(
+                model, params, make_tier_sampler(), f,
+                ServeConfig(
+                    max_batch=args.max_batch, buckets=(8, args.max_batch),
+                    max_delay_ms=2.0, cache_entries=0,
+                    # the row sketch must SEE at least as many rows as
+                    # the fast tiers can hold, or the planner is blind
+                    # to most of its own capacity
+                    workload=WorkloadConfig(
+                        topk=256,
+                        row_topk=2 * (args.tier_hbm_rows
+                                      + args.tier_host_rows),
+                    ),
+                    tier_promote_min=1.0,
+                    tier_promote_batch=2 * (args.tier_hbm_rows
+                                            + args.tier_host_rows),
+                ),
+            )
+            eng.warmup()
+            eng.predict(trace[:warm_n], timeout=600)  # sketch warm-up
+            passes = moves = 0
+            t_adapt0 = time.perf_counter()
+            if adaptive:
+                while passes < 8:
+                    s = eng.adapt_tiers()
+                    passes += 1
+                    moves += s["moves"]
+                    if s["moves"] == 0:
+                        break
+            adapt_wall = time.perf_counter() - t_adapt0
+            promoted = eng.stats.tier_promoted  # before the stats reset
+            eng.reset_stats()  # measured window only (sketches re-fill)
+            chunks = np.array_split(trace[warm_n:], args.clients)
+            errors = []
+
+            def client(chunk):
+                try:
+                    eng.predict(chunk, timeout=600)
+                except Exception as exc:
+                    errors.append(repr(exc))
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in chunks]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            wall = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(f"tier clients failed ({label}): {errors}")
+            tiers_mix = eng.workload.skew_report()["tiers"]
+            total = sum(v["hits"] for v in tiers_mix.values()) or 1
+            mix = {t: v["hits"] / total for t, v in tiers_mix.items()}
+            f.tier_store.placement.check() if f.tier_store is not None else None
+            return {
+                "qps": (len(trace) - warm_n) / wall,
+                "p99_ms": eng.stats.latency.percentile(99),
+                "p50_ms": eng.stats.latency.percentile(50),
+                "gather_mix": {t: round(v, 4) for t, v in mix.items()},
+                "adapt_passes": passes,
+                "adapt_moves": moves,
+                "adapt_wall_s": round(adapt_wall, 4),
+                "placement": (
+                    f.tier_store.placement.counts()
+                    if f.tier_store is not None else None
+                ),
+                "tier_promoted": promoted,
+            }
+
+        # one DISCARDED warm pair first: the first run of each arm pays
+        # the bucket compiles + page-cache warm-up (measured ~4x slower
+        # than steady state), which would poison an interleaved median
+        # at repeats=3
+        run_serve(False, "warm_s")
+        run_serve(True, "warm_a")
+        # interleaved median-of-3 (NEXT.md noise discipline)
+        runs_s, runs_a = [], []
+        for r in range(args.repeats):
+            runs_s.append(run_serve(False, f"run_s{r}"))
+            runs_a.append(run_serve(True, f"run_a{r}"))
+
+        def agg(runs, key):
+            return median_min_max([r[key] for r in runs])
+
+        qps_s, qps_a = agg(runs_s, "qps"), agg(runs_a, "qps")
+        p99_s, p99_a = agg(runs_s, "p99_ms"), agg(runs_a, "p99_ms")
+        qps_uplift = qps_a["median"] / qps_s["median"]
+        p99_ratio = p99_a["median"] / p99_s["median"] if p99_s["median"] else 1.0
+        assert qps_uplift > 1.0 or p99_ratio < 1.0, (
+            f"adaptive placement did not beat static: qps x{qps_uplift:.3f}, "
+            f"p99 x{p99_ratio:.3f}"
+        )
+
+        # the cost model, priced with the measured inputs above
+        def as_mix(run, name):
+            m = run["gather_mix"]
+            hbm = m.get("hbm", 0.0)
+            host = m.get("host", 0.0)
+            disk = max(1.0 - hbm - host, 0.0)
+            return (name, hbm, host, disk)
+
+        tt_rows = tier_table(
+            mixes=[("all_hbm", 1.0, 0.0, 0.0),
+                   as_mix(runs_s[-1], "static_measured"),
+                   as_mix(runs_a[-1], "adaptive_measured")],
+            bucket=args.max_batch, dispatch_s=dispatch_s,
+            hbm_row_s=hbm_row_s, host_row_s=host_row_s,
+            # the model wants the SINGLE-THREAD disk cost (it divides by
+            # read_workers itself); reconstruct it from the pooled
+            # measurement above
+            disk_row_s=disk_row_s * READ_WORKERS,
+            feature_dim=tfeat.shape[1], read_workers=READ_WORKERS,
+        )
+        print(format_tier_markdown(tt_rows))
+
+        out = {
+            "metric": "serve_probe_tiers",
+            "git_revision": git_revision(),
+            "backend": jax.devices()[0].platform,
+            "config": {
+                "nodes": tn, "dim": tfeat.shape[1],
+                "hbm_rows": args.tier_hbm_rows,
+                "host_rows": args.tier_host_rows,
+                "host_budget_bytes": HOST_B,
+                "table_bytes": table_bytes,
+                "capacity_ratio_vs_dram_budget": round(capacity_ratio, 2),
+                "alpha": 1.3, "requests": args.tier_requests,
+                "max_batch": args.max_batch,
+                "clients": args.clients, "repeats": args.repeats,
+                "cache_entries": 0,
+                "disk_us_per_row_simulated": args.tier_disk_us_per_row,
+                "read_workers": READ_WORKERS,
+            },
+            "note": (
+                "disk reads carry a SIMULATED per-row latency (labeled in "
+                "config): this box's page cache makes flat-file reads "
+                "DRAM-speed, production cold storage is not — the sim "
+                "applies identically to both placements, so the uplift "
+                "isolates WHERE rows live, which is the claim under test. "
+                "cache_entries=0 so the embedding cache cannot hide the "
+                "tier path. Trace hotness is PERMUTED off the stored "
+                "prefix (static placement misaligned by construction — "
+                "the drift scenario adaptation exists for)."
+            ),
+            "parity_rows_checked": parity,
+            "measured_row_costs_s": {
+                "hbm": hbm_row_s, "host": host_row_s,
+                "disk_pooled": disk_row_s, "dispatch_s": dispatch_s,
+            },
+            "static": {"qps": qps_s, "p99_ms": p99_s,
+                       "runs": runs_s},
+            "adaptive": {"qps": qps_a, "p99_ms": p99_a,
+                         "runs": runs_a},
+            "adaptive_vs_static": {
+                "qps_uplift_median": round(qps_uplift, 4),
+                "p99_ratio_median": round(p99_ratio, 4),
+            },
+            "tier_table": [r._asdict() for r in tt_rows],
+        }
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return
 
     # -- round-13 workload-skew leg (--skew -> SERVE_r06.json) ---------------
     if args.skew:
