@@ -1169,6 +1169,63 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
         context["serve_dist2_error"] = repr(exc)
         log(f"serve dist bench failed: {exc}")
 
+    # fleet robustness (round 15, ISSUE 10): the SAME hosts=2 routed
+    # engine with a deterministic owner-kill injected mid-run and the
+    # full-graph fallback absorbing — measures what serving through the
+    # failover path costs (hedged QPS vs the healthy serve_dist2_qps
+    # above) and asserts in-run that every completed row still bit-matches
+    # the offline fleet replay. A fault leg that ran means the numbers are
+    # from a run where the parity held.
+    try:
+        from quiver_tpu.serve import (
+            DistServeConfig, DistServeEngine, FaultInjector, FaultSpec,
+            replay_fleet_oracle,
+        )
+
+        inj = FaultInjector([FaultSpec(owner=0, fid=2, kind="kill")])
+        dist = DistServeEngine.build(
+            model, params, topo, table, [15, 10, 5], hosts=2,
+            config=DistServeConfig(
+                hosts=2, max_batch=64, max_delay_ms=2.0, exchange="host",
+                record_dispatches=True, fault_injector=inj,
+                full_graph_fallback=True, eject_after=1,
+                eject_backoff_flushes=8,
+                shard_config=ServeConfig(
+                    max_batch=64, buckets=(64,), max_delay_ms=2.0,
+                    record_dispatches=True,
+                ),
+            ),
+            sampler_seed=11, sampler_kw={"caps": caps},
+        )
+        dist.warmup()
+        dist.reset_stats()
+        n_dist = min(n_requests, 96)
+        trace = zipfian_trace(n_nodes, n_dist, alpha=0.99, seed=19)
+        t0 = time.time()
+        out = dist.predict(trace)
+        wall = time.time() - t0
+        oracle = replay_fleet_oracle(dist, model, params, make_sampler, table)
+        parity = all(
+            any(np.array_equal(out[i], c) for c in oracle[int(nid)])
+            for i, nid in enumerate(trace)
+        )
+        sd = dist.stats
+        context["serve_hedge_qps"] = round(n_dist / wall, 1)
+        context["serve_hedge_parity"] = parity
+        context["serve_hedge_hedges"] = sd.hedges
+        context["serve_hedge_owner_ejections"] = sd.owner_ejections
+        context["serve_hedge_request_errors"] = sd.request_errors
+        log(
+            f"serve hedged (owner 0 killed @fid 2): {n_dist / wall:.0f} QPS "
+            f"through the fallback, hedges {sd.hedges}, ejections "
+            f"{sd.owner_ejections}, parity={parity}"
+        )
+        if not parity:
+            log("serve hedge PARITY VIOLATION — investigate before trusting r15")
+    except Exception as exc:
+        context["serve_hedge_error"] = repr(exc)
+        log(f"serve hedge bench failed: {exc}")
+
 
 def wait_for_backend(max_wait_s=None):
     """The axon tunnel can be down for stretches (observed: hours). Probe

@@ -39,6 +39,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ID_PAD = np.int64(-1)
 
+
+class OwnerAnswerError(RuntimeError):
+    """An owner's serve answerer raised inside a collective
+    `exchange_serve` round. The collective is ONE launch — it cannot fail
+    per-owner — but the failure is attributable: ``host`` names the owner
+    whose callback raised (the original exception chains via
+    ``__cause__``), so the router can feed its ejection/health state even
+    when the whole routed flush must error."""
+
+    def __init__(self, host: int, exc: BaseException):
+        super().__init__(f"serve answerer for host {host} failed: {exc!r}")
+        self.host = int(host)
+
 # Collective launches from one process must be SERIALIZED: XLA's CPU
 # collectives rendezvous participants by (run_id, op_id), and two threads
 # launching multi-device programs concurrently can interleave participants
@@ -270,7 +283,12 @@ def exchange_serve_all(
         L = recv.shape[2]
         rows = np.zeros((h, h, L, out_dim), np.float32)
         for host in range(h):
-            ans = np.asarray(answer_fn(host, recv[host]), np.float32)
+            try:
+                ans = np.asarray(answer_fn(host, recv[host]), np.float32)
+            except OwnerAnswerError:
+                raise
+            except Exception as exc:
+                raise OwnerAnswerError(host, exc) from exc
             if ans.shape != (h, L, out_dim):
                 raise ValueError(
                     f"answer_fn(host={host}) returned {ans.shape}, "
@@ -537,9 +555,12 @@ class TpuComm:
                 )
                 recv = _a2a_ids_jit(req, mesh=self.mesh, axis=self.axis)
                 recv_mine = np.asarray(self._my_rows(recv))  # [H, L]: ids asked of me
-                rows_mine = np.asarray(
-                    answerers[self.host](recv_mine), np.float32
-                )[None]  # [1, H, L, C]
+                try:
+                    rows_mine = np.asarray(
+                        answerers[self.host](recv_mine), np.float32
+                    )[None]  # [1, H, L, C]
+                except Exception as exc:
+                    raise OwnerAnswerError(self.host, exc) from exc
                 if rows_mine.shape != (1, h, budget, out_dim):
                     raise ValueError(
                         f"serve answerer returned {rows_mine.shape[1:]}, "

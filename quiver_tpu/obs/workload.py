@@ -580,6 +580,22 @@ class WorkloadMonitor:
         ]
         return out
 
+    def hot_set(self, k: int) -> np.ndarray:
+        """The ``k`` hottest tracked keys as a SORTED int64 id array —
+        the round-15 hot-set replication head (`DistServeEngine.
+        refresh_replicas` feeds it to `shard_topology_for_seeds`).
+        Deterministic: err-corrected weights ranked by the sketch's
+        (count desc, key asc) tie rule, then id-sorted, so two monitors
+        that observed the same stream name the same head."""
+        if k <= 0:
+            return np.array([], np.int64)
+        # rank over the WHOLE tracked head, then take k: limiting first
+        # would let err-zeroed entries inside the top-k crowd out
+        # qualifying keys at ranks k+1.. and silently under-fill the
+        # replica the skew_table row priced
+        ids = [kk for kk, _ in self.promotion_candidates(limit=None)[:int(k)]]
+        return np.sort(np.asarray(ids, np.int64))
+
     def skew_report(
         self,
         capacities: Sequence[int] = (),

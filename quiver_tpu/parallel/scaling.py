@@ -770,6 +770,31 @@ def skew_table(
     return rows
 
 
+def pick_replication_k(
+    rows: Sequence[SkewPrediction],
+    min_uplift: float = 1.0,
+    replica_budget_bytes: Optional[float] = None,
+) -> Optional[SkewPrediction]:
+    """The CHEAPEST `skew_table` row worth replicating: the smallest
+    top-k whose predicted ``qps_uplift`` strictly beats ``min_uplift``
+    within the per-host replica byte budget (None = unbounded). Returns
+    None when no row qualifies — replication buys nothing at this skew /
+    budget, don't pay for it. This is how the round-15 serve stack sizes
+    ``DistServeConfig.replicate_top_k`` from a MEASURED head-concentration
+    curve instead of a guess (serve_probe --faults closes the loop:
+    measured uplift vs this row's prediction)."""
+    best: Optional[SkewPrediction] = None
+    for r in sorted(rows, key=lambda r: r.top_k):
+        if r.qps_uplift <= min_uplift:
+            continue
+        if (replica_budget_bytes is not None
+                and r.replica_bytes_per_host > replica_budget_bytes):
+            continue
+        best = r
+        break
+    return best
+
+
 class TierPrediction(NamedTuple):
     mix: str
     hbm_frac: float

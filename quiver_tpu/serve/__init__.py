@@ -16,6 +16,15 @@ contract.
 requests by seed ownership over the `HostRankTable` exchange (seed ids
 out, logits back) to per-owner `ServeEngine`s serving from ~1/H topology
 + feature shards — docs/api.md "Distributed serving".
+
+Round 15 makes the fleet production-shaped (docs/api.md "Fleet serving"):
+hot-set replication (`DistServeEngine.refresh_replicas` mirrors the Zipf
+head locally so head traffic never crosses the exchange), hedged/failover
+dispatch (per-owner deadlines, re-route to replica/full-graph fallback,
+flush-indexed ejection backoff, per-request error isolation), per-tenant
+admission (`submit(node, tenant=)`: weighted flush quotas, deterministic
+queue-depth shedding, per-tenant latency tails), and the deterministic
+`faults.FaultInjector` that proves all of it replayable.
 """
 
 from .cache import EmbeddingCache
@@ -24,34 +33,53 @@ from .dist import (
     DistServeConfig,
     DistServeEngine,
     DistServeStats,
+    OwnerTimeout,
+    REPLICA_HOST,
     contiguous_partition,
+    replay_fleet_oracle,
     replay_shard_oracle,
     shard_topology_by_owner,
+    shard_topology_for_seeds,
 )
 from .engine import (
+    DEFAULT_TENANT,
+    DrainTimeout,
     ServeConfig,
     ServeEngine,
     ServeResult,
     ServeStats,
+    ShedError,
     default_buckets,
 )
+from .faults import FaultInjector, FaultSpec, OwnerFault, OwnerKilled
 from .trace_gen import poisson_arrivals, trace_skew_stats, zipfian_trace
 
 __all__ = [
     "ClosureFeature",
+    "DEFAULT_TENANT",
     "DistServeConfig",
     "DistServeEngine",
     "DistServeStats",
+    "DrainTimeout",
     "EmbeddingCache",
+    "FaultInjector",
+    "FaultSpec",
+    "OwnerFault",
+    "OwnerKilled",
+    "OwnerTimeout",
+    "REPLICA_HOST",
     "ServeConfig",
     "ServeEngine",
     "ServeResult",
     "ServeStats",
+    "ShedError",
     "contiguous_partition",
     "default_buckets",
     "poisson_arrivals",
+    "replay_fleet_oracle",
     "replay_shard_oracle",
     "shard_topology_by_owner",
+    "shard_topology_for_seeds",
     "trace_skew_stats",
     "zipfian_trace",
 ]

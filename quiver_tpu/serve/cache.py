@@ -92,6 +92,22 @@ class EmbeddingCache:
                 self._entries.popitem(last=False)
                 self.counters.evict()
 
+    def entry_version(self, node_id: Hashable) -> Optional[int]:
+        """The params version a node's entry was computed under, or None
+        when the node has no entry — an INSPECTION helper (no LRU touch,
+        no counter movement): the round-15 replication tests pin
+        "one entry per node, whichever engine computed it" and "refresh
+        invalidates exactly the refreshed keys" through this."""
+        with self._lock:
+            ent = self._entries.get(node_id)
+            return None if ent is None else ent[0]
+
+    def keys(self):
+        """Resident node ids, LRU order (coldest first) — inspection
+        only, same no-side-effect rule as `entry_version`."""
+        with self._lock:
+            return list(self._entries)
+
     def invalidate(self) -> int:
         """Drop every entry (the engine calls this on weight update).
         Returns how many entries were dropped."""

@@ -85,7 +85,35 @@ from ..trace import (
 )
 from ..utils import CSRTopo
 from .cache import EmbeddingCache
-from .engine import ServeConfig, ServeEngine, ServeResult, ServeStats, _Slot
+from .engine import (
+    DEFAULT_TENANT,
+    ServeConfig,
+    ServeEngine,
+    ServeResult,
+    ServeStats,
+    ShedError,
+    _Slot,
+    abandon_undrained,
+    register_tenant_latency,
+    shed_decision,
+    weighted_drain_keys,
+)
+
+# pseudo-owner id for the local hot-set replica in a routed flush's owner
+# split / dispatch log: seeds routed here are answered on the router's own
+# host and never enter the serve exchange (round 15, ROADMAP item 3a)
+REPLICA_HOST = -2
+
+# bound on the hedge/shed policy logs (ring semantics, newest win): the
+# conditions that fill them — sustained overload, a long-dead owner — are
+# exactly when an unbounded list would leak until OOM
+POLICY_LOG_CAP = 65536
+
+
+class OwnerTimeout(RuntimeError):
+    """A routed owner sub-batch missed its ``hedge_deadline_ms`` — the
+    hedge machinery re-routes the sub-batch; the slow owner's eventual
+    answer is discarded."""
 
 
 def contiguous_partition(n_nodes: int, hosts: int) -> np.ndarray:
@@ -196,6 +224,31 @@ def shard_topology_by_owner(
     if return_closure:
         return shard, stats, np.nonzero(closure)[0]
     return shard, stats
+
+
+def shard_topology_for_seeds(
+    csr_topo: CSRTopo,
+    seed_ids: np.ndarray,
+    hops: int,
+    closure_hops: Optional[int] = None,
+):
+    """`shard_topology_by_owner` for an EXPLICIT seed set instead of an
+    ownership map: the hops-hop halo-closure topology of ``seed_ids``
+    (every other row reads degree 0), in the GLOBAL id space. This is the
+    hot-set replica's topology (round 15): a sampler over it draws
+    bit-identically to a full-graph sampler for the replicated seeds —
+    the same closure argument the owner shards ride. Returns
+    ``(shard_topo, stats, closure_ids)``."""
+    n = csr_topo.indptr.shape[0] - 1
+    seed_ids = np.asarray(seed_ids, np.int64)
+    if seed_ids.size and (seed_ids.min() < 0 or seed_ids.max() >= n):
+        raise ValueError(f"seed ids outside [0, {n})")
+    mask = np.ones(n, np.int32)  # host 1 = everyone else
+    mask[seed_ids] = 0           # host 0 = the replicated set
+    return shard_topology_by_owner(
+        csr_topo, mask, 0, hops, return_closure=True,
+        closure_hops=closure_hops,
+    )
 
 
 class LoopbackComm:
@@ -431,6 +484,39 @@ class DistServeConfig:
     late_admission: bool = True
     journal_events: int = 0
     workload: Optional[WorkloadConfig] = None
+    # -- round-15 fleet policies (ROADMAP item 3; docs/api.md "Fleet
+    # serving") -----------------------------------------------------------
+    # replicate_top_k: hot-set replication head size — `refresh_replicas()`
+    # mirrors the k hottest seeds (router workload sketch; k priced by
+    # scaling.skew_table) onto the router's own host, so head traffic is
+    # answered locally and never enters comm.exchange_serve. 0 = off.
+    replicate_top_k: int = 0
+    # hedge_deadline_ms: per-owner deadline on routed sub-batches
+    # (exchange="host" mode, where owner legs are individually
+    # addressable). A leg that misses it re-routes to the full-graph
+    # fallback / the replica; the slow owner's answer is discarded.
+    # 0 = no deadline (errors still fail over when a target exists).
+    hedge_deadline_ms: float = 0.0
+    # full_graph_fallback: build() keeps one full-topology/full-feature
+    # engine on the router's host as the degraded-mode hedge target — any
+    # seed can fail over to it (the replica covers only the hot head).
+    full_graph_fallback: bool = False
+    # eject_after / eject_backoff_flushes: an owner failing this many
+    # CONSECUTIVE sub-batches is ejected (routed straight to the hedge
+    # target, no deadline burned) until this many router dispatch indices
+    # pass — then it is probed again (half-open). Flush-indexed, never
+    # wall time, so ejection decisions replay deterministically.
+    eject_after: int = 2
+    eject_backoff_flushes: int = 16
+    # fault_injector: a `serve.faults.FaultInjector` exercising the
+    # host-mode owner legs — deterministic (owner, dispatch-index) keyed
+    # kill/error/stall, the proof harness for everything above.
+    fault_injector: Optional[object] = None
+    # per-tenant admission (same semantics as the ServeConfig fields;
+    # applied at the ROUTER — the fleet's admission point)
+    tenant_weights: Optional[Dict[str, float]] = None
+    max_queue_depth: int = 0
+    drain_deadline_s: float = 30.0
     # round-14 adaptive tier knobs, inherited by every owner engine via
     # the default shard config (same semantics as the ServeConfig
     # fields); `DistServeEngine.adapt_tiers` drives one fenced pass per
@@ -473,6 +559,23 @@ class DistServeStats:
     router_dispatches: int = 0
     routed_seeds: int = 0
     late_admitted: int = 0
+    # round-15 fleet-policy counters: replica_hits counts seeds answered
+    # by the local hot-set replica (never entered the exchange); hedges /
+    # hedged_seeds count owner sub-batches (and their seeds) re-routed to
+    # a failover target, split by cause (deadline miss vs owner error vs
+    # routed-while-ejected); owner_ejections counts backoff entries;
+    # shed / request_errors / undrained mirror the ServeStats fields.
+    replica_hits: int = 0
+    hedges: int = 0
+    hedged_seeds: int = 0
+    hedge_timeouts: int = 0
+    hedge_errors: int = 0
+    hedge_ejected: int = 0
+    hedge_failed: int = 0       # failovers with no (working) target
+    owner_ejections: int = 0
+    shed: int = 0
+    request_errors: int = 0
+    undrained: int = 0
     inflight_peak: int = 0
     sub_batches: Dict[int, int] = field(default_factory=dict)
     sub_batch_seeds: Dict[int, int] = field(default_factory=dict)
@@ -480,7 +583,13 @@ class DistServeStats:
     exchange_logit_bytes: int = 0
     router_cache: HitRateCounter = field(default_factory=HitRateCounter)
     latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+    tenant_latency: Dict[str, LatencyHistogram] = field(default_factory=dict)
     spans: SpanRecorder = field(default_factory=SpanRecorder)
+
+    def tenant_hist(self, tenant: str) -> LatencyHistogram:
+        from .engine import tenant_latency_hist
+
+        return tenant_latency_hist(self.tenant_latency, tenant)
 
     def mean_sub_batch_width(self) -> Dict[int, float]:
         return {
@@ -496,6 +605,17 @@ class DistServeStats:
             "router_dispatches": self.router_dispatches,
             "routed_seeds": self.routed_seeds,
             "late_admitted": self.late_admitted,
+            "replica_hits": self.replica_hits,
+            "hedges": self.hedges,
+            "hedged_seeds": self.hedged_seeds,
+            "hedge_timeouts": self.hedge_timeouts,
+            "hedge_errors": self.hedge_errors,
+            "hedge_ejected": self.hedge_ejected,
+            "hedge_failed": self.hedge_failed,
+            "owner_ejections": self.owner_ejections,
+            "shed": self.shed,
+            "request_errors": self.request_errors,
+            "undrained": self.undrained,
             "inflight_peak": self.inflight_peak,
             "sub_batches": dict(self.sub_batches),
             "mean_sub_batch_width": self.mean_sub_batch_width(),
@@ -503,6 +623,10 @@ class DistServeStats:
             "exchange_logit_bytes": self.exchange_logit_bytes,
             "router_cache": self.router_cache.snapshot(),
             "latency": self.latency.snapshot(),
+            "tenant_latency": {
+                t: self.tenant_latency[t].snapshot()
+                for t in sorted(self.tenant_latency)
+            },
             "overlap": self.spans.overlap_summary(),
         }
 
@@ -511,9 +635,16 @@ class _RoutedFlush:
     """Per-flush router state between assemble and resolve. ``bucket`` is
     the admission cap (the router pads nothing, so its "pad slack" is the
     drained width up to ``max_batch``); the owner split is computed at SEAL
-    time so late-admitted seeds route with their flush."""
+    time so late-admitted seeds route with their flush.
 
-    __slots__ = ("keys", "slots", "split", "bucket", "error", "fid")
+    ``error`` poisons the WHOLE flush (assemble/seal failures, a
+    collective-exchange abort); ``slot_errors`` maps key POSITIONS to
+    per-request exceptions — the round-15 isolation contract: a failed
+    owner sub-batch resolves only its own slots with the error, every
+    other slot resolves normally, and `flush()` does not re-raise."""
+
+    __slots__ = ("keys", "slots", "split", "bucket", "error", "slot_errors",
+                 "fid")
 
     def __init__(self, keys, slots, split):
         self.keys = keys
@@ -521,7 +652,26 @@ class _RoutedFlush:
         self.split = split  # [(host, ids ndarray, positions ndarray)]
         self.bucket = 0
         self.error: Optional[BaseException] = None
+        self.slot_errors: Dict[int, BaseException] = {}
         self.fid = -1  # journal flush id (router dispatch-log index)
+
+
+class _HotReplica:
+    """The router-local hot-set replica (round 15): a full `ServeEngine`
+    over the replicated seeds' halo-closure topology + feature rows —
+    the mirror of Quiver's ``p2p_clique_replicate`` hot-prefix applied to
+    serving. ``ids`` is the sorted replicated seed set; ``id_set`` the
+    O(1) membership view the hedge path consults."""
+
+    __slots__ = ("engine", "ids", "id_set", "version", "stats")
+
+    def __init__(self, engine: ServeEngine, ids: np.ndarray, version: int,
+                 stats: Dict[str, float]):
+        self.engine = engine
+        self.ids = np.asarray(ids, np.int64)
+        self.id_set = frozenset(int(x) for x in self.ids)
+        self.version = version
+        self.stats = stats
 
 
 class DistServeEngine:
@@ -560,6 +710,12 @@ class DistServeEngine:
             mode = "collective" if comm is not None else "host"
         if mode == "collective" and comm is None:
             raise ValueError("exchange='collective' needs a TpuComm")
+        if self.config.fault_injector is not None and mode != "host":
+            raise ValueError(
+                "fault_injector exercises the per-owner host-mode dispatch "
+                "legs (the collective is one launch and cannot fail "
+                "per-owner); build with exchange='host'"
+            )
         self.exchange_mode = mode
         self.engines = dict(engines)
         self.hosts = self.config.hosts
@@ -597,6 +753,40 @@ class DistServeEngine:
         self.dispatch_log: List[Tuple[np.ndarray, List[Tuple[int, np.ndarray]]]] = []
         self._pending: Dict[int, _Slot] = {}
         self._inflight: Dict[int, _Slot] = {}
+        import collections
+
+        # round-15 fleet-policy state -------------------------------------
+        # per-tenant admission (guarded by _lock; mirrors ServeEngine).
+        # Policy logs are BOUNDED rings (newest win) — sustained overload
+        # or a long-dead owner is exactly when they fill, and an unbounded
+        # list there would leak until OOM
+        self._pending_tenant: Dict[str, int] = {}
+        self.shed_log = collections.deque(maxlen=POLICY_LOG_CAP)
+        # hot-set replica (swapped only under the update_params fence) +
+        # the full-graph failover engine (built by `build` on request)
+        self.replica: Optional[_HotReplica] = None
+        self.replica_version = 0
+        # retired replica engines keep their dispatch logs so the fleet
+        # replay oracle can still vouch for rows they served pre-refresh
+        self._retired_replicas: List[ServeEngine] = []
+        self.fallback: Optional[ServeEngine] = None
+        self._params = None                # tracked for replica rebuilds
+        self._replica_materials: Optional[Dict[str, object]] = None
+        # per-owner health for hedged dispatch: consecutive failures +
+        # the dispatch index an ejection started at (-1 = serving);
+        # flush-indexed backoff keeps the state machine replayable
+        self._owner_health: Dict[int, Dict[str, int]] = {}
+        # deterministic hedge log [(fid, owner, reason, target)] — append
+        # order may interleave across in-flight flushes, read the sorted
+        # `hedge_events()` view for replay comparison; bounded like
+        # shed_log (a dead owner with no failover appends per flush)
+        self.hedge_log = collections.deque(maxlen=POLICY_LOG_CAP)
+        # abandoned (deadline-missed) leg threads per owner, guarded by
+        # _lock: while any is still alive the owner is treated as wedged
+        # and no new leg is spawned — growth is bounded by max_in_flight
+        # per wedge episode, never the life of the router
+        self._abandoned_legs: Dict[int, List[threading.Thread]] = {}
+        self.faults = self.config.fault_injector
         self._open: Optional[_RoutedFlush] = None
         self._lock = threading.Lock()
         self._fence = threading.Condition(self._lock)
@@ -751,10 +941,30 @@ class DistServeEngine:
             block = np.asarray(feat[np.nonzero(global2host == h)[0]], np.float32)
             for fcomm in feat_comms:
                 fcomm.register_local_table(h, block)
-        return cls(
+        dist = cls(
             engines, global2host, out_dim, config=config, comm=comm,
             shard_topo_stats=topo_stats,
         )
+        # round-15 fleet policies need build-time materials: the replica
+        # is rebuilt from the full graph/table on every refresh, and the
+        # fallback engine IS a full-graph single-host engine (the degraded
+        # path any seed can fail over to). Multi-process constructions
+        # (bare __init__) have neither — they hold only their own shard.
+        dist._params = params
+        dist._replica_materials = {
+            "model": model, "csr_topo": csr_topo, "feat": feat,
+            "sizes": tuple(sizes), "sampler_mode": sampler_mode,
+            "sampler_seed": sampler_seed, "sampler_kw": dict(kw),
+            "shard_config": shard_cfg,
+        }
+        if config.full_graph_fallback:
+            fb_sampler = GraphSageSampler(
+                csr_topo, sizes=sizes, mode=sampler_mode, seed=sampler_seed,
+                **kw,
+            )
+            dist.fallback = ServeEngine(model, params, fb_sampler, feat,
+                                        shard_cfg)
+        return dist
 
     def _make_answerer(self, host: int):
         """The owner-side hook of the serve exchange: ids arrive
@@ -778,19 +988,24 @@ class DistServeEngine:
 
     # -- request path ------------------------------------------------------
 
-    def submit(self, node_id: int) -> ServeResult:
+    def submit(self, node_id: int,
+               tenant: Optional[str] = None) -> ServeResult:
         """Enqueue one request: the front-end result cache answers repeats
         of already-served nodes outright (no routing, no exchange bytes),
         then the same dedup/coalesce semantics as `ServeEngine.submit`
-        apply to the rest. KEEP IN LOCKSTEP with `ServeEngine.submit` —
-        the hosts=1 bit-parity contract depends on the two front ends
-        making identical cache/coalesce decisions per request, and
+        apply to the rest. ``tenant`` drives the round-15 per-tenant
+        admission exactly as on the single-host engine (weighted flush
+        quotas, deterministic queue-depth shedding, per-tenant latency).
+        KEEP IN LOCKSTEP with `ServeEngine.submit` — the hosts=1
+        bit-parity contract depends on the two front ends making
+        identical cache/coalesce decisions per request, and
         `test_shards1_bit_equal_single_host_engine` pins it."""
         key = int(node_id)
         if not 0 <= key < self.global2host.shape[0]:
             raise ValueError(
                 f"node id {key} outside [0, {self.global2host.shape[0]})"
             )
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         now = self._clock()
         need_flush = False
         jr = self.journal
@@ -801,7 +1016,9 @@ class DistServeEngine:
                 wl.observe_seed(key)  # observe-only frequency tap
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
-                self.stats.latency.record_ms((self._clock() - now) * 1e3)
+                ms = (self._clock() - now) * 1e3
+                self.stats.latency.record_ms(ms)
+                self.stats.tenant_hist(tenant).record_ms(ms)
                 jr.emit("cache_hit", -1, -1, key)
                 return ServeResult(value=cached)
             slot = self._pending.get(key) or self._inflight.get(key)
@@ -809,11 +1026,25 @@ class DistServeEngine:
                 self.stats.coalesced += 1
                 jr.emit("coalesce", slot.rid, -1, key)
             else:
+                if shed_decision(
+                    len(self._pending), self._pending_tenant.get(tenant, 0),
+                    tenant, self.config.max_queue_depth,
+                    self.config.tenant_weights,
+                ):
+                    self.stats.shed += 1
+                    self.shed_log.append((self.stats.requests, tenant, key))
+                    jr.emit("shed", -1, -1, key)
+                    return ServeResult(error=ShedError(
+                        f"router queue depth {len(self._pending)} >= "
+                        f"{self.config.max_queue_depth} and tenant "
+                        f"{tenant!r} is at its weighted quota"
+                    ))
                 rid = -1
                 if jr.enabled:
                     rid = self._next_rid
                     self._next_rid += 1
-                slot = _Slot(key, self.params_version, now, rid=rid)
+                slot = _Slot(key, self.params_version, now, rid=rid,
+                             tenant=tenant)
                 fl = self._open
                 if fl is not None and len(fl.keys) < fl.bucket:
                     # late admission into the routed flush still waiting
@@ -825,8 +1056,11 @@ class DistServeEngine:
                     jr.emit("late_admit", rid, fl.fid, key)
                 else:
                     self._pending[key] = slot
+                    self._pending_tenant[tenant] = (
+                        self._pending_tenant.get(tenant, 0) + 1
+                    )
                     jr.emit("submit", rid, -1, key)
-            slot.waiters.append(now)
+            slot.waiters.append((now, tenant))
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
         if need_flush:
@@ -865,8 +1099,17 @@ class DistServeEngine:
         with self._lock:
             if not self._pending:
                 return None
-            keys = list(self._pending)[: self.config.max_batch]
+            keys = weighted_drain_keys(
+                self._pending, self.config.max_batch,
+                self.config.tenant_weights,
+            )
             slots = [self._pending.pop(k) for k in keys]
+            for s in slots:
+                n = self._pending_tenant.get(s.tenant, 1) - 1
+                if n > 0:
+                    self._pending_tenant[s.tenant] = n
+                else:
+                    self._pending_tenant.pop(s.tenant, None)
             self._inflight.update(zip(keys, slots))
             fl = _RoutedFlush(keys, slots, [])
             fl.bucket = self.config.max_batch
@@ -874,10 +1117,13 @@ class DistServeEngine:
             self.stats.inflight_peak = max(
                 self.stats.inflight_peak, self._inflight_flushes
             )
+            # caller holds _seq: the index _seal_assembled will draw. The
+            # fid is stamped UNCONDITIONALLY since round 15 — the fault
+            # injector and the ejection state machine key off it, not
+            # just the journal
+            fl.fid = self._flush_index + 1
             jr = self.journal
             if jr.enabled:
-                # caller holds _seq: the index _seal_assembled will draw
-                fl.fid = self._flush_index + 1
                 for k, slot in zip(keys, slots):
                     jr.emit("assemble", slot.rid, fl.fid, k)
                 jr.emit("flush", -1, fl.fid, len(keys), fl.bucket)
@@ -896,7 +1142,17 @@ class DistServeEngine:
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
             arr = np.asarray(fl.keys, np.int64)
-            owners = self.global2host[arr]
+            owners = self.global2host[arr].astype(np.int64)
+            rep = self.replica  # swapped only under the fence: stable here
+            if rep is not None and rep.ids.size:
+                # hot-set replication: replicated seeds re-route to the
+                # LOCAL replica pseudo-owner — they never enter the serve
+                # exchange (the whole point of the replica)
+                owners = np.where(np.isin(arr, rep.ids), REPLICA_HOST,
+                                  owners)
+                pos = np.nonzero(owners == REPLICA_HOST)[0]
+                if pos.size:
+                    fl.split.append((REPLICA_HOST, arr[pos], pos))
             for h in range(self.hosts):
                 pos = np.nonzero(owners == h)[0]
                 if pos.size:
@@ -911,65 +1167,314 @@ class DistServeEngine:
     def _dispatch(self, fl: _RoutedFlush) -> Optional[np.ndarray]:
         """Forward the per-owner sub-batches and re-interleave the answers
         into flush-key order. Collective mode ships ids/logits over the
-        mesh; host mode calls the owner engines directly."""
+        mesh; host mode calls the owner engines directly — per-owner legs
+        there carry the round-15 fault-injection hook, the
+        ``hedge_deadline_ms`` deadline, and the failover re-route, and an
+        owner failure lands in ``fl.slot_errors`` (that sub-batch's slots
+        only), never in ``fl.error``. Replica legs (host `REPLICA_HOST`)
+        are answered locally in BOTH modes and never touch the
+        exchange."""
         # a = bucket per the EVENT_KINDS vocabulary; the router's "bucket"
         # is its admission cap (it pads nothing)
         self.journal.emit("dispatch", -1, fl.fid, fl.bucket)
         wl = self.workload
         out = np.zeros((len(fl.keys), self.out_dim), np.float32)
+        owner_split = []
+        for h, ids, pos in fl.split:
+            if h == REPLICA_HOST:
+                self._replica_leg(fl, ids, pos, out)
+            else:
+                owner_split.append((h, ids, pos))
         if self.exchange_mode == "collective":
-            by_host = {h: (ids, pos) for h, ids, pos in fl.split}
-            host2ids = [
-                by_host[h][0] if h in by_host else np.array([], np.int64)
-                for h in range(self.hosts)
-            ]
-            t_x0 = self._clock() if wl is not None else 0.0
-            res = self.comm.exchange_serve(
-                host2ids, out_dim=self.out_dim, budget=self._budget
-            )
-            if wl is not None:
-                # one exchange round-trip covers every owner: its
-                # duration is each participating owner's flush latency at
-                # the router grain (per-owner separation needs host mode
-                # or the owners' own monitors)
-                dt = self._clock() - t_x0
-                for h, ids, _ in fl.split:
-                    wl.observe_flush(h, len(ids), dt)
-            L = self._budget
-            with self._lock:
-                self.stats.exchange_id_bytes += self.hosts * self.hosts * L * 4
-                self.stats.exchange_logit_bytes += (
-                    self.hosts * self.hosts * L * self.out_dim * 4
-                )
-            for h, (ids, pos) in by_host.items():
-                out[pos] = res[h]
-        else:
-            for h, ids, pos in fl.split:
-                t_h0 = self._clock() if wl is not None else 0.0
-                out[pos] = np.asarray(self.engines[h].predict(ids))
+            by_host = {h: (ids, pos) for h, ids, pos in owner_split}
+            if by_host:  # an all-replica flush skips the collective whole
+                host2ids = [
+                    by_host[h][0] if h in by_host else np.array([], np.int64)
+                    for h in range(self.hosts)
+                ]
+                t_x0 = self._clock() if wl is not None else 0.0
+                try:
+                    res = self.comm.exchange_serve(
+                        host2ids, out_dim=self.out_dim, budget=self._budget
+                    )
+                except comm_mod.OwnerAnswerError as exc:
+                    # the collective is one launch: it cannot fail
+                    # per-owner, but the failure IS attributable — feed
+                    # the health/ejection state before the whole-flush
+                    # error propagates
+                    self._owner_failed(exc.host, fl.fid)
+                    raise
                 if wl is not None:
-                    # host mode calls owners sequentially, so each
-                    # owner's leg is individually timed — TRUE per-owner
-                    # straggler evidence
-                    wl.observe_flush(h, len(ids), self._clock() - t_h0)
+                    # one exchange round-trip covers every owner: its
+                    # duration is each participating owner's flush latency
+                    # at the router grain (per-owner separation needs host
+                    # mode or the owners' own monitors)
+                    dt = self._clock() - t_x0
+                    for h, ids, _ in owner_split:
+                        wl.observe_flush(h, len(ids), dt)
+                L = self._budget
+                with self._lock:
+                    self.stats.exchange_id_bytes += (
+                        self.hosts * self.hosts * L * 4
+                    )
+                    self.stats.exchange_logit_bytes += (
+                        self.hosts * self.hosts * L * self.out_dim * 4
+                    )
+                for h, (ids, pos) in by_host.items():
+                    out[pos] = res[h]
+                # a successful exchange is a successful leg for every
+                # participating owner: reset their failure counts, so
+                # `fails` stays CONSECUTIVE (not cumulative over days)
+                # and a past ejection never latches in collective mode
+                for h, _, _ in owner_split:
+                    self._owner_ok(h)
+        else:
+            for h, ids, pos in owner_split:
+                self._owner_leg(fl, h, ids, pos, out)
         out.setflags(write=False)
         # one routed round-trip = one "execute" at the router grain
         self.journal.emit("execute_done", -1, fl.fid, len(fl.split))
         return out
 
+    # -- round-15 dispatch legs: replica, hedged owner, failover -----------
+
+    def _replica_leg(self, fl: _RoutedFlush, ids, pos, out) -> None:
+        """Serve a replicated sub-batch from the LOCAL hot-set replica —
+        no routing, no exchange bytes. A (should-be-impossible) local
+        failure takes the same failover path as an owner failure."""
+        wl = self.workload
+        t0 = self._clock()
+        try:
+            rows = np.asarray(self.replica.engine.predict(ids))
+        except BaseException as exc:
+            self._failover(fl, REPLICA_HOST, ids, pos, out, "error", exc)
+            return
+        if wl is not None:
+            wl.observe_flush(REPLICA_HOST, len(ids), self._clock() - t0)
+        out[pos] = rows
+        with self._lock:
+            self.stats.replica_hits += len(ids)
+
+    def _owner_leg(self, fl: _RoutedFlush, h: int, ids, pos, out) -> None:
+        """One host-mode owner sub-batch: fault-injection hook, optional
+        per-owner deadline, failover on timeout/error/ejection. Success
+        resets the owner's health; failure feeds the ejection state
+        machine (flush-indexed backoff — deterministic under replay)."""
+        wl = self.workload
+        deadline_s = self.config.hedge_deadline_ms / 1e3
+        # honoring an ejection only makes sense when someone else can
+        # serve the sub-batch: with no failover target, skipping the
+        # owner would CONVERT its traffic into guaranteed errors for the
+        # whole backoff window — attempt it instead
+        ejected = (self._has_failover(h, ids)
+                   and self._owner_ejected(h, fl.fid))
+        rows, err, timed_out = None, None, False
+        if not ejected:
+            t0 = self._clock()
+            try:
+                if deadline_s > 0:
+                    # the fault hook runs INSIDE the supervised leg so a
+                    # stalled owner is indistinguishable from a slow one
+                    # — exactly what the deadline exists to catch
+                    rows, timed_out = self._call_with_deadline(
+                        h, ids, deadline_s, fl.fid
+                    )
+                    if timed_out:
+                        err = OwnerTimeout(
+                            f"owner {h} missed the "
+                            f"{self.config.hedge_deadline_ms} ms hedge "
+                            f"deadline at dispatch index {fl.fid}"
+                        )
+                else:
+                    if self.faults is not None:
+                        self.faults.check(h, fl.fid)
+                    rows = np.asarray(self.engines[h].predict(ids))
+            except BaseException as exc:
+                err = exc
+            if wl is not None:
+                # host mode calls owners sequentially, so each owner's
+                # leg is individually timed — TRUE per-owner straggler
+                # evidence. A timed-out leg is CENSORED at the deadline
+                # (the owner did NOT answer in the measured wall; the
+                # wedged-owner fast path would otherwise record ~0 ms
+                # and rank the slowest owner fastest)
+                dt = self._clock() - t0
+                if timed_out:
+                    dt = max(dt, deadline_s)
+                wl.observe_flush(h, len(ids), dt)
+        if rows is not None and err is None:
+            self._owner_ok(h)
+            out[pos] = rows
+            return
+        if not ejected:
+            self._owner_failed(h, fl.fid)
+        reason = ("ejected" if ejected
+                  else "timeout" if timed_out else "error")
+        self._failover(fl, h, ids, pos, out, reason, err)
+
+    def _call_with_deadline(self, h: int, ids, deadline_s: float,
+                            fid: int):
+        """Run an owner leg (fault hook included) on a worker thread
+        with a deadline. On timeout the worker is ABANDONED (its eventual
+        answer lands in a local box nobody reads — never the flush's
+        output) and the caller hedges; an in-leg exception re-raises
+        here. While ANY abandoned leg to an owner is still alive, further
+        legs to it time out immediately instead of stacking more blocked
+        threads — at most ``max_in_flight`` concurrent checks can slip
+        through per wedge episode, so thread growth is bounded."""
+        with self._lock:
+            legs = self._abandoned_legs.get(h, [])
+            legs[:] = [t for t in legs if t.is_alive()]
+            if legs:
+                return None, True  # owner still wedged from earlier legs
+        box: Dict[str, object] = {}
+        engine = self.engines[h]
+
+        def run():
+            try:
+                if self.faults is not None:
+                    self.faults.check(h, fid)
+                box["rows"] = np.asarray(engine.predict(ids))
+            except BaseException as exc:  # delivered to the caller below
+                box["err"] = exc
+
+        th = threading.Thread(target=run, daemon=True,
+                              name="quiver-hedged-owner-leg")
+        th.start()
+        th.join(deadline_s)
+        if th.is_alive():
+            with self._lock:
+                self._abandoned_legs.setdefault(h, []).append(th)
+            return None, True
+        if "err" in box:
+            raise box["err"]
+        return box["rows"], False
+
+    def _pick_failover(self, h: int, ids
+                       ) -> Tuple[Optional[ServeEngine], str]:
+        """THE failover target-selection rule, used by both the ejection
+        honor decision and the re-route itself (one copy — if they
+        disagreed, an ejected owner could be skipped with no target and
+        its sub-batch error needlessly): the full-graph fallback serves
+        anything; the replica only sub-batches fully inside the hot
+        set."""
+        if self.fallback is not None:
+            return self.fallback, "fallback"
+        rep = self.replica
+        if (rep is not None and h != REPLICA_HOST
+                and all(int(x) in rep.id_set for x in ids)):
+            return rep.engine, "replica"
+        return None, ""
+
+    def _has_failover(self, h: int, ids) -> bool:
+        return self._pick_failover(h, ids)[0] is not None
+
+    def _failover(self, fl: _RoutedFlush, h: int, ids, pos, out,
+                  reason: str, err: Optional[BaseException]) -> None:
+        """Re-route a failed sub-batch: the full-graph fallback serves
+        anything; the replica serves sub-batches fully inside the hot
+        set. No (working) target -> the sub-batch's OWN slots resolve
+        with the error (per-request isolation — the flush, the engine,
+        and every other sub-batch keep serving). Every decision lands in
+        the hedge log keyed by the dispatch index."""
+        target, tname = self._pick_failover(h, ids)
+        if target is not None:
+            try:
+                rows = np.asarray(target.predict(ids))
+                out[pos] = rows
+                with self._lock:
+                    self.stats.hedges += 1
+                    self.stats.hedged_seeds += len(ids)
+                    if reason == "timeout":
+                        self.stats.hedge_timeouts += 1
+                    elif reason == "ejected":
+                        self.stats.hedge_ejected += 1
+                    else:
+                        self.stats.hedge_errors += 1
+                self.hedge_log.append((fl.fid, int(h), reason, tname))
+                self.journal.emit("hedge", -1, fl.fid, h)
+                return
+            except BaseException as exc:
+                err = exc
+        with self._lock:
+            self.stats.hedge_failed += 1
+        self.hedge_log.append((fl.fid, int(h), reason, "none"))
+        final = err if err is not None else RuntimeError(
+            f"owner {h} unavailable ({reason}) and no failover target"
+        )
+        for p in pos:
+            fl.slot_errors[int(p)] = final
+
+    # -- owner health / ejection state (flush-indexed, replay-stable) ------
+
+    def _owner_ejected(self, h: int, fid: int) -> bool:
+        with self._lock:
+            st = self._owner_health.get(h)
+            if st is None or st["ejected_at"] < 0:
+                return False
+            if fid >= st["ejected_at"] + self.config.eject_backoff_flushes:
+                st["ejected_at"] = -1  # backoff expired: half-open probe
+                return False
+            return True
+
+    def _owner_failed(self, h: int, fid: int) -> None:
+        with self._lock:
+            st = self._owner_health.setdefault(
+                h, {"fails": 0, "ejected_at": -1}
+            )
+            st["fails"] += 1
+            if st["fails"] >= self.config.eject_after and st["ejected_at"] < 0:
+                st["ejected_at"] = fid
+                self.stats.owner_ejections += 1
+                self.journal.emit("eject", -1, fid, h)
+
+    def _owner_ok(self, h: int) -> None:
+        with self._lock:
+            st = self._owner_health.get(h)
+            if st is not None:
+                st["fails"] = 0
+                st["ejected_at"] = -1
+
+    def owner_health(self) -> Dict[int, Dict[str, int]]:
+        """Per-owner hedging health snapshot: consecutive ``fails`` and
+        ``ejected_at`` (the dispatch index an ejection started at; -1 =
+        serving)."""
+        with self._lock:
+            return {h: dict(st)
+                    for h, st in sorted(self._owner_health.items())}
+
+    def hedge_events(self) -> List[Tuple[int, int, str, str]]:
+        """The hedge log sorted by (dispatch index, owner, reason,
+        target) — the deterministic replay view (append order may
+        interleave across concurrent in-flight flushes)."""
+        return sorted(self.hedge_log)
+
     def _resolve(self, fl: _RoutedFlush, rows: Optional[np.ndarray]) -> None:
+        """Per-request error isolation (round 15): a slot resolves with
+        ITS error — ``fl.error`` (whole-flush: assemble/collective
+        failure) or its position's ``fl.slot_errors`` entry (its owner
+        sub-batch failed with no failover) — and every other slot
+        resolves normally. An errored slot is never cached."""
         with self._lock:
             now = t_res0 = self._clock()
             for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
                 self._inflight.pop(k, None)
-                if fl.error is None:
+                if slot.event.is_set():
+                    # abandoned by a bounded stop() drain (resolve-once
+                    # rule — see ServeEngine._resolve)
+                    continue
+                err = fl.error or fl.slot_errors.get(i)
+                if err is None:
                     if slot.version == self.params_version:
                         self.cache.put(k, slot.version, rows[i])
                     slot.resolve(rows[i])
                 else:
-                    slot.resolve(None, error=fl.error)
-                for t0 in slot.waiters:
-                    self.stats.latency.record_ms((now - t0) * 1e3)
+                    slot.resolve(None, error=err)
+                    self.stats.request_errors += 1
+                for t0, tenant in slot.waiters:
+                    ms = (now - t0) * 1e3
+                    self.stats.latency.record_ms(ms)
+                    self.stats.tenant_hist(tenant).record_ms(ms)
             if fl.error is None:
                 self.stats.router_dispatches += 1
                 self.stats.routed_seeds += len(fl.keys)
@@ -991,7 +1496,13 @@ class DistServeEngine:
         shard's key stream — stays deterministic). As in
         `ServeEngine.flush`, the window permit is taken under ``_seq``
         AFTER the drain, so seeds arriving while this flush waits for a
-        slot join it (late admission) before the owner split is sealed."""
+        slot join it (late admission) before the owner split is sealed.
+
+        ERROR CONTRACT (round 15): an owner sub-batch failure in host
+        mode is PER-REQUEST — it resolves only that sub-batch's slots
+        with the exception (after failover was tried) and `flush` returns
+        normally; only whole-flush infrastructure failures (assemble/seal
+        errors, a collective-exchange abort) re-raise here."""
         fl = None
         have_permit = False
         try:
@@ -1053,6 +1564,13 @@ class DistServeEngine:
                     self._fence.wait()
                 for eng in self.engines.values():
                     eng.update_params(params)
+                # the hot-set replica and the full-graph fallback serve
+                # under the same weights as the owners — same fence
+                if self.replica is not None:
+                    self.replica.engine.update_params(params)
+                if self.fallback is not None:
+                    self.fallback.update_params(params)
+                self._params = params
                 self.params_version += 1
                 self.cache.invalidate()
                 for slot in self._pending.values():
@@ -1089,29 +1607,169 @@ class DistServeEngine:
         rows independently)."""
         return sum(e.placement_version for e in self.engines.values())
 
-    def warmup(self) -> Dict[int, Dict[int, float]]:
+    def refresh_replicas(self, ids=None, k: Optional[int] = None,
+                         ) -> Dict[str, object]:
+        """(Re)build the hot-set replica (round 15, ROADMAP item 3a):
+        pick the head — ``ids`` explicitly, or the ``k`` hottest seeds
+        from the ROUTER's workload sketch (``k`` defaults to
+        ``config.replicate_top_k``; price it with `scaling.skew_table`
+        from the measured head-concentration curve) — and mirror it
+        locally as a full `ServeEngine` over the head's halo-closure
+        topology (`shard_topology_for_seeds`) + feature rows
+        (`ClosureFeature`).
+
+        The swap runs under the SAME fence as `update_params` /
+        `apply_placement` (sequencing lock + in-flight drain), so no
+        routed flush ever straddles a replica version; the router cache
+        entries of every REFRESHED key (old set union new set — the keys
+        whose serving path changed) are invalidated, and exactly those
+        (pinned in tests/test_serve_dist.py). ``replica_version`` bumps
+        per refresh. ``ids=[]`` disables replication.
+
+        Replica-served rows keep the standing parity contract: the
+        closure topology makes the replica sampler's draws for
+        replicated seeds bit-equal to a full-graph sampler's on the same
+        key stream, so `replay_fleet_oracle` replays its dispatch log
+        exactly like an owner shard's."""
+        if self._replica_materials is None:
+            raise ValueError(
+                "hot-set replication needs the build()-time materials "
+                "(full topology + feature table); a bare-constructed "
+                "multi-process engine holds only its own shard"
+            )
+        m = self._replica_materials
+        if ids is None:
+            k = int(self.config.replicate_top_k if k is None else k)
+            if k <= 0:
+                raise ValueError(
+                    "pass ids= or set DistServeConfig.replicate_top_k > 0"
+                )
+            if self.workload is None:
+                raise ValueError(
+                    "picking the hot set reads the router workload sketch "
+                    "— pass DistServeConfig(workload=WorkloadConfig(...)) "
+                    "or give ids= explicitly"
+                )
+            ids = self.workload.hot_set(k)
+        ids = np.unique(np.asarray(ids, np.int64))
+        new_replica = None
+        st: Dict[str, float] = {}
+        if ids.size:
+            from ..pyg.sage_sampler import GraphSageSampler
+
+            sizes = list(m["sizes"])
+            # adjacency closure: len(sizes)-1 expansion hops; feature
+            # closure one deeper (leaves gathered, never expanded) — the
+            # same construction as the owner shards in `build`
+            topo_r, st, closure_ids = shard_topology_for_seeds(
+                m["csr_topo"], ids, hops=len(sizes) - 1,
+                closure_hops=len(sizes),
+            )
+            sampler = GraphSageSampler(
+                topo_r, sizes=sizes, mode=m["sampler_mode"],
+                seed=m["sampler_seed"], **m["sampler_kw"],
+            )
+            n = m["csr_topo"].indptr.shape[0] - 1
+            local_map = np.full(n, -1, np.int32)
+            local_map[closure_ids] = np.arange(
+                closure_ids.shape[0], dtype=np.int32
+            )
+            feat_r = ClosureFeature(
+                np.asarray(m["feat"], np.float32)[closure_ids], local_map
+            )
+        # construct + AOT-warmup the replica engine OUTSIDE the fence:
+        # the bucket compiles take seconds, and a routine refresh must
+        # not stall every submit() (the fence Condition wraps the
+        # router's request lock) for that long. Only the pointer swap +
+        # cache invalidation need the fence.
+        eng = None
+        if ids.size:
+            with self._lock:
+                params_snapshot = self._params
+            eng = ServeEngine(
+                m["model"], params_snapshot, sampler, feat_r,
+                m["shard_config"],
+            )
+            eng.warmup()
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                if eng is not None and self._params is not params_snapshot:
+                    # a weight update landed while we compiled: re-stamp
+                    # under the fence (cheap — swap + invalidate) so the
+                    # replica never serves stale params
+                    eng.update_params(self._params)
+                old = self.replica
+                if old is not None and old.engine.config.record_dispatches:
+                    # kept ONLY for the replay oracle (its dispatch log
+                    # vouches for pre-refresh rows) — a production engine
+                    # without dispatch recording retains nothing, so
+                    # periodic refreshes never accumulate dead engines
+                    self._retired_replicas.append(old.engine)
+                self.replica_version += 1
+                if eng is not None:
+                    new_replica = _HotReplica(
+                        eng, ids, self.replica_version, dict(st)
+                    )
+                self.replica = new_replica
+                old_ids = old.ids if old is not None else np.array(
+                    [], np.int64
+                )
+                refreshed = np.union1d(old_ids, ids)
+                invalidated = self.cache.invalidate_keys(
+                    int(x) for x in refreshed
+                )
+        return {
+            "replicated": int(ids.size),
+            "version": self.replica_version,
+            "invalidated": invalidated,
+            "closure_nodes": int(st.get("closure_nodes", 0)),
+            "edge_frac": float(st.get("edge_frac", 0.0)),
+        }
+
+    def warmup(self) -> Dict[object, Dict[int, float]]:
         """Pre-trace every shard engine's bucket programs (twin samplers
-        where supported, so no shard's key stream moves). Returns
+        where supported, so no shard's key stream moves) — plus the
+        full-graph fallback's and the live replica's, under the
+        ``"fallback"`` / ``"replica"`` keys. Returns
         {host: {bucket: seconds}}."""
-        return {h: eng.warmup() for h, eng in self.engines.items()}
+        out: Dict[object, Dict[int, float]] = {
+            h: eng.warmup() for h, eng in self.engines.items()
+        }
+        if self.fallback is not None:
+            out["fallback"] = self.fallback.warmup()
+        if self.replica is not None:
+            out["replica"] = self.replica.engine.warmup()
+        return out
 
     def aggregate_stats(self) -> Dict[str, object]:
         """Router snapshot + the per-shard `ServeStats` merged into one
         view (`ServeStats.merge` -> the `trace` merge family) + per-shard
         topology shard stats. The merged latency histogram is OWNER-side
         latency; end-to-end latency (queue + route + owner + return) is the
-        router's own ``stats.latency``."""
+        router's own ``stats.latency``. The replica/fallback engines (when
+        built) merge into ``shards_merged`` and appear under their own
+        keys — they are serving engines like any owner."""
         merged = ServeStats()
         for h in sorted(self.engines):
             merged.merge(self.engines[h].stats)
-        return {
+        out: Dict[str, object] = {
             "router": self.stats.snapshot(),
-            "shards_merged": merged.snapshot(),
             "per_shard": {
                 h: self.engines[h].stats.snapshot() for h in sorted(self.engines)
             },
             "topology": self.shard_topo_stats,
         }
+        if self.replica is not None:
+            merged.merge(self.replica.engine.stats)
+            out["replica"] = self.replica.engine.stats.snapshot()
+            out["replica"]["replicated_ids"] = int(self.replica.ids.size)
+        if self.fallback is not None:
+            merged.merge(self.fallback.stats)
+            out["fallback"] = self.fallback.stats.snapshot()
+        out["shards_merged"] = merged.snapshot()
+        return out
 
     def reset_stats(self) -> None:
         """Zero router counters (re-pointing the router cache's counter at
@@ -1127,6 +1785,10 @@ class DistServeEngine:
                 self.workload.clear()
         for eng in self.engines.values():
             eng.reset_stats()
+        if self.replica is not None:
+            self.replica.engine.reset_stats()
+        if self.fallback is not None:
+            self.fallback.reset_stats()
 
     # -- fleet observability ----------------------------------------------
 
@@ -1142,10 +1804,31 @@ class DistServeEngine:
         Owner-engine metrics ride :meth:`fleet_registry`."""
         reg = registry if registry is not None else MetricsRegistry()
         for f in ("requests", "coalesced", "router_dispatches",
-                  "routed_seeds", "late_admitted"):
+                  "routed_seeds", "late_admitted", "replica_hits",
+                  "hedges", "hedged_seeds", "hedge_timeouts",
+                  "hedge_errors", "hedge_ejected", "hedge_failed",
+                  "owner_ejections", "shed", "request_errors",
+                  "undrained"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"DistServeStats.{f}", labels)
+        reg.gauge_fn(f"{prefix}_replica_version",
+                     lambda: self.replica_version,
+                     "hot-set replica refreshes applied", labels)
+        reg.gauge_fn(f"{prefix}_replica_rows",
+                     lambda: (self.replica.ids.size
+                              if self.replica is not None else 0),
+                     "seeds currently replicated on every host", labels)
+        reg.gauge_fn(f"{prefix}_owners_ejected",
+                     lambda: sum(
+                         1 for st in self.owner_health().values()
+                         if st["ejected_at"] >= 0
+                     ),
+                     "owners currently in ejection backoff", labels)
+        register_tenant_latency(
+            reg, prefix, "end-to-end routed latency by submitting tenant",
+            lambda: self.stats, self.config.tenant_weights, labels,
+        )
         reg.counter_fn(f"{prefix}_exchange_id_bytes_total",
                        lambda: self.stats.exchange_id_bytes,
                        "global collective id payload bytes", labels)
@@ -1218,6 +1901,18 @@ class DistServeEngine:
         for h in sorted(self.engines):
             self.engines[h].register_metrics(
                 reg, prefix="quiver_serve", labels={"host": str(h)}
+            )
+        # the replica/fallback engines are serving engines like any owner
+        # — same families under reserved host labels. A replica refresh
+        # swaps the engine; re-calling fleet_registry re-points the
+        # adapters (last-writer-wins, the registry's documented rule).
+        if self.replica is not None:
+            self.replica.engine.register_metrics(
+                reg, prefix="quiver_serve", labels={"host": "replica"}
+            )
+        if self.fallback is not None:
+            self.fallback.register_metrics(
+                reg, prefix="quiver_serve", labels={"host": "fallback"}
             )
         return reg
 
@@ -1355,23 +2050,40 @@ class DistServeEngine:
         tier_daemon_loop(self)
 
     def stop(self, drain: bool = True) -> None:
+        """Stop the pollers and retire queued work, BOUNDED by
+        ``config.drain_deadline_s`` (round 15): a poller or owner that
+        died mid-flush must not hang the caller. Work not retired by the
+        deadline resolves with `serve.engine.DrainTimeout` and is counted
+        in ``stats.undrained`` — in the snapshot, never silently
+        dropped."""
         self._running = False
+        # one deadline covers poller joins too (a poller wedged mid-flush
+        # must not defeat the bound — see ServeEngine.stop)
+        deadline = self._clock() + self.config.drain_deadline_s
         for t in self._threads:
-            t.join()
+            t.join(timeout=max(deadline - self._clock(), 0.05))
         self._threads = []
         if drain:
-            while self._drainable():
-                self.flush()
+            while self._drainable() and self._clock() < deadline:
+                try:
+                    self.flush()
+                except Exception:
+                    pass  # the failing flush resolved its own waiters
         with self._fence:
-            while self._inflight_flushes:
-                self._fence.wait()
+            while self._inflight_flushes and self._clock() < deadline:
+                self._fence.wait(timeout=0.05)
+        abandon_undrained(self, drained=drain)
 
     def _poll_loop(self) -> None:
         while self._running:
             try:
                 self.pump()
             except Exception:
-                pass  # the failing flush already resolved its waiters
+                # whole-flush infrastructure errors only (round-15
+                # contract: owner failures are per-request and never
+                # raise out of flush); the failing flush already resolved
+                # its waiters with the error — keep serving
+                pass
             time.sleep(self.config.flush_poll_ms / 1e3)
 
     def __enter__(self) -> "DistServeEngine":
@@ -1412,4 +2124,47 @@ def replay_shard_oracle(
             )
             for i in range(nvalid):
                 served.setdefault(int(padded[i]), logits[i])
+    return served
+
+
+def replay_fleet_oracle(
+    dist: DistServeEngine,
+    model,
+    params,
+    full_sampler_factory: Callable[[], object],
+    full_feature,
+) -> Dict[int, List[np.ndarray]]:
+    """`replay_shard_oracle` extended over the WHOLE round-15 fleet:
+    owners + the hot-set replica + the full-graph fallback, each engine's
+    dispatch log replayed through a fresh FULL-graph sampler and the
+    offline `batch_logits` path, collecting EVERY computation of every
+    node (not just the first — a cache invalidation, e.g. a replica
+    refresh, can legitimately recompute a node under a later key draw).
+
+    Returns {node_id: [candidate rows]}. Under hedged/failover dispatch a
+    node may be computed by more than one engine over a run (its owner
+    before a fault, the fallback after) — a served row is CORRECT iff it
+    bit-matches one candidate, which is exactly the fault-parity
+    acceptance the probe and tests/test_faults.py assert: faults and
+    failovers change WHO computes, never change any completed bit away
+    from an offline full-graph replay."""
+    from ..inference import _cached_apply, batch_logits
+
+    apply = _cached_apply(model)
+    engines: Dict[object, ServeEngine] = dict(dist.engines)
+    if dist.replica is not None:
+        engines["replica"] = dist.replica.engine
+    for i, retired in enumerate(dist._retired_replicas):
+        engines[f"replica_retired_{i}"] = retired
+    if dist.fallback is not None:
+        engines["fallback"] = dist.fallback
+    served: Dict[int, List[np.ndarray]] = {}
+    for h in sorted(engines, key=str):
+        sampler = full_sampler_factory()
+        for padded, nvalid in engines[h].dispatch_log:
+            logits = np.asarray(
+                batch_logits(apply, params, sampler, full_feature, padded)
+            )
+            for i in range(nvalid):
+                served.setdefault(int(padded[i]), []).append(logits[i])
     return served

@@ -118,6 +118,154 @@ from ..trace import (
 from .cache import EmbeddingCache
 
 
+DEFAULT_TENANT = "default"
+
+
+class ShedError(RuntimeError):
+    """Request refused at admission: the engine's queue-depth bound was
+    hit and the submitting tenant is at or over its weighted quota
+    (``ServeConfig.max_queue_depth`` / ``tenant_weights``). Per-request
+    and deterministic — the decision reads only queue state, never wall
+    time — and delivered through the returned `ServeResult`, never
+    raised out of ``submit`` itself."""
+
+
+class DrainTimeout(RuntimeError):
+    """``stop(drain=True)`` could not retire every queued request within
+    ``ServeConfig.drain_deadline_s`` (e.g. a poller or owner died
+    mid-flush). Undrained slots are resolved with this error so waiters
+    unblock instead of hanging, and counted in ``stats.undrained``."""
+
+
+def weighted_drain_keys(pending: Dict[int, "_Slot"], cap: int,
+                        tenant_weights: Optional[Dict[str, float]],
+                        ) -> List[int]:
+    """A flush's drain set (caller holds the owning engine's lock): FIFO
+    prefix of the pending queue, except when ``tenant_weights`` is set and
+    the queue overflows ``cap`` — then each tenant gets its
+    largest-remainder share of the flush (FIFO within a tenant), unused
+    quota refills FIFO, and the picked keys keep their queue order so
+    batch composition stays deterministic. Shared by `ServeEngine` and
+    `DistServeEngine` so the two front ends make identical QoS
+    decisions."""
+    if not tenant_weights or len(pending) <= cap:
+        return list(pending)[:cap]
+    by_tenant: Dict[str, List[int]] = {}
+    for k, slot in pending.items():
+        by_tenant.setdefault(slot.tenant, []).append(k)
+    tenants = sorted(by_tenant)
+    weights = {t: float(tenant_weights.get(t, 1.0)) for t in tenants}
+    total = sum(weights.values()) or 1.0
+    shares = {t: cap * weights[t] / total for t in tenants}
+    quota = {t: int(shares[t]) for t in tenants}
+    rem = cap - sum(quota.values())
+    for t in sorted(tenants, key=lambda t: (-(shares[t] - quota[t]), t))[:rem]:
+        quota[t] += 1
+    picked = set()
+    for t in tenants:
+        picked.update(by_tenant[t][: quota[t]])
+    keys: List[int] = [k for k in pending if k in picked]
+    if len(keys) < cap:  # a tenant under-filled its quota: FIFO refill
+        for k in pending:
+            if k not in picked:
+                keys.append(k)
+                if len(keys) == cap:
+                    break
+        order = {k: i for i, k in enumerate(pending)}
+        keys.sort(key=order.__getitem__)
+    return keys
+
+
+def shed_decision(pending_len: int, tenant_pending: int, tenant: str,
+                  max_queue_depth: int,
+                  tenant_weights: Optional[Dict[str, float]]) -> bool:
+    """The deterministic shed rule shared by both front ends: shed iff
+    the pending queue is at ``max_queue_depth`` AND the tenant already
+    holds its weighted share of it. A tenant under quota is admitted
+    even at a full queue (the bound protects light tenants from heavy
+    ones, not the queue from light tenants)."""
+    if max_queue_depth <= 0 or pending_len < max_queue_depth:
+        return False
+    if not tenant_weights:
+        return True  # single implicit tenant: plain depth bound
+    w = float(tenant_weights.get(tenant, 1.0))
+    total = sum(float(v) for v in tenant_weights.values())
+    if tenant not in tenant_weights:
+        total += w
+    # all-zero weights (every tenant "blocked"): fall back to the plain
+    # depth bound with a 1-slot floor per tenant — never divide by zero
+    quota = max(1, int(max_queue_depth * w / (total or 1.0)))
+    return tenant_pending >= quota
+
+
+def tenant_latency_hist(tenant_latency: Dict[str, LatencyHistogram],
+                        tenant: str) -> LatencyHistogram:
+    """Get-or-create a tenant's latency histogram — the one creation
+    path shared by `ServeStats.tenant_hist` and
+    `DistServeStats.tenant_hist`, so the router's per-tenant tails can
+    never diverge from the single-host engine's in construction."""
+    h = tenant_latency.get(tenant)
+    if h is None:
+        h = tenant_latency[tenant] = LatencyHistogram()
+    return h
+
+
+def register_tenant_latency(reg, prefix: str, help_text: str, get_stats,
+                            tenant_weights: Optional[Dict[str, float]],
+                            labels: Optional[Dict[str, str]] = None) -> None:
+    """Register the per-tenant latency histogram family (``tenant``
+    label): tenants known from the QoS config plus any observed so far
+    (later tenants appear on the next registration call). ``get_stats``
+    is a zero-arg resolver so `reset_stats` swaps are followed. Shared
+    by `ServeEngine.register_metrics` and the router."""
+    for t in sorted(set(tenant_weights or ())
+                    | set(get_stats().tenant_latency)):
+        reg.histogram(
+            f"{prefix}_tenant_latency_ms", help_text,
+            dict(labels or {}, tenant=str(t)),
+            fn=(lambda t=t: get_stats().tenant_latency.get(t)
+                or LatencyHistogram()),
+        )
+
+
+def abandon_undrained(engine, drained: bool = True) -> None:
+    """Resolve whatever a bounded ``stop`` left behind with
+    `DrainTimeout` and count it in ``stats.undrained`` — shared by
+    `ServeEngine` and `DistServeEngine` (both expose the queue state and
+    stats fields this reads). ``drained`` distinguishes the message: a
+    deliberate ``stop(drain=False)`` with queued work is not a deadline
+    failure and must not read like one."""
+    with engine._lock:
+        leftover = len(engine._pending) + len(engine._inflight)
+        if not leftover and not engine._inflight_flushes:
+            return
+        if drained:
+            msg = (
+                f"stop(drain=True) abandoned {leftover} slot(s) after "
+                f"{engine.config.drain_deadline_s}s "
+                f"({engine._inflight_flushes} flush(es) still in flight)"
+            )
+        else:
+            msg = (
+                f"stop(drain=False) left {leftover} queued slot(s) "
+                f"unserved (no drain was requested)"
+            )
+        err = DrainTimeout(msg)
+        for slot in list(engine._pending.values()):
+            slot.resolve(None, error=err)
+        for slot in list(engine._inflight.values()):
+            if not slot.event.is_set():
+                slot.resolve(None, error=err)
+        # clear BOTH maps: a later submit must never coalesce onto an
+        # abandoned (errored) slot, and the wedged flush's eventual
+        # _resolve skips already-set slots (resolve-once rule)
+        engine._pending.clear()
+        engine._inflight.clear()
+        engine._pending_tenant.clear()
+        engine.stats.undrained += leftover
+        engine.stats.request_errors += leftover
+
+
 def default_buckets(max_batch: int) -> Tuple[int, ...]:
     """Powers of two up to ``max_batch`` (inclusive, appended if it is not
     itself a power of two): the bucket ladder that bounds padding waste at
@@ -226,6 +374,27 @@ class ServeConfig:
                      deterministic tests drive). Placement application
                      is ALWAYS fenced like `update_params` regardless
                      of who calls it.
+    tenant_weights : round-15 per-tenant admission: {tenant: weight}
+                     flush-quota shares (None = no QoS, the pre-round-15
+                     engine byte for byte). When the pending queue
+                     exceeds ``max_batch``, `flush` drains tenants in
+                     weighted proportion (largest-remainder apportioning,
+                     FIFO within a tenant, unused quota refilled FIFO) —
+                     a heavy tenant can saturate its share, never the
+                     whole flush. Tenants absent from the dict weigh 1.0.
+    max_queue_depth : queue-depth-bounded load shedding (0 = never shed).
+                     A NEW request whose tenant is at/over its weighted
+                     share of this bound while the queue is full is
+                     refused with a `ShedError` carried in its
+                     `ServeResult` (per-request, never engine-fatal).
+                     The decision reads only queue state — deterministic
+                     and logged (``ServeEngine.shed_log``). Cache hits
+                     and coalesces never shed (they add no queue entry).
+    drain_deadline_s : bound on ``stop(drain=True)``: if queued work
+                     cannot be retired within this budget (a poller or
+                     owner died mid-flush), remaining slots resolve with
+                     `DrainTimeout` and are counted in
+                     ``stats.undrained`` instead of hanging the caller.
     """
 
     max_batch: int = 64
@@ -244,6 +413,9 @@ class ServeConfig:
     tier_promote_min: float = 2.0
     tier_hysteresis: float = 1.25
     tier_adapt_every_s: float = 0.0
+    tenant_weights: Optional[Dict[str, float]] = None
+    max_queue_depth: int = 0
+    drain_deadline_s: float = 30.0
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -266,18 +438,21 @@ class _Slot:
     isn't journaling) — the key the lifecycle events thread through."""
 
     __slots__ = ("node_id", "version", "event", "value", "error", "enqueue_t",
-                 "waiters", "rid")
+                 "waiters", "rid", "tenant")
 
     def __init__(self, node_id: int, version: int, enqueue_t: float,
-                 rid: int = -1):
+                 rid: int = -1, tenant: str = DEFAULT_TENANT):
         self.node_id = node_id
         self.version = version
         self.event = threading.Event()
         self.value: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.enqueue_t = enqueue_t
-        self.waiters: List[float] = []  # submit timestamps, for latency
+        # (submit timestamp, tenant) per attached request: latency lands
+        # in the global histogram AND the submitting tenant's
+        self.waiters: List[Tuple[float, str]] = []
         self.rid = rid
+        self.tenant = tenant  # admitting tenant (quota accounting)
 
     def resolve(self, value: Optional[np.ndarray], error=None) -> None:
         self.value = value
@@ -286,23 +461,42 @@ class _Slot:
 
 
 class ServeResult:
-    """Handle returned by :meth:`ServeEngine.submit`."""
+    """Handle returned by :meth:`ServeEngine.submit`. May carry a value
+    (cache hit), a slot (queued computation), or a per-request error
+    (e.g. `ShedError` at admission, an owner failure isolated to this
+    request's sub-batch)."""
 
-    __slots__ = ("_slot", "_value")
+    __slots__ = ("_slot", "_value", "_error")
 
-    def __init__(self, slot: Optional[_Slot] = None, value: Optional[np.ndarray] = None):
+    def __init__(self, slot: Optional[_Slot] = None,
+                 value: Optional[np.ndarray] = None,
+                 error: Optional[BaseException] = None):
         self._slot = slot
         self._value = value
+        self._error = error
 
     def done(self) -> bool:
         return self._slot is None or self._slot.event.is_set()
 
+    def error(self) -> Optional[BaseException]:
+        """The request's exception without raising (None if none yet;
+        a queued request's error is known only after it resolves)."""
+        if self._error is not None:
+            return self._error
+        if self._slot is not None and self._slot.event.is_set():
+            return self._slot.error
+        return None
+
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Logits row for the requested node (blocks until its flush
-        lands; raises the flush's exception if the dispatch failed).
+        lands; raises the request's exception if it was shed at
+        admission or its dispatch failed — per-request: co-flushed
+        requests of a healthy sub-batch resolve normally).
 
         The row is READ-ONLY — it is shared with the embedding cache and
         every coalesced co-waiter. Copy before mutating."""
+        if self._error is not None:
+            raise self._error
         if self._slot is None:
             return self._value
         if not self._slot.event.wait(timeout):
@@ -348,11 +542,23 @@ class ServeStats:
     tier_promoted: int = 0      # rows moved UP a tier (round 14)
     tier_demoted: int = 0       # rows moved DOWN a tier
     placement_batches: int = 0  # fenced placement applies
+    shed: int = 0               # requests refused at admission (round 15)
+    request_errors: int = 0     # slots resolved with a per-request error
+    undrained: int = 0          # slots abandoned by a bounded stop() drain
     inflight_peak: int = 0
     dispatch_buckets: Dict[int, int] = field(default_factory=dict)
     cache: HitRateCounter = field(default_factory=HitRateCounter)
     latency: LatencyHistogram = field(default_factory=LatencyHistogram)
+    # per-tenant end-to-end latency (round 15): one histogram per tenant
+    # that ever submitted — `tenant_latency["t"].percentile(99)` is the
+    # per-tenant p99 the admission work is judged by
+    tenant_latency: Dict[str, LatencyHistogram] = field(default_factory=dict)
     spans: SpanRecorder = field(default_factory=SpanRecorder)
+
+    def tenant_hist(self, tenant: str) -> LatencyHistogram:
+        """The tenant's latency histogram, created on first use. Callers
+        mutate it under the owning engine's lock; readers snapshot."""
+        return tenant_latency_hist(self.tenant_latency, tenant)
 
     def merge(self, other: "ServeStats") -> "ServeStats":
         """Fold another engine's stats into this one — the cross-shard
@@ -379,9 +585,14 @@ class ServeStats:
         self.tier_promoted += other.tier_promoted
         self.tier_demoted += other.tier_demoted
         self.placement_batches += other.placement_batches
+        self.shed += other.shed
+        self.request_errors += other.request_errors
+        self.undrained += other.undrained
         self.inflight_peak = max(self.inflight_peak, other.inflight_peak)
         for b, n in other.dispatch_buckets.copy().items():
             self.dispatch_buckets[b] = self.dispatch_buckets.get(b, 0) + n
+        for t, h in other.tenant_latency.copy().items():
+            self.tenant_hist(t).merge(h)
         self.cache.merge(other.cache)
         self.latency.merge(other.latency)
         self.spans.merge(other.spans)
@@ -400,10 +611,17 @@ class ServeStats:
             "tier_promoted": self.tier_promoted,
             "tier_demoted": self.tier_demoted,
             "placement_batches": self.placement_batches,
+            "shed": self.shed,
+            "request_errors": self.request_errors,
+            "undrained": self.undrained,
             "inflight_peak": self.inflight_peak,
             "dispatch_buckets": dict(self.dispatch_buckets),
             "cache": self.cache.snapshot(),
             "latency": self.latency.snapshot(),
+            "tenant_latency": {
+                t: self.tenant_latency[t].snapshot()
+                for t in sorted(self.tenant_latency)
+            },
             "overlap": self.spans.overlap_summary(),
         }
 
@@ -535,6 +753,14 @@ class ServeEngine:
         # = FIFO), _inflight slots snapshot-ed by a running flush
         self._pending: "Dict[int, _Slot]" = {}
         self._inflight: Dict[int, _Slot] = {}
+        import collections
+
+        # round-15 per-tenant admission state (guarded by _lock):
+        # pending-slot counts per admitting tenant, and the deterministic
+        # shed decisions log [(request_seq, tenant, node_id)] — a bounded
+        # ring: sustained overload (when it fills) must not leak
+        self._pending_tenant: Dict[str, int] = {}
+        self.shed_log = collections.deque(maxlen=65536)
         # the assembled-but-not-yet-sealed flush accepting late admissions
         # (guarded by _lock; non-None only while its flusher holds _seq)
         self._open: Optional[_Flush] = None
@@ -557,16 +783,26 @@ class ServeEngine:
 
     # -- request path -----------------------------------------------------
 
-    def submit(self, node_id: int) -> ServeResult:
+    def submit(self, node_id: int,
+               tenant: Optional[str] = None) -> ServeResult:
         """Enqueue one node-prediction request; returns a handle. Fills of
         ``max_batch`` flush inline on the submitting thread. A seed
         arriving while a flush sits assembled-but-not-yet-dispatched (late
         admission enabled, pad slack left) rides that flush's pad lanes
-        instead of waiting a whole extra flush. KEEP IN LOCKSTEP with
-        `DistServeEngine.submit` (serve/dist.py): the distributed router's
-        hosts=1 bit-parity contract rides this exact
-        cache-check/coalesce/admit/flush-at-fill sequence."""
+        instead of waiting a whole extra flush.
+
+        ``tenant`` names the submitting tenant (round 15): its latency
+        lands in ``stats.tenant_latency[tenant]``, its queue share is
+        bounded by ``tenant_weights``/``max_queue_depth`` (an over-quota
+        submit at a full queue returns a `ShedError`-carrying result —
+        deterministic, logged in ``shed_log``), and flush quotas drain
+        tenants in weighted proportion. Cache hits and coalesces never
+        shed. KEEP IN LOCKSTEP with `DistServeEngine.submit`
+        (serve/dist.py): the distributed router's hosts=1 bit-parity
+        contract rides this exact cache-check/coalesce/admit/flush-at-fill
+        sequence."""
         key = int(node_id)
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         now = self._clock()
         need_flush = False
         jr = self.journal
@@ -577,7 +813,9 @@ class ServeEngine:
                 wl.observe_seed(key)  # observe-only frequency tap
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
-                self.stats.latency.record_ms((self._clock() - now) * 1e3)
+                ms = (self._clock() - now) * 1e3
+                self.stats.latency.record_ms(ms)
+                self.stats.tenant_hist(tenant).record_ms(ms)
                 jr.emit("cache_hit", -1, -1, key)
                 return ServeResult(value=cached)
             slot = self._pending.get(key) or self._inflight.get(key)
@@ -585,11 +823,21 @@ class ServeEngine:
                 self.stats.coalesced += 1
                 jr.emit("coalesce", slot.rid, -1, key)
             else:
+                if self._shed_locked(tenant):
+                    self.stats.shed += 1
+                    self.shed_log.append((self.stats.requests, tenant, key))
+                    jr.emit("shed", -1, -1, key)
+                    return ServeResult(error=ShedError(
+                        f"queue depth {len(self._pending)} >= "
+                        f"{self.config.max_queue_depth} and tenant "
+                        f"{tenant!r} is at its weighted quota"
+                    ))
                 rid = -1
                 if jr.enabled:
                     rid = self._next_rid
                     self._next_rid += 1
-                slot = _Slot(key, self.params_version, now, rid=rid)
+                slot = _Slot(key, self.params_version, now, rid=rid,
+                             tenant=tenant)
                 fl = self._open
                 if fl is not None and len(fl.keys) < fl.bucket:
                     # late admission into the open flush's pad slack (its
@@ -602,13 +850,22 @@ class ServeEngine:
                     jr.emit("late_admit", rid, fl.fid, key)
                 else:
                     self._pending[key] = slot
+                    self._pending_tenant[tenant] = (
+                        self._pending_tenant.get(tenant, 0) + 1
+                    )
                     jr.emit("submit", rid, -1, key)
-            slot.waiters.append(now)
+            slot.waiters.append((now, tenant))
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
         if need_flush:
             self.flush()
         return ServeResult(slot=slot)
+
+    def _shed_locked(self, tenant: str) -> bool:
+        return shed_decision(
+            len(self._pending), self._pending_tenant.get(tenant, 0), tenant,
+            self.config.max_queue_depth, self.config.tenant_weights,
+        )
 
     def predict(self, node_ids, timeout: Optional[float] = None) -> np.ndarray:
         """Blocking convenience: submit every id, make sure they flush
@@ -651,8 +908,14 @@ class ServeEngine:
         with self._lock:
             if not self._pending:
                 return None
-            keys = list(self._pending)[: self.config.max_batch]
+            keys = self._drain_keys_locked()
             slots = [self._pending.pop(k) for k in keys]
+            for s in slots:
+                n = self._pending_tenant.get(s.tenant, 1) - 1
+                if n > 0:
+                    self._pending_tenant[s.tenant] = n
+                else:
+                    self._pending_tenant.pop(s.tenant, None)
             self._inflight.update(zip(keys, slots))
             # params snapshot: the fence in update_params guarantees no
             # swap lands while this flush is in flight, so the snapshot and
@@ -752,6 +1015,11 @@ class ServeEngine:
             now = t_res0 = self._clock()
             for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
                 self._inflight.pop(k, None)
+                if slot.event.is_set():
+                    # abandoned by a bounded stop() drain: the error was
+                    # delivered and the waiters counted — a late
+                    # completion must not overwrite it or double-count
+                    continue
                 if fl.error is None:
                     row = logits[i]
                     if slot.version == self.params_version:
@@ -759,8 +1027,11 @@ class ServeEngine:
                     slot.resolve(row)
                 else:
                     slot.resolve(None, error=fl.error)
-                for t0 in slot.waiters:
-                    self.stats.latency.record_ms((now - t0) * 1e3)
+                    self.stats.request_errors += 1
+                for t0, tenant in slot.waiters:
+                    ms = (now - t0) * 1e3
+                    self.stats.latency.record_ms(ms)
+                    self.stats.tenant_hist(tenant).record_ms(ms)
             if fl.error is None:
                 self.stats.dispatches += 1
                 self.stats.dispatched_seeds += len(fl.keys)
@@ -847,6 +1118,11 @@ class ServeEngine:
                 return b
         return self._buckets[-1]
 
+    def _drain_keys_locked(self) -> List[int]:
+        return weighted_drain_keys(
+            self._pending, self.config.max_batch, self.config.tenant_weights
+        )
+
     def _drainable(self) -> bool:
         with self._lock:
             return bool(self._pending)
@@ -891,10 +1167,15 @@ class ServeEngine:
         for f in ("requests", "coalesced", "dispatches", "dispatched_seeds",
                   "padded_seeds", "dispatch_calls", "execute_calls",
                   "late_admitted", "tier_promoted", "tier_demoted",
-                  "placement_batches"):
+                  "placement_batches", "shed", "request_errors",
+                  "undrained"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"ServeStats.{f}", labels)
+        register_tenant_latency(
+            reg, prefix, "end-to-end request latency by submitting tenant",
+            lambda: self.stats, self.config.tenant_weights, labels,
+        )
         reg.gauge_fn(f"{prefix}_pending_depth",
                      lambda: len(self._pending),
                      "unique seeds queued and not yet drained", labels)
@@ -1208,18 +1489,32 @@ class ServeEngine:
         return self
 
     def stop(self, drain: bool = True) -> None:
+        """Stop the background threads and retire queued work, BOUNDED by
+        ``config.drain_deadline_s``: a poller or owner thread that died
+        mid-flush must not hang the caller forever. Work not retired by
+        the deadline resolves with `DrainTimeout` (waiters unblock, never
+        hang) and is counted in ``stats.undrained`` — visible in the
+        stats snapshot, never silently dropped."""
         self._running = False
+        # the WHOLE stop — poller joins included — shares one deadline: a
+        # poller wedged mid-flush (owner blocked in predict) would defeat
+        # the bound if joined without a timeout
+        deadline = self._clock() + self.config.drain_deadline_s
         for t in self._threads:
-            t.join()
+            t.join(timeout=max(deadline - self._clock(), 0.05))
         self._threads = []
         if drain:
-            while self._drainable():
-                self.flush()
+            while self._drainable() and self._clock() < deadline:
+                try:
+                    self.flush()
+                except Exception:
+                    pass  # the failing flush resolved its own waiters
         # even without drain, leave no flush mid-air: callers expect stats
         # and handles quiescent after stop()
         with self._fence:
-            while self._inflight_flushes:
-                self._fence.wait()
+            while self._inflight_flushes and self._clock() < deadline:
+                self._fence.wait(timeout=0.05)
+        abandon_undrained(self, drained=drain)
 
     def _poll_loop(self) -> None:
         while self._running:

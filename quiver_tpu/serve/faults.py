@@ -1,0 +1,162 @@
+"""Deterministic fault injection for the distributed serving fleet.
+
+The round-15 fleet policies (hot-set replication, hedged/failover
+dispatch, per-owner ejection) only earn their keep if they can be PROVEN
+against failures — and a proof that depends on wall-clock races is no
+proof at all. This module injects owner failures at chosen ROUTER
+DISPATCH INDICES (the same monotonic index the dispatch log and sampler
+key stream ride), so a faulty run is exactly as replayable as a healthy
+one: run the same trace with the same `FaultInjector` plan twice and the
+same owners fail at the same flushes, the same sub-batches hedge to the
+same targets, and every completed request's logits are bit-identical.
+
+Fault kinds:
+
+- ``"kill"``  — the owner is DEAD from the given dispatch index onward:
+  every routed sub-batch to it raises :class:`OwnerKilled` until the end
+  of the run (the machine-went-away case; drives ejection/backoff).
+- ``"error"`` — the owner raises :class:`OwnerFault` at exactly the
+  given dispatch index, then recovers (the transient-crash case; drives
+  hedge-and-retry without ejection when ``eject_after`` > 1).
+- ``"stall"`` — the owner leg sleeps ``stall_s`` seconds at the given
+  dispatch index before serving normally (the slow-host case; drives the
+  ``hedge_deadline_ms`` timeout path — inherently wall-clock, so stall
+  tests pin ORACLE parity, not cross-run bit-equality of who served).
+
+The injector sits on the ROUTER side of the owner call
+(`DistServeEngine._dispatch`, ``exchange="host"`` mode — the per-owner
+legs are individually addressable there; the collective exchange is one
+launch and cannot fail per-owner). It never touches engine state: a
+fault is an exception the hedging machinery handles like any real owner
+failure, which is exactly the point — the tested path IS the production
+path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class OwnerFault(RuntimeError):
+    """Injected transient owner failure (one dispatch index)."""
+
+
+class OwnerKilled(OwnerFault):
+    """Injected permanent owner death (every dispatch index >= fid)."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: ``owner`` fails at router dispatch index
+    ``fid`` with the given ``kind`` ("kill" | "error" | "stall");
+    ``stall_s`` is the injected delay for stalls."""
+
+    owner: int
+    fid: int
+    kind: str
+    stall_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in ("kill", "error", "stall"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.fid < 1:
+            raise ValueError("fid is a dispatch index (first flush seals 1)")
+        if self.kind == "stall" and self.stall_s <= 0:
+            raise ValueError("stall faults need stall_s > 0")
+
+
+class FaultInjector:
+    """Deterministic, replayable owner-fault schedule.
+
+    ``check(owner, fid)`` is the router's hook, called once per routed
+    owner sub-batch BEFORE the owner engine runs: it raises/sleeps per
+    the plan and records what fired into ``log`` (``(fid, owner, kind)``
+    tuples; read `events()` for the sorted view — concurrent in-flight
+    flushes may append out of dispatch order). Keyed purely by
+    (owner, dispatch index): no wall time, no randomness at check time,
+    so a replayed run fires identically.
+    """
+
+    def __init__(self, faults: Sequence[FaultSpec] = ()):
+        self.faults: Tuple[FaultSpec, ...] = tuple(faults)
+        self._kill_at: Dict[int, int] = {}
+        self._oneshot: Dict[Tuple[int, int], FaultSpec] = {}
+        for f in self.faults:
+            if f.kind == "kill":
+                prev = self._kill_at.get(f.owner)
+                self._kill_at[f.owner] = f.fid if prev is None else min(prev, f.fid)
+            else:
+                self._oneshot[(f.owner, f.fid)] = f
+        self._lock = threading.Lock()
+        self.log: List[Tuple[int, int, str]] = []
+
+    @classmethod
+    def seeded(
+        cls,
+        owners: Sequence[int],
+        n_faults: int,
+        seed: int,
+        fid_range: Tuple[int, int] = (2, 32),
+        kinds: Sequence[str] = ("error",),
+        stall_s: float = 0.05,
+    ) -> "FaultInjector":
+        """A random-but-deterministic plan: ``n_faults`` specs drawn from
+        ``seed`` over the given owners / dispatch-index range / kinds.
+        Same seed, same plan — the probe's sweep legs ride this."""
+        rng = np.random.default_rng(seed)
+        owners = list(owners)
+        lo, hi = fid_range
+        specs = [
+            FaultSpec(
+                owner=int(owners[int(rng.integers(0, len(owners)))]),
+                fid=int(rng.integers(lo, hi)),
+                kind=str(kinds[int(rng.integers(0, len(kinds)))]),
+                stall_s=stall_s,
+            )
+            for _ in range(n_faults)
+        ]
+        return cls(specs)
+
+    def check(self, owner: int, fid: int) -> None:
+        """Fire any fault planned for (owner, fid). Raises
+        `OwnerKilled`/`OwnerFault` or sleeps (stall), recording every
+        firing; a no-fault pair returns immediately."""
+        owner, fid = int(owner), int(fid)
+        kill_fid = self._kill_at.get(owner)
+        if kill_fid is not None and fid >= kill_fid:
+            with self._lock:
+                self.log.append((fid, owner, "kill"))
+            raise OwnerKilled(
+                f"owner {owner} killed at dispatch index {kill_fid} "
+                f"(now {fid})"
+            )
+        spec = self._oneshot.get((owner, fid))
+        if spec is None:
+            return
+        with self._lock:
+            self.log.append((fid, owner, spec.kind))
+        if spec.kind == "error":
+            raise OwnerFault(
+                f"owner {owner} injected error at dispatch index {fid}"
+            )
+        time.sleep(spec.stall_s)  # "stall": delay, then serve normally
+
+    def events(self) -> List[Tuple[int, int, str]]:
+        """Fired faults sorted by (fid, owner, kind) — the deterministic
+        view replay comparisons read (append order may interleave across
+        concurrent in-flight flushes)."""
+        with self._lock:
+            return sorted(self.log)
+
+    def killed_owners(self) -> Dict[int, int]:
+        """{owner: first dead dispatch index} for kill specs."""
+        return dict(self._kill_at)
+
+    def clear_log(self) -> None:
+        with self._lock:
+            self.log.clear()

@@ -526,6 +526,11 @@ EVENT_KINDS = (
     "dispatch",      # -, fid, a=bucket          device work begins
     "execute_done",  # -, fid, a=execute_calls   device work + D2H returned
     "resolve",       # -, fid, a=n_resolved      slots resolved, stats landed
+    # round-15 fleet-policy events (policy markers, not stage boundaries:
+    # the per-flush state machine below ignores them)
+    "shed",          # -,   -, a=node            refused at tenant admission
+    "hedge",         # -, fid, a=owner           sub-batch re-routed to a target
+    "eject",         # -, fid, a=owner           owner entered backoff
 )
 
 # rough per-event host bytes: 6-slot tuple + boxed floats/small ints. Used
@@ -544,7 +549,8 @@ def _fold_flush_events(events) -> Dict[int, Dict[str, float]]:
     flushes: Dict[int, Dict[str, float]] = {}
     for (t, kind, rid, fid, a, b) in events:
         if fid < 0 or kind in (
-            "submit", "cache_hit", "coalesce", "late_admit", "assemble"
+            "submit", "cache_hit", "coalesce", "late_admit", "assemble",
+            "shed", "hedge", "eject",
         ):
             continue
         f = flushes.setdefault(fid, {})

@@ -162,6 +162,16 @@ def main():
                     help="SIMULATED per-row cold-read latency (this box's "
                          "page cache makes flat-file reads DRAM-speed; "
                          "production disk is not; 0 = raw page cache)")
+    ap.add_argument("--faults", action="store_true",
+                    help="round-15 fleet-robustness leg: owner-kill "
+                         "replay parity, availability/p99 vs hedge "
+                         "deadline, replication uplift vs skew_table "
+                         "(-> SERVE_r07.json)")
+    ap.add_argument("--fault-requests", type=int, default=400)
+    ap.add_argument("--hedge-deadlines", default="0,30,120",
+                    help="hedge_deadline_ms sweep for the stall leg "
+                         "(0 = no deadline)")
+    ap.add_argument("--replicate-k", type=int, default=16)
     ap.add_argument("--skew", action="store_true",
                     help="run the round-13 workload-skew leg instead of "
                          "the fused/split sweep (-> SERVE_r06.json)")
@@ -189,9 +199,13 @@ def main():
     from quiver_tpu.serve import (
         DistServeConfig,
         DistServeEngine,
+        FaultInjector,
+        FaultSpec,
+        REPLICA_HOST,
         ServeConfig,
         ServeEngine,
         poisson_arrivals,
+        replay_fleet_oracle,
         replay_shard_oracle,
         trace_skew_stats,
         zipfian_trace,
@@ -291,6 +305,335 @@ def main():
                     )
                     parity_rows += 1
         return dist, trace, wall, parity_rows
+
+    # -- round-15 fleet-robustness leg (--faults -> SERVE_r07.json) ----------
+    if args.faults:
+        from quiver_tpu.parallel.scaling import (
+            format_skew_markdown, pick_replication_k, skew_table,
+        )
+        from quiver_tpu.trace import WorkloadConfig as _WC
+
+        HOSTS = 2
+        alpha = 1.3
+
+        def build_fleet(**kw):
+            """Host-mode routed fleet (per-owner legs individually
+            addressable — the hedging/fault surface) with the standard
+            2-bucket shard ladder."""
+            shard_cfg = ServeConfig(
+                max_batch=args.max_batch, buckets=(8, args.max_batch),
+                max_delay_ms=2.0, record_dispatches=True,
+            )
+            cfg = DistServeConfig(
+                hosts=HOSTS, max_batch=args.max_batch, max_delay_ms=2.0,
+                record_dispatches=True, shard_config=shard_cfg,
+                exchange="host", **kw,
+            )
+            dist = DistServeEngine.build(
+                model, params, topo, feat, SIZES, hosts=HOSTS, config=cfg,
+                sampler_seed=SEED,
+            )
+            dist.warmup()
+            dist.reset_stats()
+            return dist
+
+        def serve_seq(dist, trace, timeout=300):
+            """Deterministic sequential drive; returns (rows|exceptions)
+            per request — predict() would re-raise the first per-request
+            error, and the parity comparison wants every outcome."""
+            handles = [dist.submit(int(nid)) for nid in trace]
+            while dist._drainable():
+                dist.flush()
+            out = []
+            for h in handles:
+                try:
+                    out.append(h.result(timeout))
+                except Exception as exc:
+                    out.append(exc)
+            return out
+
+        def oracle_check(dist, trace, rows):
+            """Every COMPLETED row must bit-match a fault-free offline
+            replay candidate of the fleet's dispatch logs."""
+            oracle = replay_fleet_oracle(
+                dist, model, params, make_full_sampler, feat
+            )
+            checked = 0
+            for nid, row in zip(trace, rows):
+                if isinstance(row, Exception):
+                    continue
+                assert any(
+                    np.array_equal(row, c) for c in oracle[int(nid)]
+                ), f"FAULT-PARITY VIOLATION at node {int(nid)}"
+                checked += 1
+            return checked
+
+        trace_f = zipfian_trace(n, args.fault_requests, alpha=alpha, seed=51)
+
+        # (a) THE acceptance leg: kill owner 0 mid-flush, fallback up.
+        # Run the identical faulty run twice: completed rows bit-identical
+        # across runs AND bit-identical to the offline replay; hedges > 0;
+        # errors (there are none here — the fallback absorbs) per-request.
+        def kill_run():
+            inj = FaultInjector([FaultSpec(owner=0, fid=3, kind="kill")])
+            dist = build_fleet(fault_injector=inj, full_graph_fallback=True,
+                               eject_after=1, eject_backoff_flushes=8)
+            rows = serve_seq(dist, trace_f)
+            return dist, rows, inj
+
+        dist_k, rows_k, inj_k = kill_run()
+        assert not any(isinstance(r, Exception) for r in rows_k)
+        parity_rows = oracle_check(dist_k, trace_f, rows_k)
+        sk = dist_k.stats
+        assert sk.hedges > 0, "hedged re-route path not exercised"
+        assert sk.owner_ejections >= 1, sk.snapshot()
+        assert inj_k.events() and inj_k.events()[0][1] == 0
+        dist_k2, rows_k2, inj_k2 = kill_run()
+        assert dist_k2.hedge_events() == dist_k.hedge_events()
+        assert inj_k2.events() == inj_k.events()
+        replay_identical = all(
+            np.array_equal(a, b) for a, b in zip(rows_k, rows_k2)
+        )
+        assert replay_identical, "faulty run did not replay bit-identical"
+        kill_leg = {
+            "fault": {"owner": 0, "fid": 3, "kind": "kill"},
+            "requests": int(trace_f.size),
+            "alpha": alpha,
+            "parity_rows_checked": parity_rows,
+            "completed": int(trace_f.size),
+            "hedges": sk.hedges,
+            "hedged_seeds": sk.hedged_seeds,
+            "hedge_ejected": sk.hedge_ejected,
+            "owner_ejections": sk.owner_ejections,
+            "request_errors": sk.request_errors,
+            "replay_bit_identical": replay_identical,
+            "hedge_events_head": dist_k.hedge_events()[:8],
+        }
+
+        # (a') error isolation with NO failover target: the dead owner's
+        # requests error per-request, everything else completes, the
+        # engine never dies — availability is the surviving share
+        inj_iso = FaultInjector([FaultSpec(owner=0, fid=1, kind="kill")])
+        dist_iso = build_fleet(fault_injector=inj_iso, eject_after=1,
+                               eject_backoff_flushes=8)
+        rows_iso = serve_seq(dist_iso, trace_f)
+        n_err = sum(1 for r in rows_iso if isinstance(r, Exception))
+        assert 0 < n_err < trace_f.size, (n_err, trace_f.size)
+        oracle_check(dist_iso, trace_f, rows_iso)
+        iso_leg = {
+            "fault": {"owner": 0, "fid": 1, "kind": "kill"},
+            "no_failover_target": True,
+            "requests": int(trace_f.size),
+            "errored_per_request": n_err,
+            "completed": int(trace_f.size) - n_err,
+            "availability": round(1.0 - n_err / trace_f.size, 4),
+            "hedge_failed": dist_iso.stats.hedge_failed,
+            "engine_survived": True,  # serve_seq finished every flush
+        }
+
+        # (b) availability + p99 vs hedge deadline under STALL faults
+        # (seeded stalls of 150 ms), fallback up, threaded saturated
+        # drive, median-of-3 per point (NEXT.md noise discipline)
+        stall_s = 0.15
+        deadlines = [float(d) for d in args.hedge_deadlines.split(",")]
+
+        def stall_run(deadline_ms):
+            inj = FaultInjector.seeded(
+                owners=range(HOSTS), n_faults=6, seed=23,
+                fid_range=(2, 14), kinds=("stall",), stall_s=stall_s,
+            )
+            dist = build_fleet(fault_injector=inj, full_graph_fallback=True,
+                               hedge_deadline_ms=deadline_ms)
+            chunks = np.array_split(trace_f, args.clients)
+            results = {}
+
+            def client(tid, chunk):
+                rows = []
+                for nid in chunk:
+                    try:
+                        rows.append(dist.submit(int(nid)).result(300))
+                    except Exception as exc:
+                        rows.append(exc)
+                results[tid] = rows
+
+            t0 = time.perf_counter()
+            with dist:
+                threads = [threading.Thread(target=client, args=(i, c))
+                           for i, c in enumerate(chunks)]
+                [t.start() for t in threads]
+                [t.join() for t in threads]
+            wall = time.perf_counter() - t0
+            all_rows = [r for tid in sorted(results) for r in results[tid]]
+            ok = sum(1 for r in all_rows if not isinstance(r, Exception))
+            s = dist.stats
+            return {
+                "qps": round(trace_f.size / wall, 1),
+                "availability": round(ok / trace_f.size, 4),
+                "p99_ms": round(s.latency.percentile(99), 3),
+                "p50_ms": round(s.latency.percentile(50), 3),
+                "hedge_timeouts": s.hedge_timeouts,
+                "hedges": s.hedges,
+            }
+
+        stall_points = []
+        for d in deadlines:
+            reps = [stall_run(d) for _ in range(args.repeats)]
+            stall_points.append({
+                "hedge_deadline_ms": d,
+                "stall_s": stall_s,
+                "p99_ms": median_min_max([r["p99_ms"] for r in reps]),
+                "availability": min(r["availability"] for r in reps),
+                "qps": median_min_max([r["qps"] for r in reps]),
+                "hedge_timeouts": max(r["hedge_timeouts"] for r in reps),
+                "runs": reps,
+            })
+        # availability holds at 1.0 everywhere (fallback absorbs), and a
+        # live deadline must actually fire hedges on timeouts
+        assert all(p["availability"] == 1.0 for p in stall_points)
+        armed = [p for p in stall_points if p["hedge_deadline_ms"] > 0
+                 and p["hedge_deadline_ms"] < stall_s * 1e3]
+        assert all(p["hedge_timeouts"] > 0 for p in armed), stall_points
+
+        # (c) hot-set replication uplift vs the skew_table prediction:
+        # warm the router sketch, replicate the measured head, interleaved
+        # median-of-3 off/on saturated runs; the structural claim (head
+        # seeds leave the owner legs) asserts deterministically, the QPS
+        # medians report with spread
+        def repl_run(replicate):
+            dist = build_fleet(router_cache_entries=0,
+                               workload=_WC(topk=256))
+            # sketch warm-up on the SAME trace the measured window
+            # serves (steady-state assumption: the head the sketch saw
+            # is the head the replica will face; zipfian_trace permutes
+            # the node mapping per seed, so a different seed would hand
+            # the replica the wrong head)
+            dist.predict(trace_f, timeout=300)
+            rep_info = None
+            if replicate:
+                rep_info = dist.refresh_replicas(k=args.replicate_k)
+            cov_meas = dist.workload.skew_report(
+                top_ks=(1, 8, args.replicate_k, 64)
+            )["top_coverage"]
+            dist.reset_stats()
+            log_start = len(dist.dispatch_log)
+            chunks = np.array_split(trace_f, args.clients)
+            errors = []
+
+            def client(chunk):
+                try:
+                    dist.predict(chunk, timeout=300)
+                except Exception as exc:
+                    errors.append(repr(exc))
+
+            t0 = time.perf_counter()
+            with dist:
+                threads = [threading.Thread(target=client, args=(c,))
+                           for c in chunks]
+                [t.start() for t in threads]
+                [t.join() for t in threads]
+            wall = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(f"replication clients failed: {errors}")
+            if replicate:
+                # THE structural claim, exact and deterministic: after
+                # the refresh, no owner sub-batch ever carried a
+                # replicated seed — head traffic never reached the
+                # exchange path
+                rep_set = dist.replica.id_set
+                for _, split in dist.dispatch_log[log_start:]:
+                    for h, ids in split:
+                        if h != REPLICA_HOST:
+                            leaked = [i for i in ids if int(i) in rep_set]
+                            assert not leaked, (h, leaked)
+            s = dist.stats
+            owner_seeds = sum(
+                v for h, v in s.sub_batch_seeds.items() if h != REPLICA_HOST
+            )
+            return {
+                "qps": round(trace_f.size / wall, 1),
+                "p99_ms": round(s.latency.percentile(99), 3),
+                "replica_hits": s.replica_hits,
+                "owner_routed_seeds": owner_seeds,
+                "routed_seeds": s.routed_seeds,
+                "coverage": cov_meas,
+                "replica": rep_info,
+            }
+
+        runs_off, runs_on = [], []
+        for _ in range(args.repeats):
+            runs_off.append(repl_run(False))
+            runs_on.append(repl_run(True))
+        qps_off = median_min_max([r["qps"] for r in runs_off])
+        qps_on = median_min_max([r["qps"] for r in runs_on])
+        measured_uplift = qps_on["median"] / qps_off["median"]
+        # the replica actually absorbed traffic (the exact head-seeds-
+        # never-reach-an-owner claim asserted per dispatch-log entry
+        # inside repl_run; REQUEST-grain coverage is the sketch number,
+        # ROUTED-seed share is structurally flatter — router coalescing
+        # collapses the head's repeats into single routed seeds)
+        on = runs_on[-1]
+        head_share = on["replica_hits"] / max(on["routed_seeds"], 1)
+        assert on["replica_hits"] > 0
+        # the skew_table prediction from the SAME measured coverage curve
+        # (wire-term model: exchange seconds saved per routed flush); in
+        # host mode there is no DCN, so report the prediction beside the
+        # measurement rather than asserting equality
+        dispatch_s = 2e-3
+        rep_rows = skew_table(
+            sorted((int(k), float(v)) for k, v in on["coverage"].items()),
+            hosts=HOSTS, bucket=args.max_batch, out_dim=model.out_dim,
+            dispatch_s=dispatch_s, feature_dim=feat.shape[1],
+        )
+        pick = pick_replication_k(rep_rows, min_uplift=1.0)
+        print(format_skew_markdown(rep_rows))
+        repl_leg = {
+            "replicate_k": args.replicate_k,
+            "qps_off": qps_off, "qps_on": qps_on,
+            "qps_runs_off": [r["qps"] for r in runs_off],
+            "qps_runs_on": [r["qps"] for r in runs_on],
+            "measured_uplift_median": round(measured_uplift, 4),
+            "replica_head_share_of_routed": round(head_share, 4),
+            "measured_topk_coverage": on["coverage"],
+            "p99_off_ms": median_min_max([r["p99_ms"] for r in runs_off]),
+            "p99_on_ms": median_min_max([r["p99_ms"] for r in runs_on]),
+            "replica_hits": on["replica_hits"],
+            "skew_table_predicted": [r._asdict() for r in rep_rows],
+            "skew_table_pick": pick._asdict() if pick else None,
+            "note": (
+                "skew_table prices the WIRE term (DCN exchange seconds "
+                "saved); this loopback host-mode box has no wire, so the "
+                "honest read is the structural head-share assert + the "
+                "QPS medians with spread — the predicted uplift is what "
+                "a real pod's exchange would add on top"
+            ),
+        }
+
+        out = {
+            "metric": "serve_probe_faults",
+            "git_revision": git_revision(),
+            "backend": jax.devices()[0].platform,
+            "config": {
+                "hosts": HOSTS, "alpha": alpha,
+                "requests": int(trace_f.size),
+                "max_batch": args.max_batch, "clients": args.clients,
+                "repeats": args.repeats, "exchange": "host",
+            },
+            "note": (
+                "median-of-N with min/max per point (NEXT.md noise "
+                "discipline); parity/availability asserts are in-run — a "
+                "written artifact means they held"
+            ),
+            "owner_kill": kill_leg,
+            "error_isolation_no_target": iso_leg,
+            "hedge_deadline_sweep": stall_points,
+            "replication": repl_leg,
+        }
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return
 
     # -- round-14 disk-tier leg (--tiers -> TIER_r01.json) -------------------
     if args.tiers:

@@ -1,0 +1,520 @@
+"""Round-15 fleet-robustness tests: deterministic fault injection,
+hedged/failover dispatch, owner ejection, per-tenant admission, and the
+bounded stop-drain (quiver_tpu.serve.faults + the round-15 policies in
+serve/dist.py and serve/engine.py).
+
+The acceptance contract (ISSUE 10 / docs/api.md "Fleet serving"):
+
+- with a `FaultInjector` killing an owner mid-flush at hosts=2, every
+  COMPLETED request's logits are bit-identical to the fault-free offline
+  replay (`replay_fleet_oracle` — faults change WHO computes, never any
+  completed bit), errors are per-request (the engine survives), and the
+  hedged re-route path is exercised (hedge counter > 0);
+- the same faulty run replays bit-identically: same outputs, same hedge
+  log, same ejections (faults ride the dispatch index, never wall time);
+- admission (weighted quotas, shedding) is deterministic and logged;
+- `stop(drain=True)` is bounded and reports what it abandoned.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    DistServeConfig,
+    DistServeEngine,
+    DrainTimeout,
+    FaultInjector,
+    FaultSpec,
+    OwnerFault,
+    OwnerKilled,
+    REPLICA_HOST,
+    ServeConfig,
+    ServeEngine,
+    ShedError,
+    replay_fleet_oracle,
+    zipfian_trace,
+)
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+EDGE_INDEX = make_random_graph(N_NODES, 2000, seed=0)
+
+
+def make_full_sampler():
+    return GraphSageSampler(
+        CSRTopo(edge_index=EDGE_INDEX), sizes=SIZES, mode="TPU",
+        seed=SAMPLER_SEED,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    sampler = make_full_sampler()
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_dist(setup, hosts=2, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("cache_entries", 512)
+    cfg_kw.setdefault("exchange", "host")
+    return DistServeEngine.build(
+        model, params, CSRTopo(edge_index=EDGE_INDEX), feat, SIZES,
+        hosts=hosts, config=DistServeConfig(hosts=hosts, **cfg_kw),
+        sampler_seed=SAMPLER_SEED,
+    )
+
+
+def serve_all(dist, trace, tenant=None):
+    """Deterministic sequential drive: submit + flush-on-demand, collect
+    (row | exception) per request — the shape the replay comparisons
+    want (predict() would re-raise the first per-request error)."""
+    handles = [dist.submit(int(n)) if tenant is None
+               else dist.submit(int(n), tenant=tenant) for n in trace]
+    while dist._drainable():
+        dist.flush()
+    out = []
+    for h in handles:
+        try:
+            out.append(h.result(timeout=60))
+        except Exception as exc:
+            out.append(exc)
+    return out
+
+
+# -- the injector itself ------------------------------------------------------
+
+def test_fault_injector_deterministic_plan_and_semantics():
+    inj = FaultInjector([
+        FaultSpec(owner=0, fid=3, kind="kill"),
+        FaultSpec(owner=1, fid=2, kind="error"),
+    ])
+    inj.check(0, 1)
+    inj.check(0, 2)
+    with pytest.raises(OwnerKilled):
+        inj.check(0, 3)
+    with pytest.raises(OwnerKilled):  # kill is permanent from fid on
+        inj.check(0, 7)
+    with pytest.raises(OwnerFault):
+        inj.check(1, 2)
+    inj.check(1, 3)  # error is one-shot: owner recovered
+    assert inj.events() == [(2, 1, "error"), (3, 0, "kill"), (7, 0, "kill")]
+    assert inj.killed_owners() == {0: 3}
+    # seeded plans are reproducible and validated
+    a = FaultInjector.seeded([0, 1], 5, seed=9)
+    b = FaultInjector.seeded([0, 1], 5, seed=9)
+    assert a.faults == b.faults
+    with pytest.raises(ValueError):
+        FaultSpec(owner=0, fid=1, kind="teleport")
+    with pytest.raises(ValueError):
+        FaultSpec(owner=0, fid=1, kind="stall", stall_s=0.0)
+
+
+def test_fault_injector_requires_host_mode(setup):
+    with pytest.raises(ValueError, match="host"):
+        make_dist(setup, exchange="collective",
+                  fault_injector=FaultInjector([]))
+
+
+# -- THE acceptance pin: owner kill mid-flush ---------------------------------
+
+def test_owner_kill_midflush_hedged_replay_parity(setup):
+    """Kill owner 0 at dispatch index 2 with the full-graph fallback up:
+    every request COMPLETES (the hedge absorbs the dead owner), every
+    completed row is bit-identical to the fault-free offline replay of
+    the fleet's dispatch logs, the hedge path is exercised, and the dead
+    owner is ejected — errors never engine-fatal."""
+    model, params, feat = setup
+    inj = FaultInjector([FaultSpec(owner=0, fid=2, kind="kill")])
+    dist = make_dist(setup, fault_injector=inj, full_graph_fallback=True,
+                     eject_after=1, eject_backoff_flushes=8)
+    trace = zipfian_trace(N_NODES, 96, alpha=1.3, seed=7)
+    rows = serve_all(dist, trace)
+    assert not any(isinstance(r, Exception) for r in rows), rows
+    oracle = replay_fleet_oracle(dist, model, params, make_full_sampler, feat)
+    for nid, row in zip(trace, rows):
+        assert any(np.array_equal(row, cand) for cand in oracle[int(nid)]), (
+            f"completed row for node {int(nid)} matches no fault-free "
+            f"replay candidate"
+        )
+    s = dist.stats
+    assert s.hedges > 0 and s.hedged_seeds > 0          # re-route exercised
+    assert s.request_errors == 0                        # fallback absorbed all
+    assert s.owner_ejections >= 1                       # dead owner ejected
+    assert s.hedge_ejected > 0                          # ...and skipped after
+    ev = dist.hedge_events()
+    assert ev and all(owner == 0 for _, owner, _, _ in ev)
+    assert all(target == "fallback" for _, _, _, target in ev)
+    assert inj.events()[0] == (2, 0, "kill")
+    # the fallback actually served (its dispatch log is non-empty)
+    assert len(dist.fallback.dispatch_log) > 0
+
+
+def test_faulty_run_replays_bit_identical(setup):
+    """Determinism: the same trace + the same fault plan, run twice from
+    fresh engines, produce bit-identical outputs, identical hedge logs,
+    and identical owner dispatch logs — faults ride the dispatch index,
+    so replay parity survives them."""
+    trace = zipfian_trace(N_NODES, 40, alpha=1.3, seed=11)
+
+    def run():
+        inj = FaultInjector([
+            FaultSpec(owner=0, fid=2, kind="kill"),
+            FaultSpec(owner=1, fid=3, kind="error"),
+        ])
+        dist = make_dist(setup, fault_injector=inj, full_graph_fallback=True,
+                         eject_after=2, eject_backoff_flushes=4)
+        rows = serve_all(dist, trace)
+        return rows, dist.hedge_events(), inj.events(), dist
+
+    rows_a, hedge_a, fired_a, dist_a = run()
+    rows_b, hedge_b, fired_b, dist_b = run()
+    assert hedge_a == hedge_b and fired_a == fired_b
+    for ra, rb in zip(rows_a, rows_b):
+        assert type(ra) is type(rb)
+        if not isinstance(ra, Exception):
+            assert np.array_equal(ra, rb)
+    for h in dist_a.engines:
+        la, lb = dist_a.engines[h].dispatch_log, dist_b.engines[h].dispatch_log
+        assert len(la) == len(lb)
+        for (pa, na), (pb, nb) in zip(la, lb):
+            assert na == nb and np.array_equal(pa, pb)
+
+
+def test_owner_error_without_target_is_per_request(setup):
+    """No fallback, no replica: a one-shot owner error resolves exactly
+    that sub-batch's requests with the fault and the engine keeps
+    serving — the error-isolation contract under injection."""
+    model, params, feat = setup
+    inj = FaultInjector([FaultSpec(owner=0, fid=1, kind="error")])
+    dist = make_dist(setup, fault_injector=inj, eject_after=99)
+    # flush 1: one seed per owner — owner 0 faults, owner 1 serves
+    h_bad = dist.submit(1)              # owner 0
+    h_ok = dist.submit(N_NODES - 1)     # owner 1
+    assert dist.flush() == 2
+    with pytest.raises(OwnerFault):
+        h_bad.result(timeout=10)
+    ok_row = h_ok.result(timeout=10)
+    assert dist.stats.request_errors == 1
+    assert dist.stats.hedge_failed == 1  # failover wanted, no target
+    # flush 2: owner 0 recovered (one-shot error), the same node serves
+    healed = dist.predict([1])[0]
+    oracle = replay_fleet_oracle(dist, model, params, make_full_sampler, feat)
+    assert any(np.array_equal(healed, c) for c in oracle[1])
+    assert any(np.array_equal(ok_row, c) for c in oracle[N_NODES - 1])
+
+
+def test_stall_fault_trips_hedge_deadline(setup):
+    """A stalled owner misses the hedge deadline; the sub-batch re-routes
+    to the fallback (hedge_timeouts), the stalled leg's late answer is
+    discarded, and every completed row still matches the offline replay.
+    Wall-clock path: pins oracle parity, not cross-run bit-equality of
+    who served."""
+    model, params, feat = setup
+    inj = FaultInjector([FaultSpec(owner=0, fid=1, kind="stall",
+                                   stall_s=1.0)])
+    dist = make_dist(setup, fault_injector=inj, full_graph_fallback=True,
+                     hedge_deadline_ms=100.0)
+    trace = zipfian_trace(N_NODES, 16, alpha=1.1, seed=5)
+    rows = serve_all(dist, trace)
+    assert not any(isinstance(r, Exception) for r in rows)
+    assert dist.stats.hedge_timeouts >= 1
+    oracle = replay_fleet_oracle(dist, model, params, make_full_sampler, feat)
+    for nid, row in zip(trace, rows):
+        assert any(np.array_equal(row, c) for c in oracle[int(nid)])
+    time.sleep(1.0)  # let the abandoned leg finish before teardown
+
+
+def test_ejected_owner_probed_after_backoff(setup):
+    """Flush-indexed backoff: an ejected owner is routed around (no
+    fault fired, hedge_ejected grows) until ``eject_backoff_flushes``
+    dispatch indices pass, then probed again — visible as a new kill
+    firing at a fid >= ejection + backoff."""
+    inj = FaultInjector([FaultSpec(owner=0, fid=1, kind="kill")])
+    dist = make_dist(setup, fault_injector=inj, full_graph_fallback=True,
+                     eject_after=1, eject_backoff_flushes=3, max_batch=4)
+    trace = zipfian_trace(N_NODES, 64, alpha=0.8, seed=13)
+    rows = serve_all(dist, trace)
+    assert not any(isinstance(r, Exception) for r in rows)
+    fired = inj.events()
+    assert fired[0][0] >= 1 and fired[0][1] == 0
+    assert len(fired) >= 2, "owner never re-probed after backoff"
+    assert fired[1][0] >= fired[0][0] + 3  # backoff respected
+    assert dist.stats.hedge_ejected > 0    # routed-around while ejected
+    assert dist.stats.owner_ejections >= 2  # re-ejected after the probe
+
+
+# -- per-tenant admission -----------------------------------------------------
+
+def make_engine(setup, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 4)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    return ServeEngine(model, params, make_full_sampler(), feat,
+                       ServeConfig(**cfg_kw))
+
+
+def test_weighted_flush_quota_deterministic(setup):
+    """Tenant A (weight 3) vs B (weight 1) over an 8-deep overflowing
+    queue at max_batch=4: the drained flush takes 3 A's and 1 B, FIFO
+    within each tenant, in queue order — pinned via the dispatch log."""
+    eng = make_engine(setup, tenant_weights={"A": 3.0, "B": 1.0})
+    real_flush = eng.flush
+    eng.flush = lambda: 0  # defer inline flushes while the queue builds
+    for i in range(6):
+        eng.submit(i, tenant="A")
+    for i in range(10, 16):
+        eng.submit(i, tenant="B")
+    eng.flush = real_flush
+    eng.flush()
+    padded, nvalid = eng.dispatch_log[-1]
+    assert nvalid == 4
+    assert padded[:4].tolist() == [0, 1, 2, 10]  # 3 A's + 1 B, queue order
+    # second flush drains the next weighted batch
+    eng.flush()
+    padded2, nvalid2 = eng.dispatch_log[-1]
+    assert nvalid2 == 4 and padded2[:4].tolist() == [3, 4, 5, 11]
+    while eng._drainable():
+        eng.flush()
+    # per-tenant latency recorded for both tenants
+    snap = eng.stats.snapshot()
+    assert snap["tenant_latency"]["A"]["count"] == 6
+    assert snap["tenant_latency"]["B"]["count"] == 6
+
+
+def test_shed_deterministic_logged_and_per_request(setup):
+    """Queue-depth-bounded shedding: at a full queue a tenant at its
+    weighted quota is refused with a ShedError-carrying handle (never a
+    raise out of submit, never engine-fatal); under-quota tenants still
+    admit. Decisions read only queue state — rerunning the same submit
+    sequence sheds identically — and land in shed_log."""
+    def drive():
+        eng = make_engine(setup, max_queue_depth=4,
+                          tenant_weights={"A": 1.0, "B": 1.0})
+        real_flush = eng.flush
+        eng.flush = lambda: 0
+        handles = [eng.submit(i, tenant="A") for i in range(5)]
+        handles += [eng.submit(10 + i, tenant="B") for i in range(3)]
+        eng.flush = real_flush
+        return eng, handles
+
+    eng, handles = drive()
+    # A0..A3 admitted (queue below depth), A4 shed (A at quota 2 with a
+    # full queue), B0/B1 admitted (under quota), B2 shed
+    assert isinstance(handles[4].error(), ShedError)
+    assert isinstance(handles[7].error(), ShedError)
+    with pytest.raises(ShedError):
+        handles[4].result()
+    admitted = [h for i, h in enumerate(handles) if i not in (4, 7)]
+    assert eng.stats.shed == 2
+    assert [(t, k) for _, t, k in eng.shed_log] == [("A", 4), ("B", 12)]
+    while eng._drainable():
+        eng.flush()
+    for h in admitted:
+        assert h.result(timeout=10) is not None
+    # deterministic: the same sequence sheds the same requests
+    eng2, handles2 = drive()
+    assert [i for i, h in enumerate(handles2)
+            if isinstance(h.error(), ShedError)] == [4, 7]
+    assert eng2.shed_log == eng.shed_log
+    # cache hits never shed: re-ask a served node at a full queue
+    eng.submit(0, tenant="A")
+    assert eng.stats.shed == 2
+
+
+def test_tenant_qos_off_is_byte_identical(setup):
+    """tenant_weights=None + max_queue_depth=0 (the defaults) must be the
+    pre-round-15 engine bit for bit — same served rows, same dispatch
+    log — even when callers pass tenant names."""
+    model, params, feat = setup
+    trace = zipfian_trace(N_NODES, 40, alpha=1.1, seed=7)
+    ref = make_engine(setup, max_batch=8, cache_entries=512)
+    out_ref = ref.predict(trace)
+    eng = make_engine(setup, max_batch=8, cache_entries=512)
+    handles = [eng.submit(int(n), tenant="T" if i % 2 else None)
+               for i, n in enumerate(trace)]
+    while eng._drainable():
+        eng.flush()
+    out = np.stack([h.result(timeout=60) for h in handles])
+    assert np.array_equal(out_ref, out)
+    assert len(ref.dispatch_log) == len(eng.dispatch_log)
+    for (pa, na), (pb, nb) in zip(ref.dispatch_log, eng.dispatch_log):
+        assert na == nb and np.array_equal(pa, pb)
+    # both tenants' tails are tracked separately
+    assert set(eng.stats.tenant_latency) == {"default", "T"}
+
+
+def test_router_tenant_admission_and_p99(setup):
+    """The router mirrors the engine's admission: weighted shed at the
+    router queue, per-tenant latency in DistServeStats, and the tenant
+    family in the fleet registry exposition."""
+    dist = make_dist(setup, max_queue_depth=4,
+                     tenant_weights={"gold": 3.0, "free": 1.0})
+    real_flush = dist.flush
+    dist.flush = lambda: 0
+    handles = [dist.submit(i, tenant="free") for i in range(5)]
+    dist.flush = real_flush
+    # free holds the whole full queue -> over its quota (1/4 share)
+    assert isinstance(handles[-1].error(), ShedError)
+    assert dist.stats.shed == 1 and dist.shed_log[0][1] == "free"
+    gold = dist.submit(100, tenant="gold")  # under quota: admitted
+    assert gold.error() is None
+    while dist._drainable():
+        dist.flush()
+    snap = dist.stats.snapshot()
+    assert snap["tenant_latency"]["free"]["count"] == 4
+    assert snap["tenant_latency"]["gold"]["count"] == 1
+    assert snap["tenant_latency"]["gold"]["p99_ms"] >= 0.0
+    text = dist.fleet_registry().to_prometheus()
+    assert 'quiver_router_tenant_latency_ms' in text
+    assert 'tenant="gold"' in text and 'tenant="free"' in text
+    assert "quiver_router_shed_total 1" in text
+
+
+# -- bounded stop drain -------------------------------------------------------
+
+def test_stop_bounded_drain_reports_undrained(setup):
+    """A wedged owner (blocks forever) must not hang stop(drain=True):
+    the drain gives up at drain_deadline_s, abandoned slots resolve with
+    DrainTimeout (waiters unblock), and stats.undrained reports them in
+    the snapshot."""
+    dist = make_dist(setup, drain_deadline_s=0.6)
+    release = threading.Event()
+    orig = dist.engines[0].predict
+
+    def wedged(ids, timeout=None):
+        release.wait(20)
+        return orig(ids)
+
+    dist.engines[0].predict = wedged
+    h = dist.submit(1)  # owned by the wedged shard 0
+    t = threading.Thread(target=dist.flush, daemon=True)
+    t.start()
+    time.sleep(0.1)  # let the flush reach the wedged dispatch
+    t0 = time.monotonic()
+    dist.stop(drain=True)
+    assert time.monotonic() - t0 < 5.0, "stop hung past the drain bound"
+    assert dist.stats.undrained >= 1
+    assert dist.aggregate_stats()["router"]["undrained"] >= 1
+    with pytest.raises(DrainTimeout):
+        h.result(timeout=1)
+    release.set()
+    t.join(timeout=30)
+
+
+def test_stop_bounded_drain_single_host(setup):
+    """Same bound on the single-host engine: a dead poller mid-flush
+    (simulated by a wedged dispatch) cannot hang stop()."""
+    eng = make_engine(setup, drain_deadline_s=0.5, max_batch=2)
+    release = threading.Event()
+    orig_dispatch = eng._dispatch
+
+    def wedged(fl):
+        release.wait(20)
+        return orig_dispatch(fl)
+
+    eng._dispatch = wedged
+    h = eng.submit(3)
+    t = threading.Thread(target=eng.flush, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    eng.stop(drain=True)
+    assert eng.stats.undrained >= 1
+    with pytest.raises(DrainTimeout):
+        h.result(timeout=1)
+    release.set()
+    t.join(timeout=30)
+
+
+def test_shed_decision_all_zero_weights_no_crash():
+    """Weight 0.0 is the natural 'block this tenant' spelling: an
+    all-zero weight map must degrade to the plain depth bound (1-slot
+    floor), never divide by zero inside submit()."""
+    from quiver_tpu.serve.engine import shed_decision
+
+    assert shed_decision(4, 2, "a", 4, {"a": 0.0, "b": 0.0}) is True
+    assert shed_decision(4, 0, "a", 4, {"a": 0.0, "b": 0.0}) is False
+    assert shed_decision(3, 2, "a", 4, {"a": 0.0}) is False  # queue not full
+
+
+def test_post_stop_submit_never_coalesces_onto_abandoned_slot(setup):
+    """After a bounded drain abandons a slot, a fresh submit of the same
+    node must get a NEW computation, not the stale DrainTimeout — and
+    the wedged flush's late completion must not overwrite the delivered
+    error (resolve-once)."""
+    eng = make_engine(setup, drain_deadline_s=0.5, max_batch=2)
+    release = threading.Event()
+    orig_dispatch = eng._dispatch
+
+    def wedged(fl):
+        release.wait(20)
+        return orig_dispatch(fl)
+
+    eng._dispatch = wedged
+    h = eng.submit(3)
+    t = threading.Thread(target=eng.flush, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    eng.stop(drain=True)
+    with pytest.raises(DrainTimeout):
+        h.result(timeout=1)
+    eng._dispatch = orig_dispatch
+    release.set()
+    t.join(timeout=30)
+    # the late flush completed after the abandon: the handle KEEPS its
+    # DrainTimeout (no silent overwrite), and a fresh submit computes
+    with pytest.raises(DrainTimeout):
+        h.result(timeout=1)
+    row = eng.predict([3])[0]
+    assert row is not None and not isinstance(row, Exception)
+    assert eng.stats.undrained == 1
+
+
+def test_ejection_without_failover_target_still_attempts_owner(setup):
+    """Availability guard: with NO fallback and NO replica, honoring an
+    ejection would convert the owner's traffic into guaranteed errors
+    for the whole backoff window. Instead the owner is attempted — a
+    recovered owner serves immediately after its transient faults."""
+    inj = FaultInjector([
+        FaultSpec(owner=0, fid=1, kind="error"),
+        FaultSpec(owner=0, fid=2, kind="error"),
+    ])
+    dist = make_dist(setup, fault_injector=inj, eject_after=2,
+                     eject_backoff_flushes=64, max_batch=4)
+    # flushes 1+2: owner 0 faults twice -> its requests error per-request
+    # and the state machine marks it ejected
+    for fid in (1, 2):
+        h_bad = dist.submit(fid)           # owner 0 nodes
+        h_ok = dist.submit(N_NODES - fid)  # owner 1 nodes
+        dist.flush()
+        with pytest.raises(OwnerFault):
+            h_bad.result(timeout=10)
+        assert h_ok.result(timeout=10) is not None
+    assert dist.stats.owner_ejections == 1
+    # flush 3 (well inside the backoff window): no failover target ->
+    # the recovered owner is ATTEMPTED and serves
+    row = dist.predict([3])[0]
+    assert row is not None
+    assert dist.stats.hedge_ejected == 0  # nothing was routed around

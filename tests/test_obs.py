@@ -511,3 +511,67 @@ def test_export_merges_multiple_sources_one_clock(tmp_path):
         if e["ph"] == "M" and e["name"] == "process_name"
     }
     assert procs == {"comm", "jr"}
+
+
+# -- round-15 fleet-policy metrics exposition ---------------------------------
+
+
+def test_fleet_policy_metrics_exposition_format(setup):
+    """Satellite pin (ISSUE 10): `tier_adapt_errors` and the round-15
+    hedge/ejection/shed/replica counters are real Prometheus families in
+    `register_metrics` / `fleet_registry` — typed, help'd, and carrying
+    live values — and the per-tenant latency family is a labeled
+    histogram."""
+    from quiver_tpu import CSRTopo as _CSR
+    from quiver_tpu.serve import DistServeConfig, DistServeEngine
+
+    model, params, feat = setup
+    dist = DistServeEngine.build(
+        model, params,
+        _CSR(edge_index=make_random_graph(N_NODES, 2000, seed=0)),
+        feat, SIZES, hosts=2,
+        config=DistServeConfig(
+            hosts=2, max_batch=8, max_delay_ms=1e9, exchange="host",
+            tenant_weights={"gold": 3.0, "free": 1.0}, max_queue_depth=64,
+        ),
+        sampler_seed=SAMPLER_SEED,
+    )
+    dist.predict([3], )  # default tenant
+    h = dist.submit(7, tenant="gold")
+    dist.flush()
+    h.result(timeout=30)
+    text = dist.fleet_registry().to_prometheus()
+    lines = text.splitlines()
+    # counters: typed, named per the quiver_<subsystem>_<metric>_total rule
+    for fam in ("hedges", "hedged_seeds", "hedge_timeouts", "hedge_errors",
+                "hedge_ejected", "hedge_failed", "owner_ejections",
+                "replica_hits", "shed", "request_errors", "undrained"):
+        assert f"# TYPE quiver_router_{fam}_total counter" in lines, fam
+        assert any(l.startswith(f"quiver_router_{fam}_total ")
+                   for l in lines), fam
+    # gauges: ejection occupancy + replica state + tier_adapt_errors at
+    # BOTH grains (router + per-owner engines)
+    for g in ("owners_ejected", "replica_version", "replica_rows",
+              "tier_adapt_errors"):
+        assert f"# TYPE quiver_router_{g} gauge" in lines, g
+    assert "quiver_router_owners_ejected 0" in lines
+    assert "quiver_router_tier_adapt_errors 0" in lines
+    assert '# TYPE quiver_serve_tier_adapt_errors gauge' in lines
+    assert 'quiver_serve_tier_adapt_errors{host="0"} 0' in lines
+    # engine-grain round-15 counters ride the host label too
+    assert 'quiver_serve_shed_total{host="0"} 0' in lines
+    assert 'quiver_serve_undrained_total{host="1"} 0' in lines
+    # the per-tenant latency family is a labeled histogram with samples
+    assert "# TYPE quiver_router_tenant_latency_ms histogram" in lines
+    assert any(l.startswith('quiver_router_tenant_latency_ms_count{')
+               and 'tenant="gold"' in l for l in lines)
+    gold_count = [
+        l for l in lines
+        if l.startswith("quiver_router_tenant_latency_ms_count")
+        and 'tenant="gold"' in l
+    ]
+    assert gold_count and gold_count[0].endswith(" 1")
+    # snapshot view agrees with the exposition
+    snap = dist.fleet_registry().snapshot()
+    assert snap["quiver_router_hedges_total"] == 0
+    assert snap["quiver_router_replica_rows"] == 0

@@ -304,3 +304,24 @@ def test_median_min_max():
     assert median_min_max([7])["median"] == 7.0
     with pytest.raises(ValueError):
         median_min_max([])
+
+
+def test_pick_replication_k_smallest_qualifying_row():
+    from quiver_tpu.parallel.scaling import pick_replication_k, skew_table
+
+    rows = skew_table(
+        [(1, 0.2), (8, 0.5), (64, 0.9)], hosts=2, bucket=64, out_dim=8,
+        dispatch_s=1e-3, feature_dim=100,
+        bandwidths={"dcn_bytes_per_s": 1e8},  # slow wire: uplift is real
+    )
+    pick = pick_replication_k(rows, min_uplift=1.0)
+    assert pick is not None
+    # smallest k whose uplift clears the bar, not the biggest uplift
+    qualifying = [r for r in rows if r.qps_uplift > 1.0]
+    assert pick.top_k == min(r.top_k for r in qualifying)
+    # a byte budget below every row's replica cost finds nothing
+    assert pick_replication_k(rows, replica_budget_bytes=1.0) is None
+    # hosts=1 rows (no exchange to avoid) never qualify
+    rows1 = skew_table([(8, 0.5)], hosts=1, bucket=64, out_dim=8,
+                       dispatch_s=1e-3)
+    assert pick_replication_k(rows1) is None

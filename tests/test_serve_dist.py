@@ -34,13 +34,17 @@ from quiver_tpu.pyg.sage_sampler import GraphSageSampler
 from quiver_tpu.serve import (
     DistServeConfig,
     DistServeEngine,
+    REPLICA_HOST,
     ServeConfig,
     ServeEngine,
     contiguous_partition,
+    replay_fleet_oracle,
     replay_shard_oracle,
     shard_topology_by_owner,
+    shard_topology_for_seeds,
     zipfian_trace,
 )
+from quiver_tpu.trace import WorkloadConfig
 
 N_NODES = 200
 DIM = 16
@@ -403,19 +407,145 @@ def test_fleet_observability_merges_deterministically(setup):
     assert {"router.journal", "owner0.journal", "owner1.journal"} <= procs
 
 
-def test_flush_error_resolves_waiters_and_reraises(setup):
+def test_shard_topology_for_seeds_matches_full_rows():
+    """The replica's closure topology keeps the seed set's rows
+    bit-identical to the full graph (the parity precondition) and zeroes
+    everything unreachable."""
+    topo = CSRTopo(edge_index=EDGE_INDEX)
+    seeds = np.array([3, 17, 40], np.int64)
+    shard, st, closure = shard_topology_for_seeds(topo, seeds, hops=1)
+    assert st["owned_nodes"] == 3
+    assert st["closure_nodes"] >= 3 and st["edge_frac"] <= 1.0
+    for u in seeds:
+        np.testing.assert_array_equal(
+            shard.indices[shard.indptr[u]:shard.indptr[u + 1]],
+            topo.indices[topo.indptr[u]:topo.indptr[u + 1]],
+        )
+    assert set(seeds.tolist()) <= set(closure.tolist())
+    with pytest.raises(ValueError):
+        shard_topology_for_seeds(topo, np.array([N_NODES + 5]), hops=1)
+
+
+# -- hot-set replication (round 15, ROADMAP item 3a) --------------------------
+
+def test_hot_set_replication_serves_head_locally(setup):
+    """After `refresh_replicas`, replicated seeds are answered by the
+    LOCAL replica: replica_hits counts them, the serve exchange moves no
+    new bytes for all-replica flushes, and every replica-served row still
+    bit-matches the offline full-graph replay (`replay_fleet_oracle`)."""
+    model, params, feat = setup
+    # router result cache OFF so repeat requests actually route (the
+    # replication claim is about routing, not caching)
+    dist = make_dist(setup, hosts=2, router_cache_entries=0,
+                     workload=WorkloadConfig(topk=64))
+    trace = zipfian_trace(N_NODES, 60, alpha=1.3, seed=9)
+    dist.predict(trace)  # warm the router's frequency sketch
+    rep = dist.refresh_replicas(k=8)  # head picked FROM the sketch
+    assert rep["replicated"] == 8 and dist.replica is not None
+    head = dist.replica.ids
+    # the sketch-picked head is the measured head: it covers the trace's
+    # hottest nodes (exact counts agree on this deterministic trace)
+    keys, counts = np.unique(trace, return_counts=True)
+    exact_head = set(keys[np.lexsort((keys, -counts))][:8].tolist())
+    assert len(exact_head & set(head.tolist())) >= 6
+    bytes0 = dist.stats.exchange_id_bytes
+    out = dist.predict(head)  # all-replica flush
+    assert dist.stats.replica_hits == head.size
+    assert dist.stats.exchange_id_bytes == bytes0  # nothing rode the wire
+    log_hosts = [h for h, _ in dist.dispatch_log[-1][1]]
+    assert log_hosts == [REPLICA_HOST]
+    oracle = replay_fleet_oracle(dist, model, params, make_full_sampler, feat)
+    for nid, row in zip(head, out):
+        assert any(np.array_equal(row, c) for c in oracle[int(nid)])
+    # mixed flush: head + tail seeds split between replica and owners
+    tail = [int(k) for k in keys if int(k) not in dist.replica.id_set][:4]
+    out2 = dist.predict(np.concatenate([head[:2], tail]))
+    oracle = replay_fleet_oracle(dist, model, params, make_full_sampler, feat)
+    for nid, row in zip(list(head[:2]) + tail, out2):
+        assert any(np.array_equal(row, c) for c in oracle[int(nid)])
+
+
+def test_replica_cache_single_entry_and_exact_invalidation(setup):
+    """Satellite pin: a seed answered by its OWNER and later by the
+    REPLICA holds exactly ONE router-cache entry (keyed by node), and a
+    replica refresh invalidates EXACTLY the refreshed keys (old set union
+    new set) — every other entry survives."""
+    dist = make_dist(setup, hosts=2)
+    a, b = 5, N_NODES - 3  # different owners; a will be replicated
+    dist.predict([a, b])   # owner-served, both cached at the router
+    assert dist.cache.entry_version(a) == 0
+    assert dist.cache.entry_version(b) == 0
+    res = dist.refresh_replicas(ids=[a])
+    assert res["invalidated"] == 1             # exactly the refreshed key
+    assert dist.cache.entry_version(a) is None  # a dropped...
+    assert dist.cache.entry_version(b) == 0     # ...b untouched
+    routed0 = dist.stats.routed_seeds
+    dist.predict([a])  # now replica-served (the stale entry is gone)
+    assert dist.stats.replica_hits == 1
+    assert dist.stats.routed_seeds == routed0 + 1
+    keys = dist.cache.keys()
+    assert keys.count(a) == 1, "owner- and replica-served rows must share one entry"
+    # refresh to empty: invalidates exactly the OLD replica set {a}
+    res2 = dist.refresh_replicas(ids=[])
+    assert dist.replica is None and res2["invalidated"] == 1
+    assert dist.cache.entry_version(a) is None
+    assert dist.cache.entry_version(b) == 0
+    # replica retirement keeps the oracle complete for already-served rows
+    model, params, feat = setup
+    assert a in replay_fleet_oracle(dist, model, params, make_full_sampler,
+                                    feat)
+
+
+def test_refresh_replicas_fenced_and_versioned(setup):
+    """Replica swaps ride the update_params fence: versions bump, pending
+    work drains first, and update_params reaches the replica engine too
+    (its served rows never cross a weight update)."""
+    model, params, feat = setup
+    dist = make_dist(setup, hosts=2)
+    dist.refresh_replicas(ids=[1, 2, 3])
+    assert dist.replica_version == 1
+    v0 = dist.predict([1])[0]
+    assert dist.stats.replica_hits == 1
+    params2 = jax.tree_util.tree_map(lambda a: a + 0.25, params)
+    dist.update_params(params2)
+    assert dist.replica.engine.params_version == 1
+    v1 = dist.predict([1])[0]
+    assert not np.array_equal(v0, v1)  # replica serves the NEW weights
+    with pytest.raises(ValueError):
+        dist.refresh_replicas()  # no workload sketch and no ids given
+
+
+def test_owner_error_is_per_request_and_engine_survives(setup):
+    """The round-15 error-isolation contract (explicit, not accidental):
+    a failing owner sub-batch resolves ONLY its own slots' ServeResults
+    with the exception — co-flushed seeds of healthy owners resolve
+    normally, `flush()` does not re-raise, and the engine keeps serving
+    subsequent requests (the poisoned flush is not engine-fatal)."""
+    model, params, feat = setup
     dist = make_dist(setup, hosts=2, exchange="host")
 
     class Boom(RuntimeError):
         pass
 
+    orig = dist.engines[0].predict
+
     def broken(_ids, timeout=None):
         raise Boom("shard down")
 
     dist.engines[0].predict = broken
-    h = dist.submit(1)  # node 1 is owned by shard 0
+    h_bad = dist.submit(1)            # node 1 is owned by shard 0
+    h_ok = dist.submit(N_NODES - 1)   # owned by shard 1 — same flush
+    assert dist.flush() == 2          # does NOT raise: errors are per-request
     with pytest.raises(Boom):
-        dist.flush()
-    with pytest.raises(Boom):
-        h.result(timeout=1)
+        h_bad.result(timeout=1)
+    assert isinstance(h_ok.error(), type(None))
+    row_ok = h_ok.result(timeout=1)
+    assert row_ok is not None and dist.stats.request_errors == 1
     assert not dist._drainable() and not dist._inflight
+    # the poisoned flush left the engine serving: heal the owner and the
+    # SAME node computes fine on the next flush
+    dist.engines[0].predict = orig
+    row_healed = dist.predict([1])[0]
+    oracle = replay_shard_oracle(dist, model, params, make_full_sampler, feat)
+    assert np.array_equal(row_healed, oracle[1])
+    assert np.array_equal(row_ok, oracle[N_NODES - 1])
