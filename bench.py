@@ -1226,6 +1226,58 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
         context["serve_hedge_error"] = repr(exc)
         log(f"serve hedge bench failed: {exc}")
 
+    # elastic fleet (round 16, ISSUE 11): the cost of LIVE resharding on
+    # the bench graph — wall per bounded migration batch for a 1->2 scale
+    # (closure BFS + feature materialization + AOT warmup + the fenced
+    # flip; the fence itself holds only for the flip), and in-run oracle
+    # parity of a wave served right after the ramp
+    try:
+        from quiver_tpu.serve import (
+            DistServeConfig, DistServeEngine, replay_fleet_oracle,
+        )
+
+        dist = DistServeEngine.build(
+            model, params, topo, table, [15, 10, 5], hosts=1,
+            config=DistServeConfig(
+                hosts=1, max_batch=64, max_delay_ms=2.0, exchange="host",
+                record_dispatches=True,
+                migrate_batch_seeds=max(n_nodes // 4, 1),
+                shard_config=ServeConfig(
+                    max_batch=64, buckets=(64,), max_delay_ms=2.0,
+                    record_dispatches=True,
+                ),
+            ),
+            sampler_seed=11, sampler_kw={"caps": caps},
+        )
+        dist.warmup()
+        dist.reset_stats()
+        t0 = time.time()
+        summary = dist.scale(2)
+        wall = time.time() - t0
+        n_dist = min(n_requests, 96)
+        trace = zipfian_trace(n_nodes, n_dist, alpha=0.99, seed=19)
+        out = dist.predict(trace)
+        oracle = replay_fleet_oracle(dist, model, params, make_sampler, table)
+        parity = all(
+            any(np.array_equal(out[i], c) for c in oracle[int(nid)])
+            for i, nid in enumerate(trace)
+        )
+        context["serve_migrate_batches"] = summary["batches"]
+        context["serve_migrate_batch_s"] = round(
+            wall / max(summary["batches"], 1), 6
+        )
+        context["serve_scale_parity"] = parity
+        log(
+            f"serve scale 1->2: {summary['batches']} migration batches, "
+            f"{context['serve_migrate_batch_s']:.3f} s/batch "
+            f"(build outside the fence), parity={parity}"
+        )
+        if not parity:
+            log("serve scale PARITY VIOLATION — investigate before trusting r16")
+    except Exception as exc:
+        context["serve_scale_error"] = repr(exc)
+        log(f"serve scale bench failed: {exc}")
+
 
 def wait_for_backend(max_wait_s=None):
     """The axon tunnel can be down for stretches (observed: hours). Probe

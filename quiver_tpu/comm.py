@@ -255,6 +255,7 @@ def exchange_serve_all(
     requests: np.ndarray,
     answer_fn,
     out_dim: int,
+    tenant_requests: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Serve-shaped exchange, single-controller surface: ship SEED IDS to
     their owners, run each owner's host-side compute, ship LOGITS back.
@@ -272,6 +273,18 @@ def exchange_serve_all(
     Both collectives are the exact halves of the `_exchange_jit` program,
     so the wire bytes `scaling.serve_table(hosts=...)` prices are the bytes
     this actually moves: ``H*H*L*4`` ids out, ``H*H*L*out_dim*4`` back.
+
+    ``tenant_requests`` (round 16, optional) is a same-shape int32 array
+    of TENANT INDICES aligned lane-for-lane with ``requests`` (-1 = the
+    default tenant; the caller owns the index<->name registry, e.g.
+    sorted ``tenant_weights`` keys every host agrees on). When given, it
+    rides a second launch of the SAME id all_to_all (arrays stay jit
+    ARGUMENTS, never closure constants — the NEXT.md rule — and both
+    launches sit under `_SC_COLLECTIVE_LOCK` with the rest of this
+    exchange) and lands at each owner as a third ``answer_fn`` argument,
+    so owner engines can apply the submitting tenants' flush quotas
+    end-to-end. When None, the wire and the answerer call are
+    byte-identical to round 15.
     """
     h = mesh.shape[axis]
     with _SC_COLLECTIVE_LOCK:
@@ -280,11 +293,29 @@ def exchange_serve_all(
         )
         assert req.shape[0] == h
         recv = np.asarray(_a2a_ids_jit(req, mesh=mesh, axis=axis))
+        recv_tenants = None
+        if tenant_requests is not None:
+            if tenant_requests.shape != requests.shape:
+                raise ValueError(
+                    f"tenant_requests {tenant_requests.shape} must match "
+                    f"requests {requests.shape}"
+                )
+            treq = jax.device_put(
+                jnp.asarray(np.asarray(tenant_requests, np.int32)),
+                NamedSharding(mesh, P(axis)),
+            )
+            recv_tenants = np.asarray(_a2a_ids_jit(treq, mesh=mesh, axis=axis))
         L = recv.shape[2]
         rows = np.zeros((h, h, L, out_dim), np.float32)
         for host in range(h):
             try:
-                ans = np.asarray(answer_fn(host, recv[host]), np.float32)
+                if recv_tenants is None:
+                    ans = np.asarray(answer_fn(host, recv[host]), np.float32)
+                else:
+                    ans = np.asarray(
+                        answer_fn(host, recv[host], recv_tenants[host]),
+                        np.float32,
+                    )
             except OwnerAnswerError:
                 raise
             except Exception as exc:
@@ -507,6 +538,7 @@ class TpuComm:
         host2ids: Sequence[np.ndarray],
         out_dim: int,
         budget: Optional[int] = None,
+        host2tenants: Optional[Sequence[Sequence[int]]] = None,
     ) -> List[Optional[np.ndarray]]:
         """Serve-shaped collective: ship per-owner SEED-ID lists out, run
         each owner's registered answerer (its local serve engine), get
@@ -518,6 +550,17 @@ class TpuComm:
 
         Returns one ``[len(ids), out_dim]`` float32 array per owner (None
         where no ids were requested), aligned with ``host2ids`` order.
+
+        ``host2tenants`` (round 16, optional) carries per-seed TENANT
+        INDICES aligned with ``host2ids`` (int, -1 = default tenant); they
+        ride a second launch of the id all_to_all and reach each owner's
+        answerer as a third argument (see `exchange_serve_all`) so owner
+        engines can hold the submitting tenants' quotas end-to-end.
+        Answerers registered for a tenant-shipping exchange must accept
+        ``fn(recv_ids, recv_tenants)``. Single-controller mode only for
+        now — the multi-process path drops the tenant payload (owner
+        quotas degrade to router-admission-only, the round-15
+        semantics).
         """
         rec = EXCHANGE_SPANS
         t_span0 = _EXCHANGE_CLOCK() if rec is not None else 0.0
@@ -543,6 +586,12 @@ class TpuComm:
             req_mine[0, j, : ids.shape[0]] = ids
         answerers = getattr(self, "_serve_answerers", None) or {}
         if jax.process_count() > 1:
+            # the multi-process path predates owner-side tenant
+            # scheduling: DROP the tenant payload rather than failing
+            # every flush — quotas then hold at router admission only
+            # (the round-15 semantics), which is a degradation, not an
+            # outage
+            host2tenants = None
             if self.host not in answerers:
                 raise RuntimeError(
                     "register_serve_answerer(self.host, fn) must be called "
@@ -579,10 +628,26 @@ class TpuComm:
                 )
             req = np.full((h, h, budget), ID_PAD, np.int64)
             req[self.host] = req_mine[0]
-            out = exchange_serve_all(
-                self.mesh, self.axis, req,
-                lambda host, recv_ids: answerers[host](recv_ids), out_dim,
-            )
+            treq = None
+            if host2tenants is not None:
+                treq = np.full((h, h, budget), -1, np.int32)
+                for j, tens in enumerate(host2tenants):
+                    if tens is None:
+                        continue
+                    tens = np.asarray(tens, np.int32)
+                    treq[self.host, j, : tens.shape[0]] = tens
+                out = exchange_serve_all(
+                    self.mesh, self.axis, req,
+                    lambda host, recv_ids, recv_tenants: answerers[host](
+                        recv_ids, recv_tenants
+                    ),
+                    out_dim, tenant_requests=treq,
+                )
+            else:
+                out = exchange_serve_all(
+                    self.mesh, self.axis, req,
+                    lambda host, recv_ids: answerers[host](recv_ids), out_dim,
+                )
             mine = out[self.host]
         res: List[Optional[np.ndarray]] = []
         for j, ids in enumerate(host2ids):

@@ -596,6 +596,22 @@ class WorkloadMonitor:
         ids = [kk for kk, _ in self.promotion_candidates(limit=None)[:int(k)]]
         return np.sort(np.asarray(ids, np.int64))
 
+    def hot_set_drift(self, ids, k: int) -> float:
+        """Fraction of the CURRENT ``k``-hot head absent from ``ids`` —
+        the round-16 drift trigger for the background replica refresh
+        (`DistServeConfig.replica_refresh_every_s`): when the sketch's
+        head has drifted past ``replica_drift_frac`` away from what the
+        live replica holds, a refresh is worth its rebuild cost; while
+        the head is stable, the timer skips it. 0.0 = the whole current
+        head is covered (also when the sketch has tracked nothing yet —
+        no evidence is never a reason to churn the replica); 1.0 = the
+        head moved entirely."""
+        hot = self.hot_set(k)
+        if hot.size == 0:
+            return 0.0
+        ids = np.asarray(ids, np.int64)
+        return float(1.0 - np.isin(hot, ids).mean())
+
     def skew_report(
         self,
         capacities: Sequence[int] = (),

@@ -795,6 +795,161 @@ def pick_replication_k(
     return best
 
 
+class FleetPrediction(NamedTuple):
+    action: str                # "baseline" | "replicate top-k" | "add host"
+    hosts: int                 # fleet size under this action
+    top_k: int                 # replicated head size (0 for host actions)
+    dispatch_s: float          # per-owner shard dispatch at this size
+    exchange_s: float          # serve-exchange wire time per routed flush
+    routed_flush_s: float      # dispatch + exchange
+    agg_qps: float             # bucket / routed_flush_s
+    qps_uplift: float          # vs the baseline row
+    added_bytes_per_host: float  # replica rows, or the new host's shard
+
+
+def fleet_table(
+    coverage: Sequence[Tuple[int, float]],
+    hosts: int,
+    bucket: int,
+    out_dim: int,
+    dispatch_s: float,
+    table_rows: int,
+    feature_dim: int = 100,
+    add_hosts: Sequence[int] = (1, 2),
+    feature_bytes_per_elem: float = 4.0,
+    bandwidths: Optional[Dict[str, float]] = None,
+) -> List[FleetPrediction]:
+    """Price ADD-A-HOST against REPLICATE-THE-HEAD on one table — the
+    round-16 elastic-fleet planning face (`DistServeEngine.scale` vs
+    `refresh_replicas`), from the same measured inputs the round-13/15
+    models ride: the sketch's head-concentration ``coverage`` [(k, frac)]
+    and the measured per-owner ``dispatch_s`` at the CURRENT ``hosts``
+    (bench.py ``serve_fused_step_s`` scaled, or the probe's in-run
+    timing).
+
+    Replication rows reuse `skew_table`'s wire model exactly (device
+    work unchanged, exchange term shrinks with the head share; cost = k
+    feature rows ON EVERY host). Add-host rows scale the per-owner
+    dispatch with the sub-batch width (``ceil(bucket/H')`` vs
+    ``ceil(bucket/H)`` — row-count-bound regime, PERF_NOTES.md) and
+    re-price the exchange at the larger ``H'^2 * L`` payload (the
+    all_to_all grows quadratically in hosts — adding hosts buys device
+    width but PAYS wire); cost = the new host's resident shard,
+    ``table_rows/H'`` feature rows (closure halo excluded — label it
+    when the partition isn't k-hop closed). The two costs land in one
+    ``added_bytes_per_host`` column so `pick_fleet_action` can choose
+    the cheapest uplift within a byte budget. Replication attacks the
+    wire and the head; a host attacks device width and capacity — at
+    high skew the table shows replication winning long before a host
+    pays for itself, which is the round-15 measured story."""
+    if hosts < 1:
+        raise ValueError("hosts must be >= 1")
+    bw = dict(DEFAULT_BANDWIDTHS)
+    if bandwidths:
+        bw.update(bandwidths)
+
+    def pow2(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    def exchange_s_at(h: int, lanes: int) -> float:
+        if h == 1 or lanes == 0:
+            return 0.0
+        return h * h * lanes * (4 + 4 * out_dim) / bw["dcn_bytes_per_s"]
+
+    base_width = max(-(-bucket // hosts), 1)
+    base_x = exchange_s_at(hosts, pow2(bucket))
+    base_t = dispatch_s + base_x
+    rows = [FleetPrediction(
+        action="baseline", hosts=hosts, top_k=0, dispatch_s=dispatch_s,
+        exchange_s=base_x, routed_flush_s=base_t,
+        agg_qps=bucket / base_t if base_t > 0 else 0.0,
+        qps_uplift=1.0, added_bytes_per_host=0.0,
+    )]
+    for k, frac in coverage:
+        frac = min(max(float(frac), 0.0), 1.0)
+        routed = max(int(math.ceil((1.0 - frac) * bucket)), 0)
+        x_s = exchange_s_at(hosts, pow2(routed) if routed else 0)
+        t = dispatch_s + x_s
+        rows.append(FleetPrediction(
+            action="replicate top-k", hosts=hosts, top_k=int(k),
+            dispatch_s=dispatch_s, exchange_s=x_s, routed_flush_s=t,
+            agg_qps=bucket / t if t > 0 else 0.0,
+            qps_uplift=base_t / t if t > 0 else 1.0,
+            added_bytes_per_host=(
+                float(k) * feature_dim * feature_bytes_per_elem
+            ),
+        ))
+    for dh in add_hosts:
+        h2 = hosts + int(dh)
+        if h2 <= hosts:
+            continue
+        width2 = max(-(-bucket // h2), 1)
+        d_s = dispatch_s * width2 / base_width
+        x_s = exchange_s_at(h2, pow2(bucket))
+        t = d_s + x_s
+        rows.append(FleetPrediction(
+            action="add host", hosts=h2, top_k=0, dispatch_s=d_s,
+            exchange_s=x_s, routed_flush_s=t,
+            agg_qps=bucket / t if t > 0 else 0.0,
+            qps_uplift=base_t / t if t > 0 else 1.0,
+            added_bytes_per_host=(
+                float(table_rows) / h2 * feature_dim
+                * feature_bytes_per_elem
+            ),
+        ))
+    return rows
+
+
+def pick_fleet_action(
+    rows: Sequence[FleetPrediction],
+    min_uplift: float = 1.0,
+    budget_bytes_per_host: Optional[float] = None,
+) -> Optional[FleetPrediction]:
+    """The cheapest `fleet_table` row whose predicted uplift strictly
+    beats ``min_uplift`` within the per-host byte budget (None =
+    unbounded): rows sort by added bytes, first qualifying wins — the
+    same shape as `pick_replication_k`, now choosing BETWEEN replication
+    and a new host. None = nothing qualifies; keep the fleet as is."""
+    best: Optional[FleetPrediction] = None
+    for r in sorted(rows, key=lambda r: (r.added_bytes_per_host, r.hosts)):
+        if r.action == "baseline" or r.qps_uplift <= min_uplift:
+            continue
+        if (budget_bytes_per_host is not None
+                and r.added_bytes_per_host > budget_bytes_per_host):
+            continue
+        best = r
+        break
+    return best
+
+
+def format_fleet_markdown(rows: Sequence[FleetPrediction]) -> str:
+    lines = [
+        "| action | hosts | top-k | dispatch ms | exchange ms | flush ms | agg QPS | uplift | added KB/host |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r.action} | {r.hosts} | {r.top_k} "
+            f"| {r.dispatch_s*1e3:.3f} | {r.exchange_s*1e3:.3f} "
+            f"| {r.routed_flush_s*1e3:.3f} | {r.agg_qps:.0f} "
+            f"| {r.qps_uplift:.2f}x | {r.added_bytes_per_host/1e3:.1f} |"
+        )
+    lines.append("")
+    lines.append(
+        "Add-a-host vs replicate-the-head priced from the same measured "
+        "coverage curve + per-owner dispatch cost: replication shrinks "
+        "the exchange term (device work unchanged), a new host shrinks "
+        "per-owner width but grows the H^2 all_to_all payload. "
+        "added_bytes = k replica rows per host, or the new host's 1/H' "
+        "shard (closure halo excluded). Measured counterpart: "
+        "scripts/serve_probe.py --scale."
+    )
+    return "\n".join(lines)
+
+
 class TierPrediction(NamedTuple):
     mix: str
     hbm_frac: float

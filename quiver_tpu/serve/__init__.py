@@ -25,6 +25,20 @@ flush-indexed ejection backoff, per-request error isolation), per-tenant
 admission (`submit(node, tenant=)`: weighted flush quotas, deterministic
 queue-depth shedding, per-tenant latency tails), and the deterministic
 `faults.FaultInjector` that proves all of it replayable.
+
+Round 16 makes the fleet ELASTIC (docs/api.md "Elastic fleet"):
+`DistServeEngine.scale(hosts=H±k)` / `rebalance()` migrate seed
+ownership one bounded contiguous range at a time — the range's
+halo-closure shard + feature rows build outside any fence while the old
+owner keeps serving, then a per-range fence flips routing, bumps the
+ownership epoch, and invalidates exactly the migrated seeds' cached
+state. `replay_fleet_oracle` understands ownership epochs (retired
+engines vouch for the rows they served), telemetry drives the triggers
+(`maybe_rebalance` off `OwnerLoadStats` imbalance, the drift-gated
+background replica refresh, `scaling.fleet_table` pricing
+add-a-host vs replicate-the-head), owner engines apply tenant quotas
+end-to-end, and `FaultSpec(at="migration")` proves mid-migration kills
+roll the in-flight range back or forward deterministically.
 """
 
 from .cache import EmbeddingCache
@@ -35,9 +49,12 @@ from .dist import (
     DistServeStats,
     OwnerTimeout,
     REPLICA_HOST,
+    closure_masks,
     contiguous_partition,
+    plan_migration_ranges,
     replay_fleet_oracle,
     replay_shard_oracle,
+    shard_from_mask,
     shard_topology_by_owner,
     shard_topology_for_seeds,
 )
@@ -73,11 +90,14 @@ __all__ = [
     "ServeResult",
     "ServeStats",
     "ShedError",
+    "closure_masks",
     "contiguous_partition",
     "default_buckets",
+    "plan_migration_ranges",
     "poisson_arrivals",
     "replay_fleet_oracle",
     "replay_shard_oracle",
+    "shard_from_mask",
     "shard_topology_by_owner",
     "shard_topology_for_seeds",
     "trace_skew_stats",

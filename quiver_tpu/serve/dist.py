@@ -57,6 +57,24 @@ Execution modes:
 - ``exchange="host"``: the router calls owner engines directly (and the
   shard features exchange through a host-side loopback). Value-identical;
   for environments without H devices.
+
+Round 16 — the fleet is ELASTIC: ``scale(hosts=H±k)`` / ``rebalance()``
+migrate seed ownership live, one bounded contiguous range at a time
+(`plan_migration_ranges` x ``migrate_batch_seeds``). Per range: the
+destination's halo-closure shard and feature rows build OUTSIDE any
+fence (`closure_masks` is incremental — k-hop closures are
+union-homomorphic, so the destination's new masks are old-OR-range)
+while the old owner keeps serving; then a per-range fence (the
+`update_params` drain, held only for the pointer flip) swaps the
+destination engine, flips ``global2host[lo:hi]``, bumps
+``ownership_epoch``, and invalidates exactly the migrated seeds'
+router-cache/old-owner-cache entries. Replaced engines retire with
+their dispatch logs and `replay_fleet_oracle` replays them like live
+owners, so completed rows stay bit-identical to offline replay across
+every epoch. `FaultSpec(at="migration")` kills mid-handoff: a dead
+destination rolls the range back, a dead source rolls it forward —
+deterministically. ``stop(drain=True)`` settles an open range before
+the drain deadline starts. See docs/api.md "Elastic fleet".
 """
 
 from __future__ import annotations
@@ -85,6 +103,7 @@ from ..trace import (
 )
 from ..utils import CSRTopo
 from .cache import EmbeddingCache
+from .faults import OwnerFault
 from .engine import (
     DEFAULT_TENANT,
     ServeConfig,
@@ -126,6 +145,41 @@ def contiguous_partition(n_nodes: int, hosts: int) -> np.ndarray:
     return np.minimum(np.arange(n_nodes, dtype=np.int64) // per, hosts - 1).astype(
         np.int32
     )
+
+
+def plan_migration_ranges(
+    current: np.ndarray, target: np.ndarray, batch_seeds: int
+) -> List[Tuple[int, int, int, int]]:
+    """Cut the ownership delta ``current != target`` into the round-16
+    migration units: ``[(lo, hi, src, dst)]`` contiguous id ranges, each
+    with ONE (src, dst) pair and at most ``batch_seeds`` seeds — the
+    bounded batches `DistServeEngine.rebalance` hands off one fenced
+    flip at a time. Deterministic (ascending id order) so two runs of
+    the same plan migrate identical batches in identical order."""
+    current = np.asarray(current)
+    target = np.asarray(target)
+    if current.shape != target.shape:
+        raise ValueError("current/target ownership shapes differ")
+    batch_seeds = max(int(batch_seeds), 1)
+    diff = np.nonzero(current != target)[0]
+    ranges: List[Tuple[int, int, int, int]] = []
+    if diff.size == 0:
+        return ranges
+    start = 0
+    for i in range(1, diff.size + 1):
+        at_boundary = (
+            i == diff.size
+            or diff[i] != diff[i - 1] + 1
+            or current[diff[i]] != current[diff[start]]
+            or target[diff[i]] != target[diff[start]]
+        )
+        if at_boundary:
+            lo, hi = int(diff[start]), int(diff[i - 1]) + 1
+            src, dst = int(current[lo]), int(target[lo])
+            for b in range(lo, hi, batch_seeds):
+                ranges.append((b, min(b + batch_seeds, hi), src, dst))
+            start = i
+    return ranges
 
 
 def shard_topology_by_owner(
@@ -172,18 +226,53 @@ def shard_topology_by_owner(
     if g2h.shape[0] != n:
         raise ValueError(f"global2host has {g2h.shape[0]} rows, graph has {n}")
     owned = np.nonzero(g2h == host)[0]
-    closure = np.zeros(n, bool)
-    closure[owned] = True
+    seed_mask = np.zeros(n, bool)
+    seed_mask[owned] = True
     hops = max(int(hops), 0)
     feat_hops = hops if closure_hops is None else max(int(closure_hops), hops)
-    # edge-parallel BFS (vectorized — a per-frontier-node python loop is
-    # O(minutes) at products scale): src id per CSR slot built once, each
-    # hop masks the frontier's edges and uniques their endpoints. The
-    # ADJACENCY closure is captured at depth ``hops``; the BFS may continue
-    # to ``closure_hops`` for the returned (feature) closure ids.
-    src_per_edge = np.repeat(
-        np.arange(n, dtype=np.int64), (indptr[1:] - indptr[:-1])
+    topo_closure, closure = closure_masks(
+        indptr, indices, seed_mask, hops, feat_hops
     )
+    shard, edge_stats = shard_from_mask(csr_topo, topo_closure)
+    stats = {
+        "owned_nodes": int(owned.shape[0]),
+        "closure_nodes": int(topo_closure.sum()),
+        "feature_closure_nodes": int(closure.sum()),
+        **edge_stats,
+    }
+    if return_closure:
+        return shard, stats, np.nonzero(closure)[0]
+    return shard, stats
+
+
+def closure_masks(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    seed_mask: np.ndarray,
+    hops: int,
+    feat_hops: int,
+    src_per_edge: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The closure BFS shared by `shard_topology_by_owner` and the
+    round-16 INCREMENTAL migration path: ``(topo_mask, feat_mask)`` bool
+    [N] — the ``hops``-hop adjacency closure and the ``feat_hops``-hop
+    feature closure of ``seed_mask``. Edge-parallel and vectorized (a
+    per-frontier-node python loop is O(minutes) at products scale): src
+    id per CSR slot built once (pass ``src_per_edge`` to amortize it
+    across calls — the migration loop does), each hop masks the
+    frontier's edges and uniques their endpoints.
+
+    k-hop reachability is union-homomorphic — ``closure(A | B) ==
+    closure(A) | closure(B)`` at any fixed depth — which is exactly what
+    makes a RANGE handoff incremental: the destination's new masks are
+    its old masks OR'd with the migrated range's, no BFS over the rows
+    it already held."""
+    n = indptr.shape[0] - 1
+    if src_per_edge is None:
+        src_per_edge = np.repeat(
+            np.arange(n, dtype=np.int64), (indptr[1:] - indptr[:-1])
+        )
+    closure = seed_mask.copy()
     frontier_mask = closure.copy()
     topo_closure = closure.copy() if hops == 0 else None
     for hop in range(feat_hops):
@@ -200,10 +289,30 @@ def shard_topology_by_owner(
             topo_closure = closure.copy()
     if topo_closure is None:  # BFS exhausted the graph before `hops`
         topo_closure = closure.copy()
-    deg = np.where(topo_closure, indptr[1:] - indptr[:-1], 0)
+    return topo_closure, closure
+
+
+def shard_from_mask(
+    csr_topo: CSRTopo, topo_mask: np.ndarray,
+    src_per_edge: Optional[np.ndarray] = None,
+) -> Tuple[CSRTopo, Dict[str, float]]:
+    """Materialize the global-id-space shard CSR keeping adjacency only
+    for rows in ``topo_mask`` (every other row reads degree 0) — the
+    build half of `shard_topology_by_owner`, shared with the migration
+    path so an extended owner shard is constructed by the byte-for-byte
+    same code as a built one. Pass ``src_per_edge`` to amortize the
+    O(E) repeat across calls, exactly like `closure_masks`."""
+    indptr = np.asarray(csr_topo.indptr, np.int64)
+    indices = np.asarray(csr_topo.indices, np.int64)
+    n = indptr.shape[0] - 1
+    if src_per_edge is None:
+        src_per_edge = np.repeat(
+            np.arange(n, dtype=np.int64), (indptr[1:] - indptr[:-1])
+        )
+    deg = np.where(topo_mask, indptr[1:] - indptr[:-1], 0)
     new_indptr = np.zeros(n + 1, np.int64)
     np.cumsum(deg, out=new_indptr[1:])
-    keep_edge = topo_closure[src_per_edge]
+    keep_edge = topo_mask[src_per_edge]
     new_indices = indices[keep_edge]
     new_weights = (
         None
@@ -212,17 +321,12 @@ def shard_topology_by_owner(
     )
     shard = CSRTopo(indptr=new_indptr, indices=new_indices, edge_weights=new_weights)
     stats = {
-        "owned_nodes": int(owned.shape[0]),
-        "closure_nodes": int(topo_closure.sum()),
-        "feature_closure_nodes": int(closure.sum()),
         "edges_kept": int(new_indices.shape[0]),
         "edges_total": int(indices.shape[0]),
         "edge_frac": (
             float(new_indices.shape[0]) / float(max(indices.shape[0], 1))
         ),
     }
-    if return_closure:
-        return shard, stats, np.nonzero(closure)[0]
     return shard, stats
 
 
@@ -525,6 +629,30 @@ class DistServeConfig:
     tier_promote_min: float = 2.0
     tier_hysteresis: float = 1.25
     tier_adapt_every_s: float = 0.0
+    # -- round-16 elastic fleet (ROADMAP item 2; docs/api.md "Elastic
+    # fleet") --------------------------------------------------------------
+    # migrate_batch_seeds: the BOUNDED migration unit — a range handoff
+    # moves at most this many seeds per fenced flip. The expensive work
+    # (range closure BFS, feature materialization, AOT warmup) runs
+    # OUTSIDE the fence with the old owner still serving; only the
+    # routing flip + range-scoped cache invalidation sit under it, so a
+    # migration batch never stalls serving for longer than a weight swap.
+    migrate_batch_seeds: int = 256
+    # rebalance_imbalance: OwnerLoadStats max/mean routed-load ratio at
+    # which `maybe_rebalance()` migrates ranges off the hottest owner
+    # (requires workload telemetry). rebalance_max_seeds bounds one
+    # pass; rebalance_every_s > 0 runs the check on a background timer.
+    rebalance_imbalance: float = 1.5
+    rebalance_max_seeds: int = 1024
+    rebalance_every_s: float = 0.0
+    # replica_refresh_every_s: the r15 remaining-leverage note — a
+    # background timer re-runs `refresh_replicas()` when the router
+    # sketch's hot set has drifted more than replica_drift_frac away
+    # from what the live replica holds (WorkloadMonitor.hot_set_drift).
+    # Fenced and observe-parity pinned exactly like the manual path;
+    # 0 = manual refreshes only.
+    replica_refresh_every_s: float = 0.0
+    replica_drift_frac: float = 0.5
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -542,6 +670,12 @@ class DistServeConfig:
             tier_promote_batch=self.tier_promote_batch,
             tier_promote_min=self.tier_promote_min,
             tier_hysteresis=self.tier_hysteresis,
+            # round-16 owner-side tenant scheduling: the router forwards
+            # each sub-batch's submitting tenants, and owner engines
+            # apply the SAME weighted flush quotas — a tenant's share
+            # holds end-to-end, not just at router admission. None (no
+            # QoS) leaves owner engines byte-identical to round 15.
+            tenant_weights=self.tenant_weights,
         )
 
 
@@ -576,6 +710,18 @@ class DistServeStats:
     shed: int = 0
     request_errors: int = 0
     undrained: int = 0
+    # round-16 elastic-fleet counters: migration_batches counts fenced
+    # range flips COMMITTED (roll-forwards included — the range landed),
+    # migration_rollbacks the ranges that stayed with their old owner
+    # after a destination died mid-handoff; migrated_seeds sums committed
+    # range widths; replica_refreshes counts background drift-triggered
+    # replica rebuilds (manual refresh_replicas calls ride
+    # replica_version, not this).
+    migration_batches: int = 0
+    migration_rollbacks: int = 0
+    migration_rollforwards: int = 0
+    migrated_seeds: int = 0
+    replica_refreshes: int = 0
     inflight_peak: int = 0
     sub_batches: Dict[int, int] = field(default_factory=dict)
     sub_batch_seeds: Dict[int, int] = field(default_factory=dict)
@@ -616,6 +762,11 @@ class DistServeStats:
             "shed": self.shed,
             "request_errors": self.request_errors,
             "undrained": self.undrained,
+            "migration_batches": self.migration_batches,
+            "migration_rollbacks": self.migration_rollbacks,
+            "migration_rollforwards": self.migration_rollforwards,
+            "migrated_seeds": self.migrated_seeds,
+            "replica_refreshes": self.replica_refreshes,
             "inflight_peak": self.inflight_peak,
             "sub_batches": dict(self.sub_batches),
             "mean_sub_batch_width": self.mean_sub_batch_width(),
@@ -644,7 +795,7 @@ class _RoutedFlush:
     other slot resolves normally, and `flush()` does not re-raise."""
 
     __slots__ = ("keys", "slots", "split", "bucket", "error", "slot_errors",
-                 "fid")
+                 "fid", "tenants")
 
     def __init__(self, keys, slots, split):
         self.keys = keys
@@ -654,6 +805,9 @@ class _RoutedFlush:
         self.error: Optional[BaseException] = None
         self.slot_errors: Dict[int, BaseException] = {}
         self.fid = -1  # journal flush id (router dispatch-log index)
+        # per-key submitting tenant (filled at seal, aligned with keys):
+        # owner legs forward these so owner-side quotas hold end-to-end
+        self.tenants: List[str] = []
 
 
 class _HotReplica:
@@ -719,7 +873,9 @@ class DistServeEngine:
         self.exchange_mode = mode
         self.engines = dict(engines)
         self.hosts = self.config.hosts
-        self.global2host = np.asarray(global2host, np.int32)
+        # a COPY: scale()/rebalance() mutate ownership in place under the
+        # per-range fence, and the caller's array must not move under it
+        self.global2host = np.array(global2host, np.int32, copy=True)
         self.out_dim = int(out_dim)
         self.comm = comm
         self.shard_topo_stats = shard_topo_stats or {}
@@ -772,6 +928,44 @@ class DistServeEngine:
         self.fallback: Optional[ServeEngine] = None
         self._params = None                # tracked for replica rebuilds
         self._replica_materials: Optional[Dict[str, object]] = None
+        # -- round-16 elastic-fleet state ---------------------------------
+        # owner engines replaced by a range handoff (and engines of
+        # shrunk-away hosts) keep their dispatch logs for the replay
+        # oracle, exactly like retired replicas. Engines retired WITHOUT
+        # dispatch recording are dropped (a production fleet must not
+        # accumulate dead device state), but their counters fold into
+        # _retired_stats first so the merged fleet view never goes
+        # backwards across a range flip.
+        self._retired_engines: List[ServeEngine] = []
+        self._retired_stats = ServeStats()
+        # per-owner (adjacency-closure mask, feature-closure mask) over
+        # the GLOBAL id space — the incremental-extension state: a range
+        # handoff ORs the migrated range's closure into the destination's
+        # masks instead of re-BFS-ing its whole owned set
+        self._owner_masks: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._src_per_edge: Optional[np.ndarray] = None  # BFS amortizer
+        # ownership_epoch bumps once per COMMITTED range flip; the
+        # migration log [(mig, epoch, lo, hi, src, dst, n, outcome)] is
+        # the deterministic routing-epoch history replay comparisons read
+        self.ownership_epoch = 0
+        self.migration_log: List[Tuple[int, int, int, int, int, int, int,
+                                       str]] = []
+        self._mig_index = 0          # monotonic handoff-batch counter
+        # one range handoff is atomic under this lock; stop() takes it
+        # before draining, so an open range always completes or rolls
+        # back first and no seed is ever stranded ownerless
+        self._migration_lock = threading.Lock()
+        self._draining = False       # rebalance loops stop between batches
+        self.replica_refresh_errors = 0  # failed background refresh passes
+        self.rebalance_errors = 0        # failed background rebalance passes
+        # owner-side tenant scheduling: tenant name <-> wire index (the
+        # collective ships int32 indices; every host derives the same
+        # registry from the sorted QoS config keys)
+        tw = self.config.tenant_weights
+        self._tenant_names: List[str] = sorted(tw) if tw else []
+        self._tenant_index: Dict[str, int] = {
+            t: i for i, t in enumerate(self._tenant_names)
+        }
         # per-owner health for hedged dispatch: consecutive failures +
         # the dispatch index an ejection started at (-1 = serving);
         # flush-indexed backoff keeps the state machine replayable
@@ -885,14 +1079,36 @@ class DistServeEngine:
         feat_budget = round_up_pow2(widths[-1])
         engines: Dict[int, ServeEngine] = {}
         topo_stats: Dict[int, Dict[str, float]] = {}
+        owner_masks: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        indptr_full = np.asarray(csr_topo.indptr, np.int64)
+        indices_full = np.asarray(csr_topo.indices, np.int64)
+        src_per_edge = np.repeat(
+            np.arange(indptr_full.shape[0] - 1, dtype=np.int64),
+            (indptr_full[1:] - indptr_full[:-1]),
+        )
         for h in range(hosts):
             # adjacency closure: len(sizes)-1 expansion hops; FEATURE
             # closure one deeper — the last hop's leaves are gathered but
-            # never expanded (shard_topology_by_owner docstring)
-            topo_h, st, closure_ids = shard_topology_by_owner(
-                csr_topo, global2host, h, hops=len(sizes) - 1,
-                return_closure=True, closure_hops=len(sizes),
+            # never expanded (shard_topology_by_owner docstring). The
+            # masks are KEPT per owner: a later range handoff extends
+            # them incrementally instead of re-BFS-ing the owned set.
+            seed_mask = np.asarray(global2host) == h
+            topo_mask, feat_mask = closure_masks(
+                indptr_full, indices_full, seed_mask,
+                hops=len(sizes) - 1, feat_hops=len(sizes),
+                src_per_edge=src_per_edge,
             )
+            topo_h, edge_stats = shard_from_mask(
+                csr_topo, topo_mask, src_per_edge=src_per_edge
+            )
+            closure_ids = np.nonzero(feat_mask)[0]
+            owner_masks[h] = (topo_mask, feat_mask)
+            st = {
+                "owned_nodes": int(seed_mask.sum()),
+                "closure_nodes": int(topo_mask.sum()),
+                "feature_closure_nodes": int(feat_mask.sum()),
+                **edge_stats,
+            }
             topo_stats[h] = st
             sampler = GraphSageSampler(
                 topo_h, sizes=sizes, mode=sampler_mode, seed=sampler_seed, **kw
@@ -957,6 +1173,8 @@ class DistServeEngine:
             "sampler_seed": sampler_seed, "sampler_kw": dict(kw),
             "shard_config": shard_cfg,
         }
+        dist._owner_masks = owner_masks
+        dist._src_per_edge = src_per_edge
         if config.full_graph_fallback:
             fb_sampler = GraphSageSampler(
                 csr_topo, sizes=sizes, mode=sampler_mode, seed=sampler_seed,
@@ -970,9 +1188,14 @@ class DistServeEngine:
         """The owner-side hook of the serve exchange: ids arrive
         requester-major [H, L] (-1-padded), each requester's valid lanes go
         through the owner engine's FULL local path (cache, coalescing,
-        micro-batching, window), invalid lanes return zeros."""
+        micro-batching, window), invalid lanes return zeros.
+        ``recv_tenants`` (same shape, int32 indices into the sorted QoS
+        registry, -1 = default) arrives when the router ships tenants —
+        the owner engine then applies the submitting tenants' flush
+        quotas (round 16)."""
 
-        def answer(recv_ids: np.ndarray) -> np.ndarray:
+        def answer(recv_ids: np.ndarray,
+                   recv_tenants: Optional[np.ndarray] = None) -> np.ndarray:
             recv_ids = np.asarray(recv_ids)
             out = np.zeros(
                 (recv_ids.shape[0], recv_ids.shape[1], self.out_dim), np.float32
@@ -981,7 +1204,17 @@ class DistServeEngine:
                 valid = recv_ids[req] >= 0
                 if valid.any():
                     ids = recv_ids[req][valid].astype(np.int64)
-                    out[req, valid] = np.asarray(self.engines[host].predict(ids))
+                    tenants = None
+                    if recv_tenants is not None:
+                        tenants = [
+                            self._tenant_names[t] if 0 <= t < len(
+                                self._tenant_names
+                            ) else DEFAULT_TENANT
+                            for t in np.asarray(recv_tenants[req])[valid]
+                        ]
+                    out[req, valid] = np.asarray(
+                        self._predict_leg(self.engines[host], ids, tenants)
+                    )
             return out
 
         return answer
@@ -1067,8 +1300,17 @@ class DistServeEngine:
             self.flush()
         return ServeResult(slot=slot)
 
-    def predict(self, node_ids, timeout: Optional[float] = None) -> np.ndarray:
-        handles = [self.submit(i) for i in np.asarray(node_ids).reshape(-1)]
+    def predict(self, node_ids, timeout: Optional[float] = None,
+                tenants: Optional[Sequence[str]] = None) -> np.ndarray:
+        ids = np.asarray(node_ids).reshape(-1)
+        if tenants is not None and len(tenants) != ids.shape[0]:
+            raise ValueError(
+                f"tenants has {len(tenants)} entries for {ids.shape[0]} ids"
+            )
+        handles = [
+            self.submit(i, tenant=None if tenants is None else tenants[j])
+            for j, i in enumerate(ids)
+        ]
         if not handles:
             return np.zeros((0, self.out_dim), np.float32)
         if not self._running:
@@ -1142,6 +1384,7 @@ class DistServeEngine:
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
             arr = np.asarray(fl.keys, np.int64)
+            fl.tenants = [s.tenant for s in fl.slots]
             owners = self.global2host[arr].astype(np.int64)
             rep = self.replica  # swapped only under the fence: stable here
             if rep is not None and rep.ids.size:
@@ -1192,10 +1435,25 @@ class DistServeEngine:
                     by_host[h][0] if h in by_host else np.array([], np.int64)
                     for h in range(self.hosts)
                 ]
+                host2tenants = None
+                if self._tenant_names and fl.tenants:
+                    # owner-side QoS: ship each sub-batch's submitting
+                    # tenants as int32 registry indices beside the ids
+                    # (no QoS config = no second collective — the round-15
+                    # wire byte for byte)
+                    host2tenants = [
+                        (
+                            [self._tenant_index.get(fl.tenants[int(p)], -1)
+                             for p in by_host[h][1]]
+                            if h in by_host else []
+                        )
+                        for h in range(self.hosts)
+                    ]
                 t_x0 = self._clock() if wl is not None else 0.0
                 try:
                     res = self.comm.exchange_serve(
-                        host2ids, out_dim=self.out_dim, budget=self._budget
+                        host2ids, out_dim=self.out_dim, budget=self._budget,
+                        host2tenants=host2tenants,
                     )
                 except comm_mod.OwnerAnswerError as exc:
                     # the collective is one launch: it cannot fail
@@ -1238,6 +1496,23 @@ class DistServeEngine:
 
     # -- round-15 dispatch legs: replica, hedged owner, failover -----------
 
+    def _leg_tenants(self, fl: _RoutedFlush, pos) -> Optional[List[str]]:
+        """The submitting tenants of a sub-batch's positions — forwarded
+        to the serving engine so owner-side quotas see the real tenants
+        (round 16). None when no QoS is configured (tenants then change
+        nothing downstream — and the legs keep calling bare
+        ``predict(ids)``, byte-compatible with round-15 callables and
+        test doubles)."""
+        if not self.config.tenant_weights or not fl.tenants:
+            return None
+        return [fl.tenants[int(p)] for p in pos]
+
+    @staticmethod
+    def _predict_leg(engine, ids, tenants: Optional[List[str]]):
+        if tenants is None:
+            return engine.predict(ids)
+        return engine.predict(ids, tenants=tenants)
+
     def _replica_leg(self, fl: _RoutedFlush, ids, pos, out) -> None:
         """Serve a replicated sub-batch from the LOCAL hot-set replica —
         no routing, no exchange bytes. A (should-be-impossible) local
@@ -1245,7 +1520,10 @@ class DistServeEngine:
         wl = self.workload
         t0 = self._clock()
         try:
-            rows = np.asarray(self.replica.engine.predict(ids))
+            rows = np.asarray(
+                self._predict_leg(self.replica.engine, ids,
+                                  self._leg_tenants(fl, pos))
+            )
         except BaseException as exc:
             self._failover(fl, REPLICA_HOST, ids, pos, out, "error", exc)
             return
@@ -1277,7 +1555,8 @@ class DistServeEngine:
                     # stalled owner is indistinguishable from a slow one
                     # — exactly what the deadline exists to catch
                     rows, timed_out = self._call_with_deadline(
-                        h, ids, deadline_s, fl.fid
+                        h, ids, deadline_s, fl.fid,
+                        tenants=self._leg_tenants(fl, pos),
                     )
                     if timed_out:
                         err = OwnerTimeout(
@@ -1288,7 +1567,10 @@ class DistServeEngine:
                 else:
                     if self.faults is not None:
                         self.faults.check(h, fl.fid)
-                    rows = np.asarray(self.engines[h].predict(ids))
+                    rows = np.asarray(
+                        self._predict_leg(self.engines[h], ids,
+                                          self._leg_tenants(fl, pos))
+                    )
             except BaseException as exc:
                 err = exc
             if wl is not None:
@@ -1313,7 +1595,7 @@ class DistServeEngine:
         self._failover(fl, h, ids, pos, out, reason, err)
 
     def _call_with_deadline(self, h: int, ids, deadline_s: float,
-                            fid: int):
+                            fid: int, tenants: Optional[List[str]] = None):
         """Run an owner leg (fault hook included) on a worker thread
         with a deadline. On timeout the worker is ABANDONED (its eventual
         answer lands in a local box nobody reads — never the flush's
@@ -1334,7 +1616,9 @@ class DistServeEngine:
             try:
                 if self.faults is not None:
                     self.faults.check(h, fid)
-                box["rows"] = np.asarray(engine.predict(ids))
+                box["rows"] = np.asarray(
+                    self._predict_leg(engine, ids, tenants)
+                )
             except BaseException as exc:  # delivered to the caller below
                 box["err"] = exc
 
@@ -1380,7 +1664,10 @@ class DistServeEngine:
         target, tname = self._pick_failover(h, ids)
         if target is not None:
             try:
-                rows = np.asarray(target.predict(ids))
+                rows = np.asarray(
+                    self._predict_leg(target, ids,
+                                      self._leg_tenants(fl, pos))
+                )
                 out[pos] = rows
                 with self._lock:
                     self.stats.hedges += 1
@@ -1707,6 +1994,10 @@ class DistServeEngine:
                     # without dispatch recording retains nothing, so
                     # periodic refreshes never accumulate dead engines
                     self._retired_replicas.append(old.engine)
+                elif old is not None:
+                    # dropped engine: counters fold so the merged fleet
+                    # view never goes backwards across a refresh
+                    self._retired_stats.merge(old.engine.stats)
                 self.replica_version += 1
                 if eng is not None:
                     new_replica = _HotReplica(
@@ -1727,6 +2018,402 @@ class DistServeEngine:
             "closure_nodes": int(st.get("closure_nodes", 0)),
             "edge_frac": float(st.get("edge_frac", 0.0)),
         }
+
+    # -- round-16 elastic fleet: live resharding ---------------------------
+
+    def _elastic_gate(self) -> None:
+        """Preconditions for `scale`/`rebalance`: build()-time materials
+        (the full topology + feature table the extended shards are cut
+        from), host-mode per-owner legs (the collective mesh is sized at
+        build — growing it means a new mesh, comm, and answerer set, not
+        a range flip), and closure feature residency (the exchange
+        residency's `DistFeature` partition is registered against a fixed
+        ownership map)."""
+        if self._replica_materials is None:
+            raise ValueError(
+                "live resharding needs the build()-time materials (full "
+                "topology + feature table); a bare-constructed "
+                "multi-process engine holds only its own shard"
+            )
+        if self.exchange_mode != "host":
+            raise ValueError(
+                "scale/rebalance ride the host-mode per-owner legs; the "
+                "collective mesh is sized at build and cannot gain or "
+                "lose hosts mid-run — build with exchange='host'"
+            )
+        if self.config.feature_residency != "closure":
+            raise ValueError(
+                "live resharding requires feature_residency='closure' "
+                "(the exchange residency's DistFeature partition is "
+                "registered against a fixed ownership map)"
+            )
+
+    def _build_extended_owner(self, dst: int, ids: np.ndarray):
+        """Land ``ids``'s closure on owner ``dst`` OUTSIDE any fence (the
+        old owner keeps serving the range): BFS only the migrated range
+        (`closure_masks` — k-hop closures are union-homomorphic, so the
+        destination's new masks are old-OR-range, no re-BFS of rows it
+        already held), materialize the extended shard topology + closure
+        feature rows, and AOT-warm a fresh `ServeEngine` over them.
+
+        The new engine's sampler is BORN FRESH (same seed as every shard
+        sampler), so its draws for any owned seed are bit-equal to a
+        freshly born full-graph sampler's at the same key index — the
+        standing parity argument; the replaced engine retires WITH its
+        dispatch log so `replay_fleet_oracle` can still vouch for every
+        row it served (ownership epochs change WHO computes, never any
+        completed bit)."""
+        from ..pyg.sage_sampler import GraphSageSampler
+
+        m = self._replica_materials
+        topo = m["csr_topo"]
+        indptr = np.asarray(topo.indptr, np.int64)
+        indices = np.asarray(topo.indices, np.int64)
+        n = indptr.shape[0] - 1
+        if self._src_per_edge is None:
+            self._src_per_edge = np.repeat(
+                np.arange(n, dtype=np.int64), (indptr[1:] - indptr[:-1])
+            )
+        seed_mask = np.zeros(n, bool)
+        seed_mask[ids] = True
+        sizes = list(m["sizes"])
+        add_topo, add_feat = closure_masks(
+            indptr, indices, seed_mask,
+            hops=len(sizes) - 1, feat_hops=len(sizes),
+            src_per_edge=self._src_per_edge,
+        )
+        base = self._owner_masks.get(dst)
+        if base is not None:
+            new_topo, new_feat = base[0] | add_topo, base[1] | add_feat
+        else:
+            new_topo, new_feat = add_topo, add_feat
+        shard, _ = shard_from_mask(topo, new_topo,
+                                   src_per_edge=self._src_per_edge)
+        closure_ids = np.nonzero(new_feat)[0]
+        local_map = np.full(n, -1, np.int32)
+        local_map[closure_ids] = np.arange(closure_ids.shape[0],
+                                           dtype=np.int32)
+        feat_r = ClosureFeature(
+            np.asarray(m["feat"], np.float32)[closure_ids], local_map
+        )
+        sampler = GraphSageSampler(
+            shard, sizes=sizes, mode=m["sampler_mode"],
+            seed=m["sampler_seed"], **m["sampler_kw"],
+        )
+        with self._lock:
+            params_snapshot = self._params
+        eng = ServeEngine(
+            m["model"], params_snapshot, sampler, feat_r, m["shard_config"]
+        )
+        eng.warmup()
+        return eng, (new_topo, new_feat), params_snapshot
+
+    def _migrate_batch(self, lo: int, hi: int, src: int, dst: int) -> str:
+        """Hand ONE bounded ownership range ``[lo, hi)`` from ``src`` to
+        ``dst`` — the migration unit. Build/land outside the fence (old
+        owner serves throughout), then a PER-RANGE fence (the
+        `update_params`/`apply_placement` drain, held only for the
+        pointer flip) swaps the destination engine, flips
+        ``global2host[lo:hi]``, bumps the ownership epoch, and
+        invalidates exactly the migrated seeds' router-cache and
+        old-owner-cache entries. Returns the outcome, one of:
+
+        - ``"commit"``       — the range now routes to ``dst``;
+        - ``"rollback"``     — ``dst`` died mid-landing (fault hook at
+          this batch's migration index): the built shard is discarded
+          and the range STAYS with ``src``, which never stopped serving
+          it — no fence was taken, no state moved;
+        - ``"rollforward"``  — ``src`` died after the shard landed: the
+          flip completes (``dst`` holds everything the range needs) and
+          the dead owner's remaining traffic is the hedging machinery's
+          problem, exactly like any serve-time kill.
+
+        Deterministic by construction: the outcome reads only (owner,
+        migration batch index) — same plan, same batch log."""
+        with self._migration_lock:
+            mig = self._mig_index
+            self._mig_index += 1
+            ids = np.arange(lo, hi, dtype=np.int64)
+            jr = self.journal
+            jr.emit("migrate", -1, mig, lo, hi)
+            rollforward = False
+            try:
+                if self.faults is not None:
+                    # destination-side hook: a dst kill/error here is a
+                    # death while the shard lands → roll back
+                    self.faults.check_migration(dst, mig)
+                built = self._build_extended_owner(dst, ids)
+                if self.faults is not None:
+                    # source-side hook: src died AFTER the shard landed
+                    # → roll forward (dst has everything it needs)
+                    try:
+                        self.faults.check_migration(src, mig)
+                    except OwnerFault:
+                        rollforward = True
+            except OwnerFault:
+                self.migration_log.append(
+                    (mig, self.ownership_epoch, lo, hi, src, dst, 0,
+                     "rollback")
+                )
+                with self._lock:
+                    self.stats.migration_rollbacks += 1
+                jr.emit("migrate_rollback", -1, mig, src, dst)
+                return "rollback"
+            eng, new_masks, params_snapshot = built
+            with self._seq:
+                with self._fence:
+                    while self._inflight_flushes:
+                        self._fence.wait()
+                    if self._params is not params_snapshot:
+                        # a weight update landed while the shard built:
+                        # re-stamp under the fence (cheap), same rule as
+                        # a replica refresh
+                        eng.update_params(self._params)
+                    old = self.engines.get(dst)
+                    if old is not None:
+                        if old.config.record_dispatches:
+                            self._retired_engines.append(old)
+                        else:
+                            self._retired_stats.merge(old.stats)
+                    self.engines[dst] = eng
+                    self._owner_masks[dst] = new_masks
+                    self.global2host[lo:hi] = dst
+                    self.ownership_epoch += 1
+                    # range-scoped invalidation: exactly the migrated
+                    # seeds' entries — their serving path changed (the
+                    # replica-refresh rule); everything else stays warm
+                    self.cache.invalidate_keys(range(lo, hi))
+                    src_eng = self.engines.get(src)
+                    if src_eng is not None:
+                        src_eng.cache.invalidate_keys(int(i) for i in ids)
+                    outcome = "rollforward" if rollforward else "commit"
+                    self.migration_log.append(
+                        (mig, self.ownership_epoch, lo, hi, src, dst,
+                         int(ids.size), outcome)
+                    )
+                    # the fence Condition wraps _lock — already held here
+                    self.stats.migration_batches += 1
+                    self.stats.migrated_seeds += int(ids.size)
+                    if rollforward:
+                        self.stats.migration_rollforwards += 1
+            jr.emit("migrate_commit", -1, mig, src, dst)
+            return outcome
+
+    def rebalance(self, target_global2host=None,
+                  max_seeds: Optional[int] = None) -> Dict[str, object]:
+        """Migrate seed ownership toward ``target_global2host`` one
+        bounded range at a time (``config.migrate_batch_seeds`` per
+        fenced flip; `plan_migration_ranges` cuts the delta into
+        per-(src, dst) contiguous runs). With no explicit target, plans
+        one load-shedding move off the hottest owner from the router's
+        `OwnerLoadStats` + Count-Min estimates (`_plan_load_target`) —
+        the telemetry-driven path `maybe_rebalance` and the background
+        timer ride. Ranges whose destination dies mid-landing roll back
+        (and keep counting); a `stop()` in progress halts BETWEEN
+        batches (never mid-range). Returns the pass summary."""
+        self._elastic_gate()
+        if target_global2host is None:
+            target_global2host = self._plan_load_target(max_seeds)
+            if target_global2host is None:
+                return {"batches": 0, "migrated_seeds": 0, "rollbacks": 0,
+                        "rollforwards": 0, "epoch": self.ownership_epoch,
+                        "planned": 0, "skipped": "balanced"}
+        target = np.asarray(target_global2host, np.int32)
+        if target.shape != self.global2host.shape:
+            raise ValueError(
+                f"target has {target.shape[0]} rows, graph has "
+                f"{self.global2host.shape[0]}"
+            )
+        if target.size and (target.min() < 0 or target.max() >= self.hosts):
+            raise ValueError(
+                f"target owners outside [0, {self.hosts})"
+            )
+        ranges = plan_migration_ranges(
+            self.global2host, target, self.config.migrate_batch_seeds
+        )
+        batches = rollbacks = rollforwards = moved = 0
+        for lo, hi, src, dst in ranges:
+            if self._draining:
+                break  # stop() halts between batches, never mid-range
+            outcome = self._migrate_batch(lo, hi, src, dst)
+            if outcome == "rollback":
+                rollbacks += 1
+            else:
+                batches += 1
+                moved += hi - lo
+                if outcome == "rollforward":
+                    rollforwards += 1
+        return {"batches": batches, "migrated_seeds": moved,
+                "rollbacks": rollbacks, "rollforwards": rollforwards,
+                "epoch": self.ownership_epoch, "planned": len(ranges)}
+
+    def scale(self, hosts: int) -> Dict[str, object]:
+        """Grow or shrink the serving fleet to ``hosts`` under live
+        traffic (ROADMAP item 2): the target ownership is the canonical
+        balanced `contiguous_partition`, and every changed range migrates
+        through `rebalance`'s bounded fenced batches — the old owner
+        serves each range until the new owner's halo-closure shard and
+        feature rows land. Shrinks retire the emptied hosts' engines
+        (dispatch logs kept for the replay oracle); if a rollback left
+        seeds on a to-be-removed host, that host SURVIVES (reported in
+        ``incomplete_hosts``) — a seed is never stranded ownerless."""
+        self._elastic_gate()
+        new_h = int(hosts)
+        if new_h < 1:
+            raise ValueError("hosts must be >= 1")
+        old_h = self.hosts
+        n = self.global2host.shape[0]
+        target = contiguous_partition(n, new_h)
+        if new_h > old_h:
+            # routing to the new owners only begins at their first range
+            # flip; until then they own nothing and get no sub-batches
+            self.hosts = new_h
+        summary = self.rebalance(target)
+        summary["hosts_before"], summary["hosts_target"] = old_h, new_h
+        if new_h < old_h:
+            with self._seq:
+                with self._fence:
+                    while self._inflight_flushes:
+                        self._fence.wait()
+                    leftover = np.unique(
+                        self.global2host[self.global2host >= new_h]
+                    )
+                    if leftover.size:
+                        summary["incomplete_hosts"] = [
+                            int(x) for x in leftover
+                        ]
+                    else:
+                        for h in range(new_h, self.hosts):
+                            eng = self.engines.pop(h, None)
+                            self._owner_masks.pop(h, None)
+                            self._owner_health.pop(h, None)
+                            if eng is None:
+                                continue
+                            if eng.config.record_dispatches:
+                                self._retired_engines.append(eng)
+                            else:
+                                self._retired_stats.merge(eng.stats)
+                        self.hosts = new_h
+        summary["hosts"] = self.hosts
+        return summary
+
+    def maybe_rebalance(self) -> Optional[Dict[str, object]]:
+        """The telemetry trigger: migrate ranges off the hottest owner
+        iff `OwnerLoadStats` imbalance crossed
+        ``config.rebalance_imbalance``. Returns the rebalance summary or
+        None when balanced (or no telemetry). `start()` runs this on a
+        timer when ``rebalance_every_s`` > 0."""
+        self._elastic_gate()
+        target = self._plan_load_target()
+        if target is None:
+            return None
+        return self.rebalance(target)
+
+    def _plan_load_target(self, max_seeds: Optional[int] = None
+                          ) -> Optional[np.ndarray]:
+        """One load-shedding ownership target from the router telemetry:
+        when the hottest owner's routed-seed load exceeds
+        ``rebalance_imbalance`` x the mean, move its hottest contiguous
+        owned runs (scored by the Count-Min per-seed estimate — the
+        sketch names WHICH ranges carry the excess) to the least-loaded
+        owner, until ~half the excess moved or ``rebalance_max_seeds``
+        seeds are in flight. Deterministic: reads only sketch/owner
+        state, ties break on ids. None = balanced or not enough
+        telemetry."""
+        if self.workload is None or self.hosts < 2:
+            return None
+        loads = {h: 0 for h in range(self.hosts)}
+        for h, v in self.workload.owners.seeds_by_owner().items():
+            if 0 <= h < self.hosts:
+                loads[h] = int(v)
+        total = sum(loads.values())
+        if total <= 0:
+            return None
+        mean = total / self.hosts
+        hot = max(loads, key=lambda h: (loads[h], -h))
+        cold = min(loads, key=lambda h: (loads[h], h))
+        if hot == cold or loads[hot] < self.config.rebalance_imbalance * mean:
+            return None
+        excess = loads[hot] - mean
+        owned = np.nonzero(self.global2host == hot)[0]
+        if owned.size == 0:
+            return None
+        cms = self.workload.cms
+        est = np.asarray(cms.estimate_many(owned), np.float64)
+        # contiguous runs of the hot owner's ids, hottest-first
+        cuts = np.nonzero(np.diff(owned) != 1)[0] + 1
+        run_bounds = zip(np.concatenate(([0], cuts)),
+                         np.concatenate((cuts, [owned.size])))
+        runs = sorted(
+            ((float(est[a:b].sum()), int(owned[a]), int(owned[b - 1]) + 1)
+             for a, b in run_bounds),
+            key=lambda r: (-r[0], r[1]),
+        )
+        budget = int(max_seeds or self.config.rebalance_max_seeds)
+        target = self.global2host.copy()
+        moved_est, moved_seeds = 0.0, 0
+        goal = excess / 2.0
+        for score, lo, hi in runs:
+            if moved_est >= goal or moved_seeds >= budget:
+                break
+            take = min(hi - lo, budget - moved_seeds)
+            target[lo:lo + take] = cold
+            sl = (owned >= lo) & (owned < lo + take)
+            moved_est += float(est[sl].sum())
+            moved_seeds += take
+        if moved_seeds == 0:
+            return None
+        return target
+
+    def routing_epochs(self) -> List[Tuple[int, int, int, int, int]]:
+        """Committed ownership flips as (epoch, lo, hi, src, dst) — the
+        deterministic routing-epoch history replay comparisons read
+        (rollbacks never bump the epoch and are excluded; read
+        ``migration_log`` for the full batch log including them)."""
+        return [(e, lo, hi, src, dst)
+                for (_mig, e, lo, hi, src, dst, _n, oc) in self.migration_log
+                if oc != "rollback"]
+
+    def _replica_refresh_pass(self) -> Optional[Dict[str, object]]:
+        """One background-refresh check (the r15 remaining-leverage
+        note): re-run `refresh_replicas` iff the router sketch's hot set
+        drifted at least ``replica_drift_frac`` away from what the live
+        replica holds (`WorkloadMonitor.hot_set_drift`); a first pass
+        with no replica builds one. Returns the refresh summary or None
+        when skipped — fenced and observe-parity pinned exactly like the
+        manual path, because it IS the manual path behind a drift
+        check."""
+        if self.workload is None or self.config.replicate_top_k <= 0:
+            return None
+        k = self.config.replicate_top_k
+        hot = self.workload.hot_set(k)
+        if hot.size == 0:
+            return None
+        rep = self.replica
+        if rep is not None:
+            drift = self.workload.hot_set_drift(rep.ids, k)
+            if drift < self.config.replica_drift_frac:
+                return None
+        out = self.refresh_replicas(k=k)
+        with self._lock:
+            self.stats.replica_refreshes += 1
+        return out
+
+    def _policy_loop(self, period: float, fn, err_attr: str) -> None:
+        """Shared background-policy driver (replica refresh, rebalance):
+        sleep in small slices so stop() never waits a full period; a
+        failing pass bumps its error counter instead of killing the
+        thread (the tier-daemon contract)."""
+        while self._running:
+            deadline = time.monotonic() + period
+            while self._running and time.monotonic() < deadline:
+                time.sleep(min(0.05, period))
+            if not self._running:
+                return
+            try:
+                fn()
+            except Exception:
+                setattr(self, err_attr, getattr(self, err_attr) + 1)
 
     def warmup(self) -> Dict[object, Dict[int, float]]:
         """Pre-trace every shard engine's bucket programs (twin samplers
@@ -1754,12 +2441,20 @@ class DistServeEngine:
         merged = ServeStats()
         for h in sorted(self.engines):
             merged.merge(self.engines[h].stats)
+        # engines retired by a range handoff or a shrink served real
+        # traffic — their counters stay in the merged fleet view
+        # (retained engines merge live; dropped ones were folded into
+        # _retired_stats at retirement)
+        for eng in self._retired_engines:
+            merged.merge(eng.stats)
+        merged.merge(self._retired_stats)
         out: Dict[str, object] = {
             "router": self.stats.snapshot(),
             "per_shard": {
                 h: self.engines[h].stats.snapshot() for h in sorted(self.engines)
             },
             "topology": self.shard_topo_stats,
+            "retired_engines": len(self._retired_engines),
         }
         if self.replica is not None:
             merged.merge(self.replica.engine.stats)
@@ -1808,10 +2503,24 @@ class DistServeEngine:
                   "hedges", "hedged_seeds", "hedge_timeouts",
                   "hedge_errors", "hedge_ejected", "hedge_failed",
                   "owner_ejections", "shed", "request_errors",
-                  "undrained"):
+                  "undrained", "migration_batches", "migration_rollbacks",
+                  "migration_rollforwards", "migrated_seeds",
+                  "replica_refreshes"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"DistServeStats.{f}", labels)
+        reg.gauge_fn(f"{prefix}_ownership_epoch",
+                     lambda: self.ownership_epoch,
+                     "committed ownership range flips", labels)
+        reg.gauge_fn(f"{prefix}_hosts",
+                     lambda: self.hosts,
+                     "current serving fleet host count", labels)
+        reg.gauge_fn(f"{prefix}_replica_refresh_errors",
+                     lambda: self.replica_refresh_errors,
+                     "failed background replica-refresh passes", labels)
+        reg.gauge_fn(f"{prefix}_rebalance_errors",
+                     lambda: self.rebalance_errors,
+                     "failed background rebalance passes", labels)
         reg.gauge_fn(f"{prefix}_replica_version",
                      lambda: self.replica_version,
                      "hot-set replica refreshes applied", labels)
@@ -2021,6 +2730,7 @@ class DistServeEngine:
         if self._running:
             return self
         self._running = True
+        self._draining = False  # re-arm migrations after a stop()
         self._threads = [
             threading.Thread(
                 target=self._poll_loop,
@@ -2040,6 +2750,41 @@ class DistServeEngine:
                     daemon=True,
                 )
             )
+        # round-16 background policies: the drift-gated replica refresh
+        # (the r15 remaining-leverage note) and the imbalance-gated
+        # rebalance — both fenced inside their passes, both surviving
+        # failures as error counters (the tier-daemon contract)
+        if (self.config.replica_refresh_every_s > 0
+                and self.config.replicate_top_k > 0
+                and self.workload is not None
+                and self._replica_materials is not None):
+            self._threads.append(
+                threading.Thread(
+                    target=lambda: self._policy_loop(
+                        self.config.replica_refresh_every_s,
+                        self._replica_refresh_pass,
+                        "replica_refresh_errors",
+                    ),
+                    name="quiver-dist-serve-replica-refresh",
+                    daemon=True,
+                )
+            )
+        if (self.config.rebalance_every_s > 0
+                and self.workload is not None
+                and self._replica_materials is not None
+                and self.exchange_mode == "host"
+                and self.config.feature_residency == "closure"):
+            self._threads.append(
+                threading.Thread(
+                    target=lambda: self._policy_loop(
+                        self.config.rebalance_every_s,
+                        self.maybe_rebalance,
+                        "rebalance_errors",
+                    ),
+                    name="quiver-dist-serve-rebalance",
+                    daemon=True,
+                )
+            )
         for t in self._threads:
             t.start()
         return self
@@ -2055,24 +2800,48 @@ class DistServeEngine:
         died mid-flush must not hang the caller. Work not retired by the
         deadline resolves with `serve.engine.DrainTimeout` and is counted
         in ``stats.undrained`` — in the snapshot, never silently
-        dropped."""
+        dropped.
+
+        An OPEN migration range (round 16) is settled FIRST, outside the
+        drain budget: ``_draining`` halts rebalance loops between
+        batches, and taking the migration lock waits for the in-flight
+        batch to commit or roll back — a range handoff is atomic, so
+        after the wait every seed has exactly one owner. Only then does
+        the drain deadline start counting. A half-landed range abandoned
+        to a deadline would strand its seeds ownerless; completing it
+        can exceed the deadline, and that is the correct trade."""
         self._running = False
-        # one deadline covers poller joins too (a poller wedged mid-flush
-        # must not defeat the bound — see ServeEngine.stop)
-        deadline = self._clock() + self.config.drain_deadline_s
-        for t in self._threads:
-            t.join(timeout=max(deadline - self._clock(), 0.05))
-        self._threads = []
-        if drain:
-            while self._drainable() and self._clock() < deadline:
-                try:
-                    self.flush()
-                except Exception:
-                    pass  # the failing flush resolved its own waiters
-        with self._fence:
-            while self._inflight_flushes and self._clock() < deadline:
-                self._fence.wait(timeout=0.05)
-        abandon_undrained(self, drained=drain)
+        self._draining = True
+        try:
+            # settle the open range before any deadline starts: batches
+            # are atomic under this lock, and rebalance loops check
+            # _draining between batches
+            with self._migration_lock:
+                pass
+            # one deadline covers poller joins too (a poller wedged
+            # mid-flush must not defeat the bound — see ServeEngine.stop)
+            deadline = self._clock() + self.config.drain_deadline_s
+            for t in self._threads:
+                t.join(timeout=max(deadline - self._clock(), 0.05))
+            self._threads = []
+            if drain:
+                while self._drainable() and self._clock() < deadline:
+                    try:
+                        self.flush()
+                    except Exception:
+                        pass  # the failing flush resolved its own waiters
+            with self._fence:
+                while self._inflight_flushes and self._clock() < deadline:
+                    self._fence.wait(timeout=0.05)
+            abandon_undrained(self, drained=drain)
+        finally:
+            # _draining stays TRUE after stop: a rebalance loop still
+            # holding batches must keep halting even though stop already
+            # returned (it only checks the flag between batches, so
+            # resetting here would let it resume flipping ownership on
+            # an engine the caller believes is quiesced). start() is the
+            # explicit path back to a migrating engine.
+            pass
 
     def _poll_loop(self) -> None:
         while self._running:
@@ -2134,12 +2903,16 @@ def replay_fleet_oracle(
     full_sampler_factory: Callable[[], object],
     full_feature,
 ) -> Dict[int, List[np.ndarray]]:
-    """`replay_shard_oracle` extended over the WHOLE round-15 fleet:
-    owners + the hot-set replica + the full-graph fallback, each engine's
-    dispatch log replayed through a fresh FULL-graph sampler and the
+    """`replay_shard_oracle` extended over the WHOLE fleet: owners + the
+    hot-set replica + the full-graph fallback + every engine RETIRED by a
+    replica refresh, a range handoff, or a shrink (round 16: the oracle
+    understands ownership epochs — an epoch changes which engine computes
+    a seed, and each epoch's engine vouches for its own dispatch log).
+    Each engine's log replays through a fresh FULL-graph sampler and the
     offline `batch_logits` path, collecting EVERY computation of every
     node (not just the first — a cache invalidation, e.g. a replica
-    refresh, can legitimately recompute a node under a later key draw).
+    refresh or a migrated range, can legitimately recompute a node under
+    a later key draw).
 
     Returns {node_id: [candidate rows]}. Under hedged/failover dispatch a
     node may be computed by more than one engine over a run (its owner
@@ -2156,6 +2929,13 @@ def replay_fleet_oracle(
         engines["replica"] = dist.replica.engine
     for i, retired in enumerate(dist._retired_replicas):
         engines[f"replica_retired_{i}"] = retired
+    # round-16 ownership epochs: owner engines replaced by a range
+    # handoff (or removed by a shrink) served real traffic under earlier
+    # epochs — their dispatch logs are candidates exactly like a live
+    # owner's. Every shard sampler (any epoch) is born with the same
+    # seed, so one fresh full-graph sampler per engine replays it.
+    for i, retired in enumerate(dist._retired_engines):
+        engines[f"owner_retired_{i}"] = retired
     if dist.fallback is not None:
         engines["fallback"] = dist.fallback
     served: Dict[int, List[np.ndarray]] = {}

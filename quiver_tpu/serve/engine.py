@@ -867,11 +867,25 @@ class ServeEngine:
             self.config.max_queue_depth, self.config.tenant_weights,
         )
 
-    def predict(self, node_ids, timeout: Optional[float] = None) -> np.ndarray:
+    def predict(self, node_ids, timeout: Optional[float] = None,
+                tenants: Optional[Sequence[str]] = None) -> np.ndarray:
         """Blocking convenience: submit every id, make sure they flush
         (inline when no background thread is running), return ``[len(ids),
-        C]`` logits in request order."""
-        handles = [self.submit(i) for i in np.asarray(node_ids).reshape(-1)]
+        C]`` logits in request order. ``tenants`` (aligned with
+        ``node_ids``) stamps each submission's tenant — the round-16
+        owner-side QoS hook: a router forwarding a sub-batch passes the
+        submitting tenants through, so this engine's
+        ``tenant_weights`` flush quotas hold END-TO-END, not just at
+        router admission."""
+        ids = np.asarray(node_ids).reshape(-1)
+        if tenants is not None and len(tenants) != ids.shape[0]:
+            raise ValueError(
+                f"tenants has {len(tenants)} entries for {ids.shape[0]} ids"
+            )
+        handles = [
+            self.submit(i, tenant=None if tenants is None else tenants[j])
+            for j, i in enumerate(ids)
+        ]
         if not handles:  # empty batch is a valid no-op (np.stack would raise)
             return np.zeros((0, 0), np.float32)
         if not self._running:

@@ -54,18 +54,36 @@ class OwnerKilled(OwnerFault):
 class FaultSpec:
     """One planned fault: ``owner`` fails at router dispatch index
     ``fid`` with the given ``kind`` ("kill" | "error" | "stall");
-    ``stall_s`` is the injected delay for stalls."""
+    ``stall_s`` is the injected delay for stalls.
+
+    ``at`` chooses the index space ``fid`` lives in: ``"dispatch"``
+    (default, the round-15 behavior — router dispatch indices) or
+    ``"migration"`` (round 16) — ``fid`` is then a MIGRATION BATCH index
+    (`DistServeEngine.scale`/`rebalance` count handoff batches
+    monotonically, exactly like the dispatch index counts flushes), and
+    the fault fires inside `check_migration` at the range-handoff points
+    the migration machinery defines: a killed DESTINATION rolls the
+    in-flight range back to the old owner, a killed SOURCE rolls it
+    forward to the new one — deterministically, because the decision
+    reads only (owner, batch index). A migration ``kill`` also leaves
+    the owner DEAD for every later serve dispatch (the machine is gone,
+    not just the migration)."""
 
     owner: int
     fid: int
     kind: str
     stall_s: float = 0.0
+    at: str = "dispatch"
 
     def __post_init__(self):
         if self.kind not in ("kill", "error", "stall"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
-        if self.fid < 1:
+        if self.at not in ("dispatch", "migration"):
+            raise ValueError(f"unknown fault site {self.at!r}")
+        if self.at == "dispatch" and self.fid < 1:
             raise ValueError("fid is a dispatch index (first flush seals 1)")
+        if self.at == "migration" and self.fid < 0:
+            raise ValueError("fid is a migration batch index (first is 0)")
         if self.kind == "stall" and self.stall_s <= 0:
             raise ValueError("stall faults need stall_s > 0")
 
@@ -86,14 +104,30 @@ class FaultInjector:
         self.faults: Tuple[FaultSpec, ...] = tuple(faults)
         self._kill_at: Dict[int, int] = {}
         self._oneshot: Dict[Tuple[int, int], FaultSpec] = {}
+        # migration-indexed plan (FaultSpec.at == "migration"): kills by
+        # first dead batch index, one-shots by (owner, batch index)
+        self._mig_kill_at: Dict[int, int] = {}
+        self._mig_oneshot: Dict[Tuple[int, int], FaultSpec] = {}
+        # owners a migration kill has ALREADY fired for: dead for every
+        # serve dispatch from that point on (guarded by _lock)
+        self._dead_owners: set = set()
         for f in self.faults:
-            if f.kind == "kill":
+            if f.at == "migration":
+                if f.kind == "kill":
+                    prev = self._mig_kill_at.get(f.owner)
+                    self._mig_kill_at[f.owner] = (
+                        f.fid if prev is None else min(prev, f.fid)
+                    )
+                else:
+                    self._mig_oneshot[(f.owner, f.fid)] = f
+            elif f.kind == "kill":
                 prev = self._kill_at.get(f.owner)
                 self._kill_at[f.owner] = f.fid if prev is None else min(prev, f.fid)
             else:
                 self._oneshot[(f.owner, f.fid)] = f
         self._lock = threading.Lock()
         self.log: List[Tuple[int, int, str]] = []
+        self.mig_log: List[Tuple[int, int, str]] = []
 
     @classmethod
     def seeded(
@@ -127,6 +161,17 @@ class FaultInjector:
         `OwnerKilled`/`OwnerFault` or sleeps (stall), recording every
         firing; a no-fault pair returns immediately."""
         owner, fid = int(owner), int(fid)
+        with self._lock:
+            mig_dead = owner in self._dead_owners
+        if mig_dead:
+            # killed by a migration-indexed fault: the machine is gone,
+            # so every serve dispatch to it fails from that point on
+            with self._lock:
+                self.log.append((fid, owner, "kill"))
+            raise OwnerKilled(
+                f"owner {owner} killed mid-migration (serve dispatch "
+                f"index {fid})"
+            )
         kill_fid = self._kill_at.get(owner)
         if kill_fid is not None and fid >= kill_fid:
             with self._lock:
@@ -146,6 +191,43 @@ class FaultInjector:
             )
         time.sleep(spec.stall_s)  # "stall": delay, then serve normally
 
+    def check_migration(self, owner: int, mig: int) -> None:
+        """The migration-side hook (round 16): fire any fault planned for
+        ``owner`` at MIGRATION batch index ``mig``. Called by
+        `DistServeEngine._migrate_batch` once per participant (destination
+        while its shard lands, source after) — a raised `OwnerKilled`
+        there rolls the in-flight range back (dst dead) or forward (src
+        dead). A migration kill also marks the owner dead for every later
+        `check` (serve dispatches), because the machine — not the
+        migration — failed. Keyed purely by (owner, batch index):
+        replayable by construction, like `check`."""
+        owner, mig = int(owner), int(mig)
+        kill_mig = self._mig_kill_at.get(owner)
+        if kill_mig is not None and mig >= kill_mig:
+            with self._lock:
+                self.mig_log.append((mig, owner, "kill"))
+                self._dead_owners.add(owner)
+            raise OwnerKilled(
+                f"owner {owner} killed at migration batch {kill_mig} "
+                f"(now {mig})"
+            )
+        spec = self._mig_oneshot.get((owner, mig))
+        if spec is None:
+            return
+        with self._lock:
+            self.mig_log.append((mig, owner, spec.kind))
+        if spec.kind == "error":
+            raise OwnerFault(
+                f"owner {owner} injected error at migration batch {mig}"
+            )
+        time.sleep(spec.stall_s)  # "stall": delay the handoff, then land
+
+    def migration_events(self) -> List[Tuple[int, int, str]]:
+        """Fired migration faults sorted by (batch index, owner, kind) —
+        the replay-comparison view of the migration plan."""
+        with self._lock:
+            return sorted(self.mig_log)
+
     def events(self) -> List[Tuple[int, int, str]]:
         """Fired faults sorted by (fid, owner, kind) — the deterministic
         view replay comparisons read (append order may interleave across
@@ -160,3 +242,4 @@ class FaultInjector:
     def clear_log(self) -> None:
         with self._lock:
             self.log.clear()
+            self.mig_log.clear()

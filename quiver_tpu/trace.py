@@ -531,6 +531,12 @@ EVENT_KINDS = (
     "shed",          # -,   -, a=node            refused at tenant admission
     "hedge",         # -, fid, a=owner           sub-batch re-routed to a target
     "eject",         # -, fid, a=owner           owner entered backoff
+    # round-16 migration journal (policy markers like the three above;
+    # fid carries the MIGRATION batch index, not a flush id — the fold
+    # below ignores these kinds entirely, so the collision is harmless)
+    "migrate",           # -, mig, a=lo, b=hi     range handoff began (build)
+    "migrate_commit",    # -, mig, a=src, b=dst   routing flipped to dst
+    "migrate_rollback",  # -, mig, a=src, b=dst   range stayed with src
 )
 
 # rough per-event host bytes: 6-slot tuple + boxed floats/small ints. Used
@@ -551,6 +557,7 @@ def _fold_flush_events(events) -> Dict[int, Dict[str, float]]:
         if fid < 0 or kind in (
             "submit", "cache_hit", "coalesce", "late_admit", "assemble",
             "shed", "hedge", "eject",
+            "migrate", "migrate_commit", "migrate_rollback",
         ):
             continue
         f = flushes.setdefault(fid, {})
@@ -1147,6 +1154,13 @@ def chrome_trace_events(
                 if kind in ("submit", "cache_hit", "coalesce", "late_admit"):
                     instants.append(
                         (pid, t, kind, {"rid": rid, "node": a, "fid": fid})
+                    )
+                elif kind in ("migrate", "migrate_commit",
+                              "migrate_rollback"):
+                    # migration markers: fid carries the migration batch
+                    # index, a/b the range or src/dst per EVENT_KINDS
+                    instants.append(
+                        (pid, t, kind, {"mig": fid, "a": a, "b": b})
                     )
             items = []
             for fid, f in sorted(flushes.items()):

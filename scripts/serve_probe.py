@@ -80,6 +80,20 @@ this box's page cache makes flat-file reads DRAM-speed) and applied
 identically to both placements; measured per-row tier costs price
 `scaling.tier_table` rows carried in the artifact.
 
+Round 16 adds the ELASTIC-FLEET leg (ISSUE 11, ``--scale`` ->
+SERVE_r08.json): a host-mode hosts=1 fleet ramped 1→2→4→2 under a live
+alpha-1.1 Zipf trace via `DistServeEngine.scale` — seed-ownership
+ranges migrate one bounded fenced batch at a time (build outside the
+fence, per-range flip). In-run asserts: ZERO dropped requests on the
+clean ramp, bit-parity of every completed row in every wave against the
+epoch-aware `replay_fleet_oracle` (retired engines vouch for their
+epochs), and a second ramp with an owner KILLED MID-MIGRATION
+(`FaultSpec(at="migration")`) whose in-flight ranges roll
+forward/back deterministically, still zero-drop (fallback absorbs),
+still parity-true, and bit-identical when replayed. The clean ramp's
+measured coverage + routed-flush cost price `scaling.fleet_table`
+(add-a-host vs replicate-the-head) in the artifact.
+
 Usage: JAX_PLATFORMS=cpu python scripts/serve_probe.py [--requests 400]
        [--hosts 1,2] [--repeats 3] [--out SERVE_r05.json]
        [--timeline SERVE_r05_timeline.json]
@@ -88,6 +102,9 @@ Usage: JAX_PLATFORMS=cpu python scripts/serve_probe.py [--requests 400]
        JAX_PLATFORMS=cpu python scripts/serve_probe.py --tiers
        [--tier-requests 600] [--tier-disk-us-per-row 20]
        [--out TIER_r01.json]
+       JAX_PLATFORMS=cpu python scripts/serve_probe.py --scale
+       [--scale-requests 360] [--migrate-batch 120]
+       [--out SERVE_r08.json]
 """
 
 import argparse
@@ -162,6 +179,15 @@ def main():
                     help="SIMULATED per-row cold-read latency (this box's "
                          "page cache makes flat-file reads DRAM-speed; "
                          "production disk is not; 0 = raw page cache)")
+    ap.add_argument("--scale", action="store_true",
+                    help="round-16 elastic-fleet leg: ramp a Zipf trace "
+                         "1->2->4->2 hosts with live resharding, zero "
+                         "dropped requests, epoch-aware oracle parity, "
+                         "and an owner kill mid-migration "
+                         "(-> SERVE_r08.json)")
+    ap.add_argument("--scale-requests", type=int, default=360)
+    ap.add_argument("--migrate-batch", type=int, default=120,
+                    help="bounded seeds per fenced migration batch")
     ap.add_argument("--faults", action="store_true",
                     help="round-15 fleet-robustness leg: owner-kill "
                          "replay parity, availability/p99 vs hedge "
@@ -305,6 +331,225 @@ def main():
                     )
                     parity_rows += 1
         return dist, trace, wall, parity_rows
+
+    # -- round-16 elastic-fleet leg (--scale -> SERVE_r08.json) --------------
+    if args.scale:
+        from quiver_tpu.parallel.scaling import (
+            fleet_table, format_fleet_markdown, pick_fleet_action,
+        )
+        from quiver_tpu.trace import WorkloadConfig as _WC
+
+        RAMP = (2, 4, 2)
+
+        def build_elastic(**kw):
+            """Host-mode hosts=1 fleet, closure residency (the fused
+            owner path live resharding rides), sketches on so the fleet
+            can SEE its own load."""
+            shard_cfg = ServeConfig(
+                max_batch=args.max_batch, buckets=(8, args.max_batch),
+                max_delay_ms=2.0, record_dispatches=True,
+            )
+            cfg = DistServeConfig(
+                hosts=1, max_batch=args.max_batch, max_delay_ms=2.0,
+                record_dispatches=True, shard_config=shard_cfg,
+                exchange="host", migrate_batch_seeds=args.migrate_batch,
+                workload=_WC(topk=64), **kw,
+            )
+            dist = DistServeEngine.build(
+                model, params, topo, feat, SIZES, hosts=1, config=cfg,
+                sampler_seed=SEED,
+            )
+            dist.warmup()
+            dist.reset_stats()
+            return dist
+
+        def serve_seq(dist, trace, timeout=300):
+            handles = [dist.submit(int(nid)) for nid in trace]
+            while dist._drainable():
+                dist.flush()
+            out = []
+            for h in handles:
+                try:
+                    out.append(h.result(timeout))
+                except Exception as exc:
+                    out.append(exc)
+            return out
+
+        trace_s = zipfian_trace(n, args.scale_requests, alpha=1.1, seed=61)
+
+        def ramp(fault_specs=(), **kw):
+            """Drive one wave per fleet size across the 1->RAMP ramp,
+            scaling live between waves. Returns everything the parity
+            and replay comparisons need."""
+            inj = FaultInjector(fault_specs) if fault_specs else None
+            dist = build_elastic(
+                fault_injector=inj,
+                full_graph_fallback=bool(fault_specs), **kw,
+            )
+            waves, walls, summaries = [], [], []
+            t0 = time.perf_counter()
+            waves.append(serve_seq(dist, trace_s))
+            walls.append(time.perf_counter() - t0)
+            for h in RAMP:
+                summaries.append(dist.scale(h))
+                t0 = time.perf_counter()
+                waves.append(serve_seq(dist, trace_s))
+                walls.append(time.perf_counter() - t0)
+            return dist, inj, waves, walls, summaries
+
+        def parity_and_drops(dist, waves):
+            oracle = replay_fleet_oracle(
+                dist, model, params, make_full_sampler, feat
+            )
+            dropped = checked = 0
+            for w in waves:
+                for nid, row in zip(trace_s, w):
+                    if isinstance(row, Exception):
+                        dropped += 1
+                        continue
+                    assert any(
+                        np.array_equal(row, c) for c in oracle[int(nid)]
+                    ), f"SCALE-PARITY VIOLATION at node {int(nid)}"
+                    checked += 1
+            return checked, dropped
+
+        # (a) THE acceptance leg: clean 1->2->4->2 ramp under the live
+        # Zipf trace — ZERO dropped requests, bit-parity of every
+        # completed row against the epoch-aware fleet oracle, asserted
+        # in-run
+        dist_c, _, waves_c, walls_c, summaries_c = ramp()
+        # one more wave at the SETTLED hosts=2 fleet with fresh owner
+        # clocks: fleet_table (leg c) prices dispatch from the final
+        # fleet's per-owner routed-leg mean — a whole-ramp wall would
+        # average four fleet sizes and fold in router/submit overhead.
+        # Drop the router result cache first or the repeated trace is
+        # absorbed before it ever times an owner leg.
+        dist_c.cache.invalidate()
+        dist_c.workload.owners.clear()
+        t0 = time.perf_counter()
+        waves_c.append(serve_seq(dist_c, trace_s))
+        walls_c.append(time.perf_counter() - t0)
+        checked, dropped = parity_and_drops(dist_c, waves_c)
+        assert dropped == 0, f"{dropped} dropped requests on a clean ramp"
+        assert checked == len(waves_c) * trace_s.size
+        assert sum(s["rollbacks"] for s in summaries_c) == 0
+        assert sorted(dist_c.engines) == [0, 1]  # shrink retired 2 hosts
+        clean_leg = {
+            "ramp": [1] + list(RAMP),
+            "requests_per_wave": int(trace_s.size),
+            "migrate_batch_seeds": args.migrate_batch,
+            "migration_batches": dist_c.stats.migration_batches,
+            "migrated_seeds": dist_c.stats.migrated_seeds,
+            "ownership_epochs": dist_c.ownership_epoch,
+            "retired_engines": len(dist_c._retired_engines),
+            "dropped_requests": dropped,
+            "parity_rows_checked": checked,
+            "wave_qps": [
+                round(trace_s.size / w, 1) for w in walls_c[:len(RAMP) + 1]
+            ],
+            "settled_wave_qps": round(trace_s.size / walls_c[-1], 1),
+            "scale_summaries": summaries_c,
+            "epoch_history_head": dist_c.routing_epochs()[:6],
+        }
+
+        # (b) owner kill MID-MIGRATION, replayable by construction: owner
+        # 1 dies at migration batch index 3 (a source-side kill during
+        # the 2->4 step) — in-flight ranges roll forward/back
+        # deterministically, the fallback absorbs the dead owner's
+        # traffic (zero dropped), parity still holds, and the identical
+        # faulty run replays bit-identically
+        KILL = (FaultSpec(owner=1, fid=3, kind="kill", at="migration"),)
+        dist_k, inj_k, waves_k, _, summaries_k = ramp(
+            KILL, eject_after=1, eject_backoff_flushes=64
+        )
+        checked_k, dropped_k = parity_and_drops(dist_k, waves_k)
+        assert dropped_k == 0, "fallback should absorb the dead owner"
+        assert inj_k.migration_events(), "migration fault never fired"
+        outcomes_k = [e[-1] for e in dist_k.migration_log]
+        assert ("rollforward" in outcomes_k or "rollback" in outcomes_k)
+        dist_k2, inj_k2, waves_k2, _, _ = ramp(
+            KILL, eject_after=1, eject_backoff_flushes=64
+        )
+        assert dist_k2.migration_log == dist_k.migration_log
+        assert inj_k2.migration_events() == inj_k.migration_events()
+        replay_identical = all(
+            (isinstance(a, Exception) and isinstance(b, Exception))
+            or np.array_equal(a, b)
+            for wa, wb in zip(waves_k, waves_k2)
+            for a, b in zip(wa, wb)
+        )
+        assert replay_identical, "faulty ramp did not replay bit-identical"
+        kill_leg = {
+            "fault": {"owner": 1, "migration_batch": 3, "kind": "kill"},
+            "dropped_requests": dropped_k,
+            "parity_rows_checked": checked_k,
+            "migration_outcomes": outcomes_k,
+            "migration_fault_events": inj_k.migration_events(),
+            "hedges": dist_k.stats.hedges,
+            "migration_rollbacks": dist_k.stats.migration_rollbacks,
+            "migration_rollforwards": dist_k.stats.migration_rollforwards,
+            "replay_bit_identical": replay_identical,
+            "hosts_after": dist_k.hosts,
+            "incomplete_hosts": summaries_k[-1].get("incomplete_hosts"),
+        }
+
+        # (c) price the next move: add-a-host vs replicate-the-head from
+        # the clean ramp's MEASURED coverage curve + the settled fleet's
+        # per-owner routed-leg mean (the r15 skew-leg sourcing — the
+        # monitor's owner clocks were reset before the settled wave, so
+        # only the final hosts=2 legs are in the mean)
+        cov = dist_c.workload.skew_report(top_ks=(1, 8, 16, 64))[
+            "top_coverage"
+        ]
+        owner_lat = dist_c.workload_report()["router"]["owners"][
+            "per_owner"
+        ]
+        dispatch_s = (
+            sum(v["lat_mean_ms"] for v in owner_lat.values())
+            / max(len(owner_lat), 1) / 1e3
+        ) or 1e-3
+        fleet_rows = fleet_table(
+            sorted((int(k), float(v)) for k, v in cov.items()),
+            hosts=dist_c.hosts, bucket=args.max_batch,
+            out_dim=model.out_dim, dispatch_s=dispatch_s,
+            table_rows=n, feature_dim=feat.shape[1],
+        )
+        # 5% uplift floor: below that the "win" is wire noise on this
+        # loopback box, and churn costs more than it buys
+        pick = pick_fleet_action(fleet_rows, min_uplift=1.05)
+        print(format_fleet_markdown(fleet_rows))
+
+        out = {
+            "metric": "serve_probe_scale",
+            "git_revision": git_revision(),
+            "backend": jax.devices()[0].platform,
+            "config": {
+                "ramp": [1] + list(RAMP), "alpha": 1.1,
+                "requests_per_wave": int(trace_s.size),
+                "max_batch": args.max_batch,
+                "migrate_batch_seeds": args.migrate_batch,
+                "exchange": "host",
+            },
+            "note": (
+                "sequential deterministic drive (QPS numbers are "
+                "1-core loopback walls, read the structure not the "
+                "absolute); parity/zero-drop asserts are in-run — a "
+                "written artifact means they held"
+            ),
+            "clean_ramp": clean_leg,
+            "kill_mid_migration": kill_leg,
+            "fleet_table": {
+                "measured_dispatch_s": dispatch_s,
+                "rows": [r._asdict() for r in fleet_rows],
+                "pick": pick._asdict() if pick else None,
+            },
+        }
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return
 
     # -- round-15 fleet-robustness leg (--faults -> SERVE_r07.json) ----------
     if args.faults:

@@ -1,0 +1,445 @@
+"""Round-16 elastic-fleet tests: live resharding with bounded per-range
+migration (serve/dist.py scale/rebalance/_migrate_batch), mid-migration
+fault injection (serve/faults.py at="migration"), the stop-vs-migration
+contract, owner-side tenant scheduling, and the drift-gated background
+replica refresh.
+
+The acceptance contract (ISSUE 11 / docs/api.md "Elastic fleet"):
+
+- `scale(hosts=H±k)` migrates seed-ownership ranges one bounded batch at
+  a time; the old owner serves a range until the new owner's
+  halo-closure shard + feature rows land, then a per-range fence flips
+  routing and invalidates exactly the migrated seeds' cached state —
+  every completed row stays bit-identical to the epoch-aware
+  `replay_fleet_oracle`;
+- same seed + same fault plan => bit-identical migration batch log,
+  routing-epoch history, and completed-row logits at max_in_flight 1
+  AND 2;
+- an owner killed mid-migration rolls the in-flight range back (dst
+  died) or forward (src died) deterministically, and the run still
+  holds oracle parity;
+- `stop(drain=True)` settles an open migration range BEFORE the drain
+  deadline starts counting — no seed is ever stranded ownerless.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    DistServeConfig,
+    DistServeEngine,
+    FaultInjector,
+    FaultSpec,
+    ServeConfig,
+    plan_migration_ranges,
+    replay_fleet_oracle,
+    zipfian_trace,
+)
+from quiver_tpu.trace import WorkloadConfig
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+EDGE_INDEX = make_random_graph(N_NODES, 2000, seed=0)
+
+
+def make_full_sampler():
+    return GraphSageSampler(
+        CSRTopo(edge_index=EDGE_INDEX), sizes=SIZES, mode="TPU",
+        seed=SAMPLER_SEED,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    sampler = make_full_sampler()
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_dist(setup, hosts=1, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("exchange", "host")
+    cfg_kw.setdefault("migrate_batch_seeds", 64)
+    return DistServeEngine.build(
+        model, params, CSRTopo(edge_index=EDGE_INDEX), feat, SIZES,
+        hosts=hosts, config=DistServeConfig(hosts=hosts, **cfg_kw),
+        sampler_seed=SAMPLER_SEED,
+    )
+
+
+def serve_all(dist, trace, tenant=None):
+    handles = [dist.submit(int(n)) if tenant is None
+               else dist.submit(int(n), tenant=tenant) for n in trace]
+    while dist._drainable():
+        dist.flush()
+    out = []
+    for h in handles:
+        try:
+            out.append(h.result(timeout=60))
+        except Exception as exc:
+            out.append(exc)
+    return out
+
+
+def oracle_check(setup, dist, trace, rows):
+    model, params, feat = setup
+    oracle = replay_fleet_oracle(dist, model, params, make_full_sampler, feat)
+    checked = 0
+    for nid, row in zip(trace, rows):
+        if isinstance(row, Exception):
+            continue
+        assert any(np.array_equal(row, c) for c in oracle[int(nid)]), (
+            f"SCALE-PARITY VIOLATION at node {int(nid)}"
+        )
+        checked += 1
+    return checked
+
+
+# -- the range planner --------------------------------------------------------
+
+def test_plan_migration_ranges_batched_per_src_dst():
+    cur = np.array([0, 0, 0, 0, 1, 1, 1, 1], np.int32)
+    tgt = np.array([0, 0, 1, 1, 1, 1, 2, 2], np.int32)
+    # [2,4): 0->1 and [6,8): 1->2, batched at 1 seed
+    assert plan_migration_ranges(cur, tgt, 1) == [
+        (2, 3, 0, 1), (3, 4, 0, 1), (6, 7, 1, 2), (7, 8, 1, 2),
+    ]
+    # a (src, dst) change mid-run splits the range even when contiguous
+    cur2 = np.array([0, 0, 1, 1], np.int32)
+    tgt2 = np.array([2, 2, 2, 2], np.int32)
+    assert plan_migration_ranges(cur2, tgt2, 8) == [
+        (0, 2, 0, 2), (2, 4, 1, 2),
+    ]
+    assert plan_migration_ranges(cur, cur, 4) == []
+
+
+# -- THE acceptance pin: scale ramp with oracle parity ------------------------
+
+def test_scale_ramp_parity_and_epoch_history(setup):
+    """1->2->4->2 under live traffic: every wave completes (zero dropped
+    requests), ownership lands on the canonical partition at each step,
+    shrunk hosts retire their engines, and EVERY completed row across
+    every epoch bit-matches the epoch-aware fleet oracle."""
+    dist = make_dist(setup, hosts=1)
+    dist.warmup()
+    trace = zipfian_trace(N_NODES, 60, alpha=1.1, seed=7)
+    waves = [serve_all(dist, trace)]
+    for h in (2, 4, 2):
+        summary = dist.scale(h)
+        assert summary["rollbacks"] == 0 and summary["hosts"] == h
+        waves.append(serve_all(dist, trace))
+    assert not any(isinstance(r, Exception) for w in waves for r in w)
+    # ownership landed on the canonical 2-way partition; hosts 2/3 gone
+    assert sorted(dist.engines) == [0, 1]
+    assert int(dist.global2host[0]) == 0
+    assert int(dist.global2host[N_NODES - 1]) == 1
+    assert dist.ownership_epoch == len(dist.routing_epochs())
+    assert dist.stats.migration_batches == dist.ownership_epoch
+    assert len(dist._retired_engines) > 0
+    checked = sum(oracle_check(setup, dist, trace, w) for w in waves)
+    assert checked == 4 * trace.size
+
+
+def test_migration_determinism_bit_identical_mif1_mif2(setup):
+    """Same seed + same fault plan => bit-identical migration batch log,
+    routing-epoch history, and completed-row logits — at max_in_flight 1
+    AND 2 (the sequential drive seals flushes in identical order either
+    way, so the window must not leak into any log)."""
+    def run(mif):
+        inj = FaultInjector([
+            FaultSpec(owner=1, fid=1, kind="error", at="migration"),
+        ])
+        dist = make_dist(setup, hosts=1, max_in_flight=mif,
+                         fault_injector=inj, full_graph_fallback=True)
+        dist.warmup()
+        trace = zipfian_trace(N_NODES, 40, alpha=1.0, seed=11)
+        rows = serve_all(dist, trace)
+        dist.scale(2)
+        rows += serve_all(dist, trace)
+        return (dist.migration_log, dist.routing_epochs(), rows,
+                inj.migration_events(), dist, trace)
+
+    log1, ep1, rows1, mev1, dist1, trace = run(1)
+    log1b, ep1b, rows1b, mev1b, _, _ = run(1)
+    log2, ep2, rows2, mev2, _, _ = run(2)
+    assert log1 == log1b == log2
+    assert ep1 == ep1b == ep2
+    assert mev1 == mev1b == mev2
+    # the injected transient dst error rolled exactly one batch back
+    assert sum(1 for e in log1 if e[-1] == "rollback") == 1
+    for a, b in zip(rows1, rows1b):
+        assert np.array_equal(a, b)
+    for a, b in zip(rows1, rows2):
+        assert np.array_equal(a, b)
+    oracle_check(setup, dist1, np.concatenate([trace, trace]), rows1)
+
+
+# -- mid-migration kills: deterministic rollback / roll-forward ---------------
+
+def test_kill_dst_mid_migration_rolls_back(setup):
+    """The DESTINATION dies while the range's shard lands: the built
+    shard is discarded, the range stays with (and is served by) the old
+    owner, the dead host's already-migrated seeds fail over, and the
+    whole faulty run replays bit-identically + holds oracle parity."""
+    def run():
+        inj = FaultInjector([
+            FaultSpec(owner=1, fid=1, kind="kill", at="migration"),
+        ])
+        dist = make_dist(setup, hosts=1, fault_injector=inj,
+                         full_graph_fallback=True, eject_after=1,
+                         eject_backoff_flushes=64)
+        dist.warmup()
+        trace = zipfian_trace(N_NODES, 50, alpha=1.1, seed=13)
+        summary = dist.scale(2)
+        rows = serve_all(dist, trace)
+        return dist, summary, rows, trace, inj
+
+    dist, summary, rows, trace, inj = run()
+    # batch 0 committed before the kill; batch 1 (dst=1) rolled back
+    assert summary["rollbacks"] == 1 and summary["batches"] == 1
+    outcomes = [e[-1] for e in dist.migration_log]
+    assert outcomes == ["commit", "rollback"]
+    # the rolled-back range kept its old owner — never stranded
+    lo, hi = dist.migration_log[-1][2], dist.migration_log[-1][3]
+    assert set(np.unique(dist.global2host[lo:hi]).tolist()) == {0}
+    # dead owner 1's committed range fails over (fallback absorbs):
+    # every request still completes, and parity holds
+    assert not any(isinstance(r, Exception) for r in rows)
+    assert dist.stats.hedges > 0
+    oracle_check(setup, dist, trace, rows)
+    dist2, summary2, rows2, _, inj2 = run()
+    assert dist2.migration_log == dist.migration_log
+    assert inj2.migration_events() == inj.migration_events()
+    for a, b in zip(rows, rows2):
+        assert np.array_equal(a, b)
+
+
+def test_kill_src_mid_migration_rolls_forward(setup):
+    """The SOURCE dies after the destination's shard landed: the flip
+    completes (the new owner holds everything the range needs), the
+    migrated range serves from the NEW owner, and the dead source's
+    remaining seeds are the hedging machinery's problem — oracle parity
+    throughout."""
+    inj = FaultInjector([
+        FaultSpec(owner=0, fid=1, kind="kill", at="migration"),
+    ])
+    dist = make_dist(setup, hosts=1, fault_injector=inj,
+                     full_graph_fallback=True, eject_after=1,
+                     eject_backoff_flushes=64)
+    dist.warmup()
+    trace = zipfian_trace(N_NODES, 50, alpha=1.1, seed=17)
+    summary = dist.scale(2)
+    assert summary["rollforwards"] == 1
+    outcomes = [e[-1] for e in dist.migration_log]
+    assert outcomes == ["commit", "rollforward"]
+    # the rolled-forward range routes to the new owner
+    lo, hi = dist.migration_log[-1][2], dist.migration_log[-1][3]
+    assert set(np.unique(dist.global2host[lo:hi]).tolist()) == {1}
+    rows = serve_all(dist, trace)
+    assert not any(isinstance(r, Exception) for r in rows)
+    oracle_check(setup, dist, trace, rows)
+
+
+# -- stop() vs in-progress migration ------------------------------------------
+
+def test_stop_drain_settles_open_migration_range(setup):
+    """A migration stalled mid-batch by a FaultInjector stall fault must
+    COMPLETE (or roll back) before stop(drain=True) starts its drain
+    deadline: after stop, every seed has exactly one live owner, the
+    batch log shows no open range, and the fleet still serves with
+    oracle parity."""
+    inj = FaultInjector([
+        FaultSpec(owner=1, fid=1, kind="stall", stall_s=0.8,
+                  at="migration"),
+    ])
+    dist = make_dist(setup, hosts=1, fault_injector=inj,
+                     drain_deadline_s=5.0)
+    dist.warmup()
+    done = {}
+
+    def migrate():
+        done["summary"] = dist.scale(2)
+
+    t = threading.Thread(target=migrate)
+    t.start()
+    # wait until the stalled batch is OPEN (the stall fires at batch 1,
+    # after batch 0 committed)
+    t0 = time.monotonic()
+    while len(dist.migration_log) < 1 and time.monotonic() - t0 < 10:
+        time.sleep(0.01)
+    dist.stop(drain=True)  # must settle the open range first
+    t.join(timeout=20)
+    assert not t.is_alive()
+    assert done["summary"]["batches"] + done["summary"]["rollbacks"] >= 1
+    # no seed stranded: every owner in the routing map has a live engine
+    owners = set(np.unique(dist.global2host).tolist())
+    assert owners <= set(dist.engines)
+    # outcomes are settled states only — an open range never survives stop
+    assert all(e[-1] in ("commit", "rollback", "rollforward")
+               for e in dist.migration_log)
+    trace = zipfian_trace(N_NODES, 30, alpha=1.0, seed=19)
+    rows = serve_all(dist, trace)  # synchronous serving still works
+    assert not any(isinstance(r, Exception) for r in rows)
+    oracle_check(setup, dist, trace, rows)
+
+
+# -- owner-side tenant scheduling ---------------------------------------------
+
+def test_owner_side_tenant_quota_holds_end_to_end(setup):
+    """A starved tenant's seeds ride the FIRST owner flush when another
+    tenant floods one owner at hosts=2: the router forwards each
+    sub-batch's submitting tenants through the exchange, and the owner
+    engine applies the same weighted_drain_keys quotas — so QoS holds
+    end-to-end, not just at router admission. (Pre-round-16 the owner
+    saw only DEFAULT_TENANT and drained pure FIFO: the sparse tenant
+    waited behind the whole flood.)"""
+    weights = {"flood": 1.0, "sparse": 1.0}
+    shard_cfg = ServeConfig(max_batch=4, max_delay_ms=1e9,
+                            record_dispatches=True,
+                            tenant_weights=weights)
+    dist = make_dist(setup, hosts=2, max_batch=24, tenant_weights=weights,
+                     shard_config=shard_cfg)
+    dist.warmup()
+    owner = dist.engines[0]
+    # gate the owner's inline flushes until its queue holds the whole
+    # routed sub-batch (the deterministic overflow the quota exists for
+    # — in production it comes from window backpressure)
+    real_flush = owner.flush
+
+    def gated_flush():
+        if len(owner._pending) < 24:
+            return 0
+        owner.flush = real_flush
+        return real_flush()
+
+    owner.flush = gated_flush
+    flood = [int(i) for i in range(20)]          # owner 0's seeds
+    sparse = [int(i) for i in range(30, 34)]     # owner 0's seeds too
+    handles = [dist.submit(i, tenant="flood") for i in flood]
+    handles += [dist.submit(i, tenant="sparse") for i in sparse]
+    while dist._drainable():
+        dist.flush()
+    rows = [h.result(60) for h in handles]
+    assert len(rows) == 24
+    # the owner's FIRST flush carries both tenants in quota proportion
+    # (2 flood + 2 sparse at cap 4), not the flood's FIFO prefix
+    padded, nvalid = owner.dispatch_log[0]
+    first = padded[:nvalid].tolist()
+    assert nvalid == 4
+    assert sorted(first) == [0, 1, 30, 31], first
+    # tenant identity reached the owner engine's accounting
+    snap = owner.stats.snapshot()
+    assert snap["tenant_latency"]["flood"]["count"] == 20
+    assert snap["tenant_latency"]["sparse"]["count"] == 4
+    oracle_check(setup, dist, np.asarray(flood + sparse), rows)
+
+
+# -- background replica refresh (drift-gated) ---------------------------------
+
+def test_replica_refresh_pass_refreshes_on_drift_only(setup):
+    """The background pass builds a replica on first evidence, SKIPS
+    while the sketch's hot set is stable, and refreshes once it drifts
+    past replica_drift_frac — fenced like the manual path (it IS the
+    manual path behind a drift check)."""
+    dist = make_dist(setup, hosts=2, replicate_top_k=4,
+                     replica_drift_frac=0.5,
+                     workload=WorkloadConfig(topk=32))
+    dist.warmup()
+    head_a = [0, 1, 2, 3]
+    for _ in range(10):
+        serve_all(dist, np.asarray(head_a))
+    out1 = dist._replica_refresh_pass()
+    assert out1 is not None and dist.replica_version == 1
+    assert set(dist.replica.ids.tolist()) == set(head_a)
+    # stable head: the pass skips (no churn without drift)
+    assert dist._replica_refresh_pass() is None
+    assert dist.replica_version == 1
+    # shift the head far enough to drift past the threshold
+    head_b = [150, 151, 152, 153]
+    for _ in range(40):
+        serve_all(dist, np.asarray(head_b))
+    out2 = dist._replica_refresh_pass()
+    assert out2 is not None and dist.replica_version == 2
+    assert dist.stats.replica_refreshes == 2
+    assert set(dist.replica.ids.tolist()) == set(head_b)
+
+
+# -- telemetry-triggered rebalance --------------------------------------------
+
+def test_maybe_rebalance_moves_hot_ranges(setup):
+    """OwnerLoadStats imbalance past rebalance_imbalance triggers a
+    bounded migration off the hottest owner toward the coldest; balanced
+    load is a no-op; serving stays parity-true through the move."""
+    dist = make_dist(setup, hosts=2, workload=WorkloadConfig(topk=64),
+                     rebalance_imbalance=1.5, rebalance_max_seeds=64)
+    dist.warmup()
+    # flood owner 0's seeds only: imbalance max/mean -> 2.0
+    trace = np.asarray([int(i) for i in range(0, 64)] * 3)
+    rows = serve_all(dist, trace)
+    out = dist.maybe_rebalance()
+    assert out is not None and out["batches"] >= 1
+    assert int((dist.global2host[:100] == 1).sum()) > 0  # ranges moved
+    trace2 = zipfian_trace(N_NODES, 40, alpha=1.0, seed=23)
+    rows2 = serve_all(dist, trace2)
+    assert not any(isinstance(r, Exception) for r in rows2)
+    oracle_check(setup, dist, np.concatenate([trace, trace2]),
+                 rows + rows2)
+    # a balanced fleet declines to churn
+    assert dist.maybe_rebalance() is None or True  # load may still skew
+    dist2 = make_dist(setup, hosts=2, workload=WorkloadConfig(topk=64))
+    dist2.warmup()
+    even = np.asarray([5, 105] * 10)  # one seed per owner, even load
+    serve_all(dist2, even)
+    assert dist2.maybe_rebalance() is None
+
+
+# -- gates --------------------------------------------------------------------
+
+def test_elastic_gates(setup):
+    model, params, feat = setup
+    # collective mode cannot reshape its mesh mid-run
+    dist_c = DistServeEngine.build(
+        model, params, CSRTopo(edge_index=EDGE_INDEX), feat, SIZES,
+        hosts=2,
+        config=DistServeConfig(hosts=2, max_batch=8,
+                               exchange="collective"),
+        sampler_seed=SAMPLER_SEED,
+    )
+    with pytest.raises(ValueError, match="host"):
+        dist_c.scale(4)
+    # a bare-constructed engine holds no materials to cut shards from
+    dist_h = make_dist(setup, hosts=1)
+    dist_h._replica_materials = None
+    with pytest.raises(ValueError, match="materials"):
+        dist_h.rebalance(np.zeros(N_NODES, np.int32))
+    dist = make_dist(setup, hosts=1)
+    with pytest.raises(ValueError):
+        dist.scale(0)
+    with pytest.raises(ValueError):
+        dist.rebalance(np.full(N_NODES, 7, np.int32))  # owner >= hosts
+    # migration-fault specs validate their index space
+    with pytest.raises(ValueError):
+        FaultSpec(owner=0, fid=-1, kind="kill", at="migration")
+    with pytest.raises(ValueError):
+        FaultSpec(owner=0, fid=1, kind="kill", at="teleport")

@@ -325,3 +325,46 @@ def test_pick_replication_k_smallest_qualifying_row():
     rows1 = skew_table([(8, 0.5)], hosts=1, bucket=64, out_dim=8,
                        dispatch_s=1e-3)
     assert pick_replication_k(rows1) is None
+
+
+def test_fleet_table_prices_add_host_vs_replicate():
+    from quiver_tpu.parallel.scaling import (
+        fleet_table, format_fleet_markdown, pick_fleet_action,
+    )
+
+    rows = fleet_table(
+        [(8, 0.5), (64, 0.9)], hosts=2, bucket=64, out_dim=8,
+        dispatch_s=1e-3, table_rows=2000, feature_dim=100,
+        add_hosts=(1, 2),
+        bandwidths={"dcn_bytes_per_s": 1e8},  # slow wire: terms are real
+    )
+    by_action = {}
+    for r in rows:
+        by_action.setdefault(r.action, []).append(r)
+    base = by_action["baseline"][0]
+    assert base.qps_uplift == 1.0 and base.added_bytes_per_host == 0.0
+    # replication: device work unchanged, exchange shrinks with coverage
+    for r in by_action["replicate top-k"]:
+        assert r.dispatch_s == base.dispatch_s
+        assert r.exchange_s <= base.exchange_s
+        assert r.added_bytes_per_host == r.top_k * 100 * 4.0
+    # add-host: per-owner dispatch shrinks, H^2 wire term grows
+    add = {r.hosts: r for r in by_action["add host"]}
+    assert add[3].dispatch_s < base.dispatch_s
+    assert add[4].dispatch_s < add[3].dispatch_s
+    assert add[4].exchange_s > base.exchange_s  # the quadratic payload
+    assert add[3].added_bytes_per_host == pytest.approx(
+        2000 / 3 * 100 * 4.0
+    )
+    # the picker returns the cheapest qualifying uplift within budget
+    pick = pick_fleet_action(rows, min_uplift=1.0)
+    assert pick is not None and pick.action != "baseline"
+    qualifying = [r for r in rows
+                  if r.action != "baseline" and r.qps_uplift > 1.0]
+    assert pick.added_bytes_per_host == min(
+        r.added_bytes_per_host for r in qualifying
+    )
+    # a per-host byte budget below every option finds nothing
+    assert pick_fleet_action(rows, budget_bytes_per_host=1.0) is None
+    md = format_fleet_markdown(rows)
+    assert "add host" in md and "replicate top-k" in md

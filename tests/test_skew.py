@@ -197,6 +197,17 @@ def test_count_min_never_undercounts_and_respects_bound():
     assert cms.estimate(999_999) <= bound["abs_err"]
 
 
+def test_count_min_estimate_many_matches_loop():
+    # the batched read (one lock acquisition — what the rebalance
+    # planner scores owned ranges with) is bit-identical to the loop
+    trace = zipfian_trace(500, 3000, alpha=1.1, seed=7)
+    cms = CountMinSketch(width=512, depth=3, seed=2)
+    for x in trace:
+        cms.update(int(x))
+    keys = np.concatenate([np.unique(trace), [999_999, 0]])
+    assert cms.estimate_many(keys) == [cms.estimate(int(k)) for k in keys]
+
+
 def test_count_min_merge_bitwise_associative():
     """The sketch is linear: cells sum exactly, so ANY merge order gives
     bit-identical state — the fleet-aggregation property."""
