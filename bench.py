@@ -647,6 +647,65 @@ def bench_e2e(context, bd, tiles, seeds_all, table, iters=None, classes=47, caps
         )
 
 
+def bench_stream(context, n=50_000, deg=8, edges_per_commit=512, reps=5):
+    """Round-17 streaming-graph delta-apply costs — the MEASURED inputs
+    of `scaling.delta_table` (``stream_append_s`` per edge,
+    ``stream_swap_s`` per batched device commit): one
+    `stream.StreamingTiledGraph` over a synthetic graph, a fresh
+    ``edges_per_commit``-edge `GraphDelta` applied per rep. The host
+    half (pad-lane writes + adjacency bookkeeping) is isolated on a
+    device_arrays=False twin, so the swap number is the batched
+    tile/bd row-scatter alone — the part a fenced `update_graph`
+    serializes against serving."""
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.stream import GraphDelta, StreamingTiledGraph
+
+    rng = np.random.default_rng(23)
+    src = np.repeat(np.arange(n, dtype=np.int64), deg)
+    dst = rng.integers(0, n, src.shape[0])
+    topo = CSRTopo(edge_index=np.stack([src, dst]))
+
+    import jax
+
+    def deltas(seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for _ in range(reps):
+            d = GraphDelta()
+            d.add_edges(r.integers(0, n, edges_per_commit),
+                        r.integers(0, n, edges_per_commit))
+            out.append(d)
+        return out
+
+    host = StreamingTiledGraph(topo, reserve_frac=0.5,
+                               device_arrays=False)
+    host.apply(deltas(1)[0])  # warm allocator paths
+    t0 = time.perf_counter()
+    for d in deltas(2):
+        host.apply(d)
+    host_s = (time.perf_counter() - t0) / reps
+    dev = StreamingTiledGraph(topo, reserve_frac=0.5)
+    dev.apply(deltas(1)[0])  # warm the bucketed scatter compiles
+    jax.block_until_ready(dev.graph()[1])
+    rows_before = dev.stats["tile_rows_swapped"]
+    t0 = time.perf_counter()
+    for d in deltas(2):
+        dev.apply(d)
+    jax.block_until_ready(dev.graph()[1])
+    total_s = (time.perf_counter() - t0) / reps
+    rows_per_commit = (dev.stats["tile_rows_swapped"] - rows_before) / reps
+    context["stream_append_s"] = round(host_s / edges_per_commit, 9)
+    context["stream_swap_s"] = round(max(total_s - host_s, 0.0), 6)
+    context["stream_edges_per_commit"] = edges_per_commit
+    context["stream_commit_spills"] = int(dev.stats["tile_spills"])
+    log(
+        f"stream delta apply: append {context['stream_append_s']*1e6:.2f} "
+        f"us/edge, batched device swap "
+        f"{context['stream_swap_s']*1e3:.2f} ms/commit "
+        f"({edges_per_commit} edges, {rows_per_commit:.0f} tile rows)"
+    )
+
+
 def bench_tier_rows(context, n=8192, dim=100, reps=5):
     """Round-14 per-row tier gather costs — the MEASURED inputs of
     `scaling.tier_table` (``tier_hbm_row_s`` / ``tier_host_row_s`` /
@@ -1467,6 +1526,13 @@ def main():
             log("budget exhausted before tier-row bench")
     except Exception as exc:
         log(f"tier-row bench failed: {exc}")
+    try:
+        if remaining() > 30:
+            bench_stream(context)
+        else:
+            log("budget exhausted before stream bench")
+    except Exception as exc:
+        log(f"stream bench failed: {exc}")
 
     seps_fused = results.get("fused", 0.0)
     print(

@@ -29,6 +29,8 @@ from .partition import (
 from . import comm, obs, pyg, tiers, trace
 from . import quant
 from . import serve
+from . import stream
+from .stream import GraphDelta, StreamingAdjacency, StreamingTiledGraph
 from .tiers import DiskShard, PlacementPlan, TierPlacement, TierStore
 from .quant import QuantizedFeature
 from .serve import DistServeConfig, DistServeEngine, ServeConfig, ServeEngine
@@ -72,6 +74,10 @@ __all__ = [
     "quant",
     "QuantizedFeature",
     "serve",
+    "stream",
+    "GraphDelta",
+    "StreamingAdjacency",
+    "StreamingTiledGraph",
     "DistServeConfig",
     "DistServeEngine",
     "ServeConfig",

@@ -326,6 +326,32 @@ class BucketPrograms:
         except TypeError:  # unhashable custom model: per-engine compiles only
             self._spec = None
 
+    def rebind(self, graph=None, table=None, index_map=None) -> None:
+        """Swap the persistent graph / feature-table arguments for
+        SAME-SHAPED updated arrays (round-17 streaming graph deltas: a
+        fenced ``update_graph`` commit produces new device arrays; the
+        executables take them as ARGUMENTS, so the swap is free — no
+        recompile, the sealed table stays sealed). A shape/dtype mismatch
+        raises instead of silently feeding the compiled avals garbage."""
+        if graph is not None:
+            if _aval_spec(graph) != _aval_spec(self._graph):
+                raise ValueError(
+                    "rebind graph avals differ from the compiled ones "
+                    f"({_aval_spec(graph)} vs {_aval_spec(self._graph)}) — "
+                    "streaming swaps contents, never shapes"
+                )
+            self._graph = graph
+        if table is not None:
+            if _aval_spec(table) != _aval_spec(self._table):
+                raise ValueError("rebind table avals differ from compiled")
+            self._table = table
+        if index_map is not None:
+            if self._map is None or _aval_spec(index_map) != _aval_spec(
+                self._map
+            ):
+                raise ValueError("rebind index_map avals differ from compiled")
+            self._map = index_map
+
     @property
     def buckets(self) -> Tuple[int, ...]:
         return tuple(sorted(self._exes))

@@ -1057,6 +1057,87 @@ def format_tier_markdown(rows: Sequence[TierPrediction]) -> str:
     return "\n".join(lines)
 
 
+class DeltaPrediction(NamedTuple):
+    name: str
+    edges_per_s: float       # offered edge-arrival rate
+    edges_per_commit: float  # arrivals accumulated per fenced commit
+    commit_s: float          # host appends + batched device tile swap
+    duty_frac: float         # commit wall over the commit period
+    fence_stall_s: float     # serving stall per commit (the fenced part)
+    sustainable: bool        # duty < 1 (the stream keeps up)
+
+
+def delta_table(
+    cases: Sequence[Tuple[str, float]],
+    append_s_per_edge: float,
+    swap_s_per_commit: float,
+    commit_period_s: float = 1.0,
+) -> List[DeltaPrediction]:
+    """Price streaming-graph ingest (round 17) from MEASURED per-edge
+    costs: "at edge rate R with a commit every ``commit_period_s``, what
+    does `update_graph` cost and does the stream keep up?"
+
+    ``cases`` is ``[(name, edges_per_s)]``. ``append_s_per_edge`` is the
+    host pad-lane apply cost per edge and ``swap_s_per_commit`` the
+    batched device tile-swap cost per commit — both measured by bench.py
+    (``stream_append_s`` / ``stream_swap_s``); this model invents no
+    constants. The whole commit runs under the update_params-style fence,
+    so ``fence_stall_s`` IS the per-commit serving stall — ``duty_frac``
+    (commit wall over period) is the fraction of wall the engine spends
+    fenced, and a case is ``sustainable`` only while that stays below 1.
+    Batching is the lever the table makes visible: the swap cost
+    amortizes over ``edges_per_commit``, so longer periods trade delta
+    visibility lag for lower duty.
+    """
+    if append_s_per_edge < 0 or swap_s_per_commit < 0:
+        raise ValueError("per-edge/per-commit costs must be >= 0")
+    if commit_period_s <= 0:
+        raise ValueError("commit_period_s must be > 0")
+    rows: List[DeltaPrediction] = []
+    for name, rate in cases:
+        rate = float(rate)
+        if rate < 0:
+            raise ValueError(f"edge rate must be >= 0 for case {name!r}")
+        per_commit = rate * commit_period_s
+        commit_s = per_commit * append_s_per_edge + swap_s_per_commit
+        duty = commit_s / commit_period_s
+        rows.append(
+            DeltaPrediction(
+                name=str(name),
+                edges_per_s=rate,
+                edges_per_commit=per_commit,
+                commit_s=commit_s,
+                duty_frac=duty,
+                fence_stall_s=commit_s,
+                sustainable=duty < 1.0,
+            )
+        )
+    return rows
+
+
+def format_delta_markdown(rows: Sequence[DeltaPrediction]) -> str:
+    lines = [
+        "| case | edges/s | edges/commit | commit ms | fence stall ms "
+        "| duty | sustainable |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r.name} | {r.edges_per_s:.0f} | {r.edges_per_commit:.0f} "
+            f"| {r.commit_s*1e3:.2f} | {r.fence_stall_s*1e3:.2f} "
+            f"| {r.duty_frac:.1%} | {'yes' if r.sustainable else 'NO'} |"
+        )
+    lines.append("")
+    lines.append(
+        "Streaming-graph ingest priced from MEASURED bench legs "
+        "(stream_append_s per edge, stream_swap_s per batched commit). "
+        "The commit runs fenced, so its wall is the per-commit serving "
+        "stall; longer commit periods amortize the swap at the cost of "
+        "delta visibility lag — the round-17 ingest planning table."
+    )
+    return "\n".join(lines)
+
+
 def format_skew_markdown(rows: Sequence[SkewPrediction]) -> str:
     lines = [
         "| replicated top-k | coverage | replica KB/host | exchange seeds | exchange bytes | exchange ms | routed flush ms | QPS uplift |",

@@ -554,6 +554,11 @@ class GraphSageSampler:
         self._dev_arrays = None
         self._dev_tiled = None
         self._w_dev = None
+        # round-17 streaming binding (`bind_stream`): when set, the tiled
+        # device graph is READ FROM THE STREAM at every sample/spec call
+        # instead of the frozen CSRTopo cache — fenced graph deltas become
+        # visible to the next draw without touching the key stream
+        self._stream = None
         # per-sampler probe-scan cache: under the default layout='tiled'
         # (and for weighted samplers) _engine() hands probe_hop_counts a
         # fresh sample_fn closure per call, so without this the jitted
@@ -569,6 +574,47 @@ class GraphSageSampler:
             return local[self.device % len(local)]
         return None
 
+    # -- streaming graph binding (round 17; quiver_tpu.stream) -----------
+    @property
+    def stream(self):
+        """The bound `stream.StreamingTiledGraph`, or None (frozen
+        graph). Serve engines read this to decide whether
+        ``update_graph`` is supported."""
+        return self._stream
+
+    def bind_stream(self, stream) -> "GraphSageSampler":
+        """Attach a `quiver_tpu.stream.StreamingTiledGraph`: every
+        sample (split path), fused-spec build, and `lazy_init_quiver`
+        then reads the stream's CURRENT device ``(bd, tiles)`` pair —
+        array objects change at each fenced delta commit, shapes never
+        do, so sealed AOT serve programs keep running (the engine
+        rebinds their argument arrays via `BucketPrograms.rebind`).
+        TPU-mode tiled uniform samplers only: HOST/CPU engines sample a
+        host CSR the stream does not maintain, the flat layout has no
+        pad lanes to append into, and weighted samplers would need the
+        weight tiles streamed in lockstep (not built — stage weights
+        with a rebuild instead)."""
+        if self.mode != "TPU":
+            raise TypeError("bind_stream needs mode='TPU' (device graph)")
+        if self.layout != "tiled":
+            raise TypeError(
+                "bind_stream needs layout='tiled' — the flat CSR has no "
+                "pad lanes to append into"
+            )
+        if self.weighted:
+            raise TypeError(
+                "streaming deltas keep the uniform tile map only; "
+                "weighted samplers would need wtiles streamed in lockstep"
+            )
+        self._stream = stream
+        self._dev_tiled = None
+        # the cached probe scan (calibrate_caps) bakes the graph arrays
+        # in as trace-time constants — sound for a frozen graph, stale
+        # the moment this sampler reads a stream (re-keyed per commit
+        # version in calibrate_caps)
+        self._probe_scan_cache.clear()
+        return self
+
     # -- device-graph binding (reference lazy_init_quiver, sage_sampler.py:98-113)
     def lazy_init_quiver(self):
         """Bind the graph to the device and return the binding: the
@@ -578,6 +624,8 @@ class GraphSageSampler:
         under ``layout='flat'``. Callers needing the flat pair regardless
         of layout should use ``self.csr_topo.to_device()``."""
         if self.layout == "tiled":
+            if self._stream is not None:
+                return self._stream.graph()
             if self._dev_tiled is None:
                 self._dev_tiled = self.csr_topo.to_device_tiled(self._device_obj())
             return self._dev_tiled
@@ -726,6 +774,18 @@ class GraphSageSampler:
                 def sample_fn(cur, cur_valid, k, key):
                     return _tiled_weighted_sample_layer_op(
                         bd, tiles, wtiles, cur, cur_valid, k, key, max_deg
+                    )
+            elif self._stream is not None:
+                # stream-bound: re-read the CURRENT device pair per draw
+                # (a fenced commit swaps the array objects; binding them
+                # into the closure once would sample the pre-delta graph
+                # forever)
+                stream = self._stream
+
+                def sample_fn(cur, cur_valid, k, key):
+                    bd_s, tiles_s = stream.graph()
+                    return _tiled_sample_layer_op(
+                        bd_s, tiles_s, cur, cur_valid, k, key
                     )
             else:
                 def sample_fn(cur, cur_valid, k, key):
@@ -910,6 +970,16 @@ class GraphSageSampler:
         if batches.ndim != 2:
             raise ValueError(f"probe_seeds must be [m, B]; got {batches.shape}")
         if self.mode == "TPU":
+            if self._stream is not None:
+                # the cached probe scan closes over the stream's graph
+                # arrays AS OF ITS TRACE — a delta commit leaves it
+                # probing a stale graph, so the cache lives one stream
+                # version only (probe_hop_counts keys entries by sizes;
+                # the version marker coexists under its own key)
+                ver = int(self._stream.version)
+                if self._probe_scan_cache.get("stream_version") != ver:
+                    self._probe_scan_cache.clear()
+                    self._probe_scan_cache["stream_version"] = ver
             indptr, indices, sample_fn, id_dtype = self._engine()
             counts = probe_hop_counts(
                 indptr, indices, self._next_key(),

@@ -26,6 +26,22 @@ admission (`submit(node, tenant=)`: weighted flush quotas, deterministic
 queue-depth shedding, per-tenant latency tails), and the deterministic
 `faults.FaultInjector` that proves all of it replayable.
 
+Round 17 makes the GRAPH live (docs/api.md "Streaming graphs"):
+`ServeEngine.update_graph(delta)` / `DistServeEngine.update_graph(delta)`
+commit edge arrivals behind the `update_params` fence — in-place pad-lane
+tile writes + batched device tile swaps over a bound
+`quiver_tpu.stream.StreamingTiledGraph` (gather-only sampling untouched,
+sealed AOT executables rebind arguments, never recompile), with the three
+consumers the round-10 fence never had: closure-touched cache
+invalidation at every grain, stale hot-set replicas dropped + rebuilt,
+and an immediate tier re-placement pass for delta-hot subgraphs. Owner
+shards extend their halo closures INCREMENTALLY (union-homomorphic BFS
+from the arrivals only; rows entering a closure install into reserved
+tile/feature capacity). Frozen-graph replay == delta-replay with an empty
+delta, and an appended edge is visible to the next sample after the
+commit returns. `trace_gen.delta_interleaved_trace` drives churn
+deterministically.
+
 Round 16 makes the fleet ELASTIC (docs/api.md "Elastic fleet"):
 `DistServeEngine.scale(hosts=H±k)` / `rebalance()` migrate seed
 ownership one bounded contiguous range at a time — the range's
@@ -69,11 +85,19 @@ from .engine import (
     default_buckets,
 )
 from .faults import FaultInjector, FaultSpec, OwnerFault, OwnerKilled
-from .trace_gen import poisson_arrivals, trace_skew_stats, zipfian_trace
+from .trace_gen import (
+    DeltaTrace,
+    delta_interleaved_trace,
+    poisson_arrivals,
+    trace_skew_stats,
+    zipfian_trace,
+)
 
 __all__ = [
     "ClosureFeature",
     "DEFAULT_TENANT",
+    "DeltaTrace",
+    "delta_interleaved_trace",
     "DistServeConfig",
     "DistServeEngine",
     "DistServeStats",

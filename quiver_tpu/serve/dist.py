@@ -444,12 +444,30 @@ class ClosureFeature:
     aggregation zeroes out — the same guarantee every padded pipeline here
     rides. Host ``__getitem__`` runs the identical clip/map/clip/take
     arithmetic, so split-path dispatches and parity replays are
-    value-identical to the fused gather."""
+    value-identical to the fused gather.
 
-    def __init__(self, rows: np.ndarray, local_map: np.ndarray):
-        self._rows = np.ascontiguousarray(np.asarray(rows, np.float32))
+    ``reserve_rows`` (round-17 streaming graphs) appends zeroed slack
+    rows so `install_rows` can land feature rows for nodes that ENTER the
+    closure under a graph delta without changing the table's shape —
+    sealed AOT executables take the table as an argument, so same-shape
+    swaps never recompile. Exhausting the reserve raises
+    `stream.StreamCapacityError` (capacity is planned, never silently
+    grown)."""
+
+    def __init__(self, rows: np.ndarray, local_map: np.ndarray,
+                 reserve_rows: int = 0):
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim != 2:
+            raise ValueError("ClosureFeature wants rows [C, D] and map [N]")
+        self._used = rows.shape[0]
+        if reserve_rows:
+            rows = np.concatenate(
+                [rows, np.zeros((int(reserve_rows), rows.shape[1]),
+                                np.float32)]
+            )
+        self._rows = np.ascontiguousarray(rows)
         self._map = np.asarray(local_map, np.int32)
-        if self._rows.ndim != 2 or self._map.ndim != 1:
+        if self._map.ndim != 1:
             raise ValueError("ClosureFeature wants rows [C, D] and map [N]")
         # hosts=1 (closure == everything): the map is the identity, so the
         # fused gather collapses to the plain-table program — the hosts=1
@@ -471,7 +489,83 @@ class ClosureFeature:
 
     @property
     def resident_rows(self) -> int:
+        """Rows holding real feature data (reserve slack excluded)."""
+        return self._used
+
+    @property
+    def capacity_rows(self) -> int:
         return self._rows.shape[0]
+
+    def preflight_install(self, node_ids) -> int:
+        """Reserve-capacity check for a batch of `install_rows` ids
+        WITHOUT mutating: raises the same `StreamCapacityError` an
+        install would, so multi-consumer commits (the dist router's
+        fleet-wide `update_graph`) can validate every owner before
+        mutating any. Returns the fresh slots the batch would take."""
+        from ..stream import StreamCapacityError
+
+        node_ids = np.asarray(node_ids, np.int64).reshape(-1)
+        if node_ids.size == 0:
+            return 0
+        fresh = int(np.count_nonzero(
+            self._map[np.unique(node_ids)] < 0
+        ))
+        if self._used + fresh > self._rows.shape[0]:
+            raise StreamCapacityError(
+                f"ClosureFeature reserve exhausted: batch installs "
+                f"{fresh} new rows, {self._rows.shape[0] - self._used} "
+                f"free of {self._rows.shape[0]} — rebuild with a larger "
+                "reserve_rows"
+            )
+        return fresh
+
+    def install_rows(self, node_ids, rows) -> int:
+        """Land feature rows for nodes newly entering the closure (the
+        round-17 incremental extension): each node takes the next free
+        reserve slot (a node already mapped is overwritten in place —
+        feature rows are static under topology deltas, so this only
+        happens on a re-install). ATOMIC: capacity is preflighted before
+        any slot moves, so a raising install leaves map, rows, and
+        device state untouched. Device state updates as a batched
+        same-shape row scatter, exactly like the tile swaps. Callers
+        must hold the owning engine's fence (the serve engines do)."""
+        from ..stream import _bucketed, _swap_rows
+
+        node_ids = np.asarray(node_ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, np.float32)
+        if rows.shape[0] != node_ids.shape[0] or rows.shape[1] != self.dim:
+            raise ValueError(
+                f"install rows {rows.shape} do not match "
+                f"{node_ids.shape[0]} nodes x dim {self.dim}"
+            )
+        if node_ids.size == 0:
+            return 0
+        self.preflight_install(node_ids)
+        slots = np.empty(node_ids.shape[0], np.int64)
+        for i, node in enumerate(node_ids):
+            node = int(node)
+            slot = int(self._map[node])
+            if slot < 0:
+                slot = self._used
+                self._used += 1
+                self._map[node] = slot
+            slots[i] = slot
+            self._rows[slot] = rows[i]
+        if self._dev is not None:
+            import jax.numpy as jnp
+
+            dev_rows, dev_map = self._dev
+            pos, vals = _bucketed(slots, rows, self._rows.shape[0])
+            dev_rows = _swap_rows(dev_rows, jnp.asarray(pos),
+                                  jnp.asarray(vals))
+            if dev_map is not None:
+                pos, vals = _bucketed(
+                    node_ids, self._map[node_ids], self._map.shape[0]
+                )
+                dev_map = _swap_rows(dev_map, jnp.asarray(pos),
+                                     jnp.asarray(vals))
+            self._dev = (dev_rows, dev_map)
+        return int(node_ids.size)
 
     def jit_gather_spec(self):
         import jax.numpy as jnp
@@ -489,6 +583,18 @@ class ClosureFeature:
         ids = np.clip(np.asarray(n_id), 0, self._map.shape[0] - 1)
         loc = np.clip(self._map[ids], 0, self._rows.shape[0] - 1)
         return jnp.asarray(self._rows[loc])
+
+
+def _feat_reserve(config, n_closure: int) -> int:
+    """`ClosureFeature` reserve rows for a closure shard of ``n_closure``
+    nodes: room for rows ENTERING the closure under streaming deltas
+    (sized like the tile reserve, off the same knob; 0 = frozen graph).
+    One formula for every shard build site — initial owners and
+    migration engines must agree or a migrated-in owner would exhaust
+    its reserve earlier than the fleet it joined."""
+    if not config.streaming:
+        return 0
+    return max(64, int(config.stream_reserve_frac * n_closure))
 
 
 @dataclass
@@ -653,6 +759,36 @@ class DistServeConfig:
     # 0 = manual refreshes only.
     replica_refresh_every_s: float = 0.0
     replica_drift_frac: float = 0.5
+    # -- round-17 streaming graphs (ROADMAP item 1; docs/api.md
+    # "Streaming graphs") -------------------------------------------------
+    # streaming: build() binds every owner shard (and the full-graph
+    # fallback) to a `stream.StreamingTiledGraph` so
+    # `update_graph(delta)` can commit live edge appends — in-place
+    # pad-lane tile writes + batched device tile swaps, the owner shards'
+    # halo closures extended INCREMENTALLY (never resharded). Requires
+    # feature_residency="closure" (owner feature rows install into the
+    # ClosureFeature reserve; the exchange residency's DistFeature
+    # partition already spans the full id space but its owners gather
+    # host-side — stream them by rebuilding). False = the frozen-graph
+    # engine, byte-for-byte round 16.
+    streaming: bool = False
+    # stream_reserve_frac: slack planned per owner at build, as a
+    # fraction of the built size — tile rows for spills/installs AND
+    # ClosureFeature rows for closure growth. Exhaustion raises
+    # stream.StreamCapacityError (plan capacity like sampler caps;
+    # shapes are frozen so sealed executables never recompile).
+    stream_reserve_frac: float = 0.5
+    # stream_invalidate_hops: reverse-closure depth of the delta cache
+    # invalidation (None = len(sizes) - 1, the expansion-hop count —
+    # see ServeConfig.stream_invalidate_hops).
+    stream_invalidate_hops: Optional[int] = None
+    # stream_replica_rebuild: when a delta's closure touches the live
+    # hot-set replica, the replica is DROPPED under the commit fence
+    # (its shard topology went stale — serving from it would draw from
+    # the pre-delta graph); True rebuilds it over the updated graph
+    # right after the fence, False leaves replication off until the
+    # next manual/drift refresh.
+    stream_replica_rebuild: bool = True
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -722,6 +858,17 @@ class DistServeStats:
     migration_rollforwards: int = 0
     migrated_seeds: int = 0
     replica_refreshes: int = 0
+    # round-17 streaming-graph counters: graph_deltas counts fenced
+    # update_graph commits, delta_edges the edges they appended,
+    # delta_cache_invalidated the closure-touched ROUTER cache drops,
+    # delta_closure_installs the owner-shard rows (topology installs)
+    # landed by incremental halo extension, replica_delta_invalidations
+    # the hot-set replicas dropped because a delta touched their closure
+    graph_deltas: int = 0
+    delta_edges: int = 0
+    delta_cache_invalidated: int = 0
+    delta_closure_installs: int = 0
+    replica_delta_invalidations: int = 0
     inflight_peak: int = 0
     sub_batches: Dict[int, int] = field(default_factory=dict)
     sub_batch_seeds: Dict[int, int] = field(default_factory=dict)
@@ -767,6 +914,11 @@ class DistServeStats:
             "migration_rollforwards": self.migration_rollforwards,
             "migrated_seeds": self.migrated_seeds,
             "replica_refreshes": self.replica_refreshes,
+            "graph_deltas": self.graph_deltas,
+            "delta_edges": self.delta_edges,
+            "delta_cache_invalidated": self.delta_cache_invalidated,
+            "delta_closure_installs": self.delta_closure_installs,
+            "replica_delta_invalidations": self.replica_delta_invalidations,
             "inflight_peak": self.inflight_peak,
             "sub_batches": dict(self.sub_batches),
             "mean_sub_batch_width": self.mean_sub_batch_width(),
@@ -951,6 +1103,30 @@ class DistServeEngine:
         self.migration_log: List[Tuple[int, int, int, int, int, int, int,
                                        str]] = []
         self._mig_index = 0          # monotonic handoff-batch counter
+        # -- round-17 streaming-graph state -------------------------------
+        # graph_version counts fenced delta commits at the ROUTER grain;
+        # pending_delta accumulates staged arrivals (stage_edges);
+        # _stream_adj is the host-side full-graph adjacency view (base
+        # CSR + appended edges — closures and materialization, no device
+        # bytes); _owner_streams/_owner_feats hold each owner's
+        # StreamingTiledGraph / ClosureFeature for the in-place apply;
+        # _materials_stale marks the build() materials' csr_topo as
+        # behind the stream (re-materialized lazily by
+        # `_current_full_topo` before a replica rebuild / migration
+        # build — NEVER on the serving path).
+        self.graph_version = 0
+        self.pending_delta = None
+        self._stream_adj = None
+        self._owner_streams: Dict[int, object] = {}
+        self._owner_feats: Dict[int, ClosureFeature] = {}
+        self._materials_stale = False
+        # serializes _stream_adj WRITES (update_graph's add/rollback)
+        # against the lazy re-materialize — replica/migration builds run
+        # OUTSIDE the router fence by design (AOT warmup costs seconds),
+        # so without this a background build could iterate the adjacency
+        # dicts mid-mutation or capture a mid-rollback graph. Ordering:
+        # router fence lock -> _mat_lock, never the reverse.
+        self._mat_lock = threading.Lock()
         # one range handoff is atomic under this lock; stop() takes it
         # before draining, so an open range always completes or rolls
         # back first and no seed is ever stranded ownerless
@@ -1066,6 +1242,13 @@ class DistServeEngine:
                 "feature_kw (tiered owner features) requires "
                 "feature_residency='exchange'"
             )
+        if config.streaming and residency != "closure":
+            raise ValueError(
+                "streaming graphs require feature_residency='closure' — "
+                "closure-entering nodes install into the ClosureFeature "
+                "reserve; the exchange residency's owners gather "
+                "host-side (rebuild to stream them)"
+            )
         # feature-exchange budget ("exchange" residency only): a shard
         # forward gathers up to the final padded n_id width of the largest
         # bucket, all of which could be remote in the worst case
@@ -1080,6 +1263,8 @@ class DistServeEngine:
         engines: Dict[int, ServeEngine] = {}
         topo_stats: Dict[int, Dict[str, float]] = {}
         owner_masks: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        owner_streams: Dict[int, object] = {}
+        owner_feats: Dict[int, ClosureFeature] = {}
         indptr_full = np.asarray(csr_topo.indptr, np.int64)
         indices_full = np.asarray(csr_topo.indices, np.int64)
         src_per_edge = np.repeat(
@@ -1113,6 +1298,16 @@ class DistServeEngine:
             sampler = GraphSageSampler(
                 topo_h, sizes=sizes, mode=sampler_mode, seed=sampler_seed, **kw
             )
+            if config.streaming:
+                # round 17: the owner shard becomes a streaming tile
+                # layout — update_graph commits land as in-place pad-lane
+                # writes + batched device tile swaps, never a reshard
+                from ..stream import StreamingTiledGraph
+
+                owner_streams[h] = StreamingTiledGraph(
+                    topo_h, reserve_frac=config.stream_reserve_frac
+                )
+                sampler.bind_stream(owner_streams[h])
             if residency == "closure":
                 # materialize the closure's rows ONCE (the rows the
                 # per-flush exchange would fetch) — the owner gather is
@@ -1124,7 +1319,12 @@ class DistServeEngine:
                 local_map[closure_ids] = np.arange(
                     closure_ids.shape[0], dtype=np.int32
                 )
-                shard_feat = ClosureFeature(feat[closure_ids], local_map)
+                shard_feat = ClosureFeature(
+                    feat[closure_ids], local_map,
+                    reserve_rows=_feat_reserve(config,
+                                               closure_ids.shape[0]),
+                )
+                owner_feats[h] = shard_feat
             else:
                 owned = np.nonzero(global2host == h)[0]
                 fkw = dict(feature_kw or {})
@@ -1175,11 +1375,26 @@ class DistServeEngine:
         }
         dist._owner_masks = owner_masks
         dist._src_per_edge = src_per_edge
+        if config.streaming:
+            from ..stream import StreamingAdjacency
+
+            dist._stream_adj = StreamingAdjacency(csr_topo)
+            dist._owner_streams = owner_streams
+            dist._owner_feats = owner_feats
         if config.full_graph_fallback:
             fb_sampler = GraphSageSampler(
                 csr_topo, sizes=sizes, mode=sampler_mode, seed=sampler_seed,
                 **kw,
             )
+            if config.streaming:
+                # the degraded-mode hedge target must see deltas too — a
+                # frozen fallback would serve pre-delta draws for any
+                # failed-over seed
+                from ..stream import StreamingTiledGraph
+
+                fb_sampler.bind_stream(StreamingTiledGraph(
+                    csr_topo, reserve_frac=config.stream_reserve_frac
+                ))
             dist.fallback = ServeEngine(model, params, fb_sampler, feat,
                                         shard_cfg)
         return dist
@@ -1863,6 +2078,256 @@ class DistServeEngine:
                 for slot in self._pending.values():
                     slot.version = self.params_version
 
+    # -- round-17 streaming graphs (ROADMAP item 1) -------------------------
+
+    def stage_edges(self, src, dst) -> int:
+        """Accumulate edge arrivals host-side into ``pending_delta`` —
+        observe-only until `update_graph` commits (mirrors
+        `ServeEngine.stage_edges`, including the stage-time id
+        validation: a bad arrival raises here and never poisons the
+        pending buffer)."""
+        from ..stream import GraphDelta, validate_edge_ids
+
+        src, dst = validate_edge_ids(
+            src, dst,
+            (self._stream_adj.n if self._stream_adj is not None
+             else self.global2host.shape[0]),
+            "staged",
+        )
+        with self._lock:
+            if self.pending_delta is None:
+                self.pending_delta = GraphDelta()
+            self.pending_delta.add_edges(src, dst)
+            n = len(self.pending_delta)
+        self.journal.emit("graph_delta", -1, -1, n)
+        return n
+
+    def _current_full_topo(self):
+        """The build()-time full topology, RE-MATERIALIZED from the
+        stream when graph deltas landed since (lazy: only the auxiliary
+        rebuild paths — replica refresh, migration shard builds — pay
+        the O(E) materialize; the serving path mutates tiles in place
+        and never touches this)."""
+        m = self._replica_materials
+        with self._mat_lock:
+            if self._stream_adj is not None and self._materials_stale:
+                m["csr_topo"] = self._stream_adj.to_csr_topo()
+                self._src_per_edge = None
+                self._materials_stale = False
+            return m["csr_topo"]
+
+    def update_graph(self, delta=None) -> Dict[str, object]:
+        """Commit a graph delta FLEET-WIDE behind the router's
+        `update_params` fence, with the three consumers the round-10
+        fence never had (ROADMAP item 1):
+
+        1. **Owner shards extend incrementally** — for each owner, the
+           delta's closure growth is BFS'd over the updated graph from
+           the arriving endpoints only (k-hop closures are
+           union-homomorphic: new mask = old mask OR the arrivals'
+           closure — the `closure_masks` argument the r16 migration path
+           rides, never a reshard). Rows already in the closure take
+           in-place pad-lane appends; rows ENTERING it install their
+           full adjacency into the owner stream's reserve, and their
+           feature rows land in the `ClosureFeature` reserve — the
+           owner's sealed fused executables just rebind arguments.
+        2. **Versioned node stamps invalidate caches** — every cached
+           seed whose expansion closure touched a changed row is dropped
+           at the ROUTER and at every owner (reverse k-hop closure over
+           the updated graph; everything else stays warm).
+        3. **Stale replicas drop** — a live hot-set replica whose
+           replicated seeds lie in the invalidation closure would keep
+           serving PRE-delta draws; it is retired under the fence
+           (oracle rules: dispatch logs kept) and, with
+           ``stream_replica_rebuild``, rebuilt over the updated graph
+           right after. (Tier re-placement, consumer (c), rides the
+           single-host `ServeEngine.update_graph` — tiered owner
+           features gather host-side and require the exchange
+           residency, which streaming rebuilds instead.)
+
+        The full-graph fallback commits the same delta so failed-over
+        seeds see it too. ``delta=None`` commits ``pending_delta``; an
+        empty commit is a strict no-op (frozen == empty-delta replay,
+        pinned). An appended edge is visible to the next routed sample
+        after this returns."""
+        from ..stream import GraphDelta
+
+        if self._stream_adj is None:
+            raise ValueError(
+                "streaming is off — build with "
+                "DistServeConfig(streaming=True)"
+            )
+        from_pending = delta is None
+        with self._lock:
+            if delta is None:
+                delta, self.pending_delta = self.pending_delta, None
+        if delta is None or len(delta) == 0:
+            return {"edges": 0, "graph_version": self.graph_version,
+                    "cache_invalidated": 0, "closure_installs": 0,
+                    "replica_invalidated": False}
+        src, dst = delta.edges()
+        m = self._replica_materials
+        sizes = list(m["sizes"])
+        hops = max(len(sizes) - 1, 0)
+        feat_hops = len(sizes)
+        inv_hops = self.config.stream_invalidate_hops
+        if inv_hops is None:
+            inv_hops = hops
+        m_feat = np.asarray(m["feat"], np.float32)
+        stale_replica_ids = None
+        installs_total = 0
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                adj = self._stream_adj
+                # _mat_lock covers the whole tentative-adjacency window
+                # (add -> plan/preflight -> commit-or-rollback): a
+                # background replica refresh / migration build
+                # re-materializing via `_current_full_topo` must never
+                # iterate the adjacency dicts mid-mutation or capture a
+                # graph that is about to roll back (ordering: router
+                # fence -> _mat_lock, per the lock's contract)
+                with self._mat_lock:
+                    adj.add_edges(src, dst)  # validates ids first
+                    # plan + preflight EVERY consumer over the updated
+                    # adjacency before mutating ANY owner — a capacity
+                    # error must leave the whole fleet (and the
+                    # adjacency, rolled back below) untouched, never one
+                    # owner committed and the next one not
+                    try:
+                        affected = adj.reverse_closure(np.unique(src),
+                                                       inv_hops)
+                        plans = []
+                        for h in sorted(self.engines):
+                            stream_h = self._owner_streams.get(h)
+                            if stream_h is None:
+                                continue
+                            topo_mask, feat_mask = self._owner_masks[h]
+                            # fixpoint over delta chains: an edge whose
+                            # src entered the mask via an EARLIER delta
+                            # edge of this batch extends it further.
+                            # EVERY dst of an in-mask src seeds a BFS —
+                            # including dsts already in the mask: a node
+                            # previously at the closure BOUNDARY (row
+                            # kept, own closure not) can now be reached
+                            # at a shallower depth and gets EXPANDED, so
+                            # its k-hop closure must enter the mask too
+                            # (the >=3-layer under-extension case; a
+                            # superset costs reserve rows, never
+                            # correctness)
+                            new_topo = topo_mask.copy()
+                            while True:
+                                seeds = np.unique(dst[new_topo[src]])
+                                if seeds.size == 0:
+                                    break
+                                add = adj.forward_closure(seeds, hops)
+                                if not (add & ~new_topo).any():
+                                    break
+                                new_topo |= add
+                            feat_seeds = np.unique(dst[new_topo[src]])
+                            new_feat = feat_mask | new_topo
+                            if feat_seeds.size:
+                                # one hop deeper than the adjacency
+                                # closure (leaves gathered, never
+                                # expanded)
+                                new_feat |= adj.forward_closure(
+                                    feat_seeds, feat_hops
+                                )
+                            topo_new = np.nonzero(new_topo & ~topo_mask)[0]
+                            installs = [(int(nd), adj.neighbors(int(nd)))
+                                        for nd in topo_new]
+                            rel = topo_mask[src]
+                            owner_delta = GraphDelta(src[rel], dst[rel])
+                            feat_new = np.nonzero(new_feat & ~feat_mask)[0]
+                            stream_h.preflight(owner_delta,
+                                               installs=installs)
+                            if feat_new.size:
+                                self._owner_feats[h].preflight_install(
+                                    feat_new
+                                )
+                            plans.append((h, new_topo, new_feat, installs,
+                                          owner_delta, feat_new))
+                        fb_stream = (getattr(self.fallback._sampler,
+                                             "stream", None)
+                                     if self.fallback is not None
+                                     else None)
+                        if fb_stream is not None:
+                            fb_stream.preflight(GraphDelta(src, dst))
+                    except BaseException:
+                        adj.pop_edges(src, dst)
+                        if from_pending:
+                            # a failed commit must not DROP staged
+                            # arrivals (ServeEngine.update_graph's
+                            # contract): re-staged ahead of anything
+                            # staged meanwhile — arrival order is the
+                            # replay order. _lock guards pending_delta
+                            # against a concurrent stage_edges (which
+                            # never takes the fence)
+                            with self._lock:
+                                if self.pending_delta is not None:
+                                    delta.extend(self.pending_delta)
+                                self.pending_delta = delta
+                        raise
+                    self._materials_stale = True
+                self.graph_version += 1
+                for (h, new_topo, new_feat, installs, owner_delta,
+                     feat_new) in plans:
+                    if feat_new.size:
+                        self._owner_feats[h].install_rows(
+                            feat_new, m_feat[feat_new]
+                        )
+                    if len(owner_delta) or installs:
+                        self.engines[h].update_graph(
+                            owner_delta, installs=installs,
+                            invalidate=affected,
+                        )
+                        installs_total += len(installs)
+                    self._owner_masks[h] = (new_topo, new_feat)
+                if self.fallback is not None:
+                    self.fallback.update_graph(
+                        GraphDelta(src, dst), invalidate=affected
+                    )
+                rep = self.replica
+                if (rep is not None and rep.ids.size
+                        and np.intersect1d(rep.ids, affected).size):
+                    # consumer (b): the replica's closure topology went
+                    # stale — retire it under the fence (oracle rules)
+                    # so no routed flush ever serves a pre-delta draw
+                    stale_replica_ids = rep.ids
+                    if rep.engine.config.record_dispatches:
+                        self._retired_replicas.append(rep.engine)
+                    else:
+                        self._retired_stats.merge(rep.engine.stats)
+                    self.replica = None
+                    self.replica_version += 1
+                    self.cache.invalidate_keys(
+                        int(x) for x in stale_replica_ids
+                    )
+                    self.stats.replica_delta_invalidations += 1
+                invalidated = self.cache.invalidate_keys(
+                    int(x) for x in affected
+                )
+                self.stats.graph_deltas += 1
+                self.stats.delta_edges += int(src.size)
+                self.stats.delta_cache_invalidated += invalidated
+                self.stats.delta_closure_installs += installs_total
+        self.journal.emit("delta_commit", -1, self.graph_version,
+                          int(src.size), invalidated)
+        out = {"edges": int(src.size),
+               "graph_version": self.graph_version,
+               "cache_invalidated": invalidated,
+               "affected_seeds": int(affected.size),
+               "closure_installs": installs_total,
+               "replica_invalidated": stale_replica_ids is not None}
+        if stale_replica_ids is not None and self.config.stream_replica_rebuild:
+            # rebuild OUTSIDE the fence (AOT warmup costs seconds;
+            # refresh_replicas takes the fence itself for the swap)
+            out["replica_refresh"] = self.refresh_replicas(
+                ids=stale_replica_ids
+            )
+        return out
+
     def adapt_tiers(self) -> Dict[int, Dict[str, object]]:
         """One fleet-wide promote/demote pass (round 14): fence the
         ROUTER (no routed flush in the air — the same drain as
@@ -1947,16 +2412,20 @@ class DistServeEngine:
             sizes = list(m["sizes"])
             # adjacency closure: len(sizes)-1 expansion hops; feature
             # closure one deeper (leaves gathered, never expanded) — the
-            # same construction as the owner shards in `build`
+            # same construction as the owner shards in `build`. The
+            # source topology is the CURRENT one: a streaming fleet
+            # re-materializes the full graph from the stream first, so a
+            # rebuilt replica serves post-delta draws (round 17).
+            full_topo = self._current_full_topo()
             topo_r, st, closure_ids = shard_topology_for_seeds(
-                m["csr_topo"], ids, hops=len(sizes) - 1,
+                full_topo, ids, hops=len(sizes) - 1,
                 closure_hops=len(sizes),
             )
             sampler = GraphSageSampler(
                 topo_r, sizes=sizes, mode=m["sampler_mode"],
                 seed=m["sampler_seed"], **m["sampler_kw"],
             )
-            n = m["csr_topo"].indptr.shape[0] - 1
+            n = full_topo.indptr.shape[0] - 1
             local_map = np.full(n, -1, np.int32)
             local_map[closure_ids] = np.arange(
                 closure_ids.shape[0], dtype=np.int32
@@ -2066,7 +2535,10 @@ class DistServeEngine:
         from ..pyg.sage_sampler import GraphSageSampler
 
         m = self._replica_materials
-        topo = m["csr_topo"]
+        # streaming fleets migrate over the UPDATED graph (lazy
+        # re-materialize; the masks stay valid — update_graph extends
+        # them at every commit)
+        topo = self._current_full_topo()
         indptr = np.asarray(topo.indptr, np.int64)
         indices = np.asarray(topo.indices, np.int64)
         n = indptr.shape[0] - 1
@@ -2094,19 +2566,30 @@ class DistServeEngine:
         local_map[closure_ids] = np.arange(closure_ids.shape[0],
                                            dtype=np.int32)
         feat_r = ClosureFeature(
-            np.asarray(m["feat"], np.float32)[closure_ids], local_map
+            np.asarray(m["feat"], np.float32)[closure_ids], local_map,
+            reserve_rows=_feat_reserve(self.config, closure_ids.shape[0]),
         )
         sampler = GraphSageSampler(
             shard, sizes=sizes, mode=m["sampler_mode"],
             seed=m["sampler_seed"], **m["sampler_kw"],
         )
+        new_stream = None
+        if self.config.streaming:
+            # a migrated-in owner must keep streaming: bind the extended
+            # shard to its own tile stream so later deltas apply in place
+            from ..stream import StreamingTiledGraph
+
+            new_stream = StreamingTiledGraph(
+                shard, reserve_frac=self.config.stream_reserve_frac
+            )
+            sampler.bind_stream(new_stream)
         with self._lock:
             params_snapshot = self._params
         eng = ServeEngine(
             m["model"], params_snapshot, sampler, feat_r, m["shard_config"]
         )
         eng.warmup()
-        return eng, (new_topo, new_feat), params_snapshot
+        return eng, (new_topo, new_feat), params_snapshot, new_stream, feat_r
 
     def _migrate_batch(self, lo: int, hi: int, src: int, dst: int) -> str:
         """Hand ONE bounded ownership range ``[lo, hi)`` from ``src`` to
@@ -2159,7 +2642,7 @@ class DistServeEngine:
                     self.stats.migration_rollbacks += 1
                 jr.emit("migrate_rollback", -1, mig, src, dst)
                 return "rollback"
-            eng, new_masks, params_snapshot = built
+            eng, new_masks, params_snapshot, new_stream, new_feat = built
             with self._seq:
                 with self._fence:
                     while self._inflight_flushes:
@@ -2177,6 +2660,9 @@ class DistServeEngine:
                             self._retired_stats.merge(old.stats)
                     self.engines[dst] = eng
                     self._owner_masks[dst] = new_masks
+                    if new_stream is not None:
+                        self._owner_streams[dst] = new_stream
+                        self._owner_feats[dst] = new_feat
                     self.global2host[lo:hi] = dst
                     self.ownership_epoch += 1
                     # range-scoped invalidation: exactly the migrated
@@ -2287,6 +2773,8 @@ class DistServeEngine:
                             eng = self.engines.pop(h, None)
                             self._owner_masks.pop(h, None)
                             self._owner_health.pop(h, None)
+                            self._owner_streams.pop(h, None)
+                            self._owner_feats.pop(h, None)
                             if eng is None:
                                 continue
                             if eng.config.record_dispatches:
@@ -2505,13 +2993,23 @@ class DistServeEngine:
                   "owner_ejections", "shed", "request_errors",
                   "undrained", "migration_batches", "migration_rollbacks",
                   "migration_rollforwards", "migrated_seeds",
-                  "replica_refreshes"):
+                  "replica_refreshes", "graph_deltas", "delta_edges",
+                  "delta_cache_invalidated", "delta_closure_installs",
+                  "replica_delta_invalidations"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"DistServeStats.{f}", labels)
         reg.gauge_fn(f"{prefix}_ownership_epoch",
                      lambda: self.ownership_epoch,
                      "committed ownership range flips", labels)
+        reg.gauge_fn(f"{prefix}_graph_version",
+                     lambda: self.graph_version,
+                     "fenced streaming-graph delta commits applied",
+                     labels)
+        reg.gauge_fn(f"{prefix}_delta_pending_edges",
+                     lambda: (len(self.pending_delta)
+                              if self.pending_delta is not None else 0),
+                     "edge arrivals staged and not yet committed", labels)
         reg.gauge_fn(f"{prefix}_hosts",
                      lambda: self.hosts,
                      "current serving fleet host count", labels)

@@ -395,6 +395,20 @@ class ServeConfig:
                      owner died mid-flush), remaining slots resolve with
                      `DrainTimeout` and are counted in
                      ``stats.undrained`` instead of hanging the caller.
+    stream_invalidate_hops : round-17 streaming graphs — reverse-closure
+                     depth of the delta cache invalidation (every cached
+                     seed within this many hops of a changed row is
+                     dropped at ``update_graph``). None (default) =
+                     ``len(sampler.sizes) - 1``, the exact number of
+                     EXPANSION hops: a changed row only alters a seed's
+                     draws if the seed can expand it, and the final
+                     hop's frontier is gathered but never expanded.
+    stream_adapt_tiers : run one fenced `adapt_tiers` pass right after a
+                     delta commit when the engine has an adaptive tier
+                     store + workload telemetry (round-17 consumer (c):
+                     a delta-hot subgraph pulls its rows off disk at the
+                     commit, not at the next background timer tick).
+                     False = timer/manual adaptation only.
     """
 
     max_batch: int = 64
@@ -416,6 +430,8 @@ class ServeConfig:
     tenant_weights: Optional[Dict[str, float]] = None
     max_queue_depth: int = 0
     drain_deadline_s: float = 30.0
+    stream_invalidate_hops: Optional[int] = None
+    stream_adapt_tiers: bool = True
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -545,6 +561,16 @@ class ServeStats:
     shed: int = 0               # requests refused at admission (round 15)
     request_errors: int = 0     # slots resolved with a per-request error
     undrained: int = 0          # slots abandoned by a bounded stop() drain
+    # round-17 streaming-graph counters: graph_deltas counts fenced
+    # update_graph commits, delta_edges the edges they appended,
+    # delta_tile_writes/spills the pad-lane vs relocation split (the
+    # layout-health signal: spills rising means the reserve is being
+    # eaten), delta_cache_invalidated the closure-touched cache drops
+    graph_deltas: int = 0
+    delta_edges: int = 0
+    delta_tile_writes: int = 0
+    delta_tile_spills: int = 0
+    delta_cache_invalidated: int = 0
     inflight_peak: int = 0
     dispatch_buckets: Dict[int, int] = field(default_factory=dict)
     cache: HitRateCounter = field(default_factory=HitRateCounter)
@@ -588,6 +614,11 @@ class ServeStats:
         self.shed += other.shed
         self.request_errors += other.request_errors
         self.undrained += other.undrained
+        self.graph_deltas += other.graph_deltas
+        self.delta_edges += other.delta_edges
+        self.delta_tile_writes += other.delta_tile_writes
+        self.delta_tile_spills += other.delta_tile_spills
+        self.delta_cache_invalidated += other.delta_cache_invalidated
         self.inflight_peak = max(self.inflight_peak, other.inflight_peak)
         for b, n in other.dispatch_buckets.copy().items():
             self.dispatch_buckets[b] = self.dispatch_buckets.get(b, 0) + n
@@ -614,6 +645,11 @@ class ServeStats:
             "shed": self.shed,
             "request_errors": self.request_errors,
             "undrained": self.undrained,
+            "graph_deltas": self.graph_deltas,
+            "delta_edges": self.delta_edges,
+            "delta_tile_writes": self.delta_tile_writes,
+            "delta_tile_spills": self.delta_tile_spills,
+            "delta_cache_invalidated": self.delta_cache_invalidated,
             "inflight_peak": self.inflight_peak,
             "dispatch_buckets": dict(self.dispatch_buckets),
             "cache": self.cache.snapshot(),
@@ -748,6 +784,12 @@ class ServeEngine:
         self.placement_version = 0
         self.tier_adapt_errors = 0  # failed background adapt passes
         self.params_version = 0
+        # round-17 streaming graphs: graph_version counts fenced delta
+        # commits (the analog of params_version for topology);
+        # pending_delta accumulates staged edge arrivals (stage_edges)
+        # until update_graph commits them — both guarded by _lock
+        self.graph_version = 0
+        self.pending_delta = None
         self.dispatch_log: List[Tuple[np.ndarray, int]] = []
         # queue state: _pending holds slots not yet flushed (insertion order
         # = FIFO), _inflight slots snapshot-ed by a running flush
@@ -1182,7 +1224,9 @@ class ServeEngine:
                   "padded_seeds", "dispatch_calls", "execute_calls",
                   "late_admitted", "tier_promoted", "tier_demoted",
                   "placement_batches", "shed", "request_errors",
-                  "undrained"):
+                  "undrained", "graph_deltas", "delta_edges",
+                  "delta_tile_writes", "delta_tile_spills",
+                  "delta_cache_invalidated"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"ServeStats.{f}", labels)
@@ -1207,6 +1251,14 @@ class ServeEngine:
         reg.gauge_fn(f"{prefix}_params_version",
                      lambda: self.params_version,
                      "current weights version", labels)
+        reg.gauge_fn(f"{prefix}_graph_version",
+                     lambda: self.graph_version,
+                     "fenced streaming-graph delta commits applied",
+                     labels)
+        reg.gauge_fn(f"{prefix}_delta_pending_edges",
+                     lambda: (len(self.pending_delta)
+                              if self.pending_delta is not None else 0),
+                     "edge arrivals staged and not yet committed", labels)
         reg.gauge_fn(f"{prefix}_placement_version",
                      lambda: self.placement_version,
                      "fenced tier-placement batches applied", labels)
@@ -1343,6 +1395,151 @@ class ServeEngine:
                 self.cache.invalidate()
                 for slot in self._pending.values():
                     slot.version = self.params_version
+
+    # -- streaming graph deltas (round 17; quiver_tpu.stream) --------------
+
+    def stage_edges(self, src, dst) -> int:
+        """Accumulate edge arrivals host-side into ``pending_delta``
+        (observe-only until a commit: no device state, no fence, no
+        served bit moves). Edge ids are validated HERE, against the
+        bound stream's node range, so one bad arrival raises at the
+        staging call site and never poisons the pending buffer (a commit
+        failure re-stages the delta — an unvalidated bad edge would
+        wedge every future ``update_graph``). Returns the pending-edge
+        count — the ``delta_pending_edges`` gauge reads the same
+        number."""
+        from ..stream import GraphDelta, validate_edge_ids
+
+        stream = getattr(self._sampler, "stream", None)
+        if stream is not None:
+            n = stream.n
+        else:
+            # not stream-bound (yet): validate against the sampler's own
+            # graph so a bad arrival still cannot poison the buffer — a
+            # later bind_stream + commit would otherwise wedge on it
+            topo = getattr(self._sampler, "csr_topo", None)
+            n = topo.node_count if topo is not None else None
+        src, dst = validate_edge_ids(src, dst, n, "staged")
+        with self._lock:
+            if self.pending_delta is None:
+                self.pending_delta = GraphDelta()
+            self.pending_delta.add_edges(src, dst)
+            n = len(self.pending_delta)
+        self.journal.emit("graph_delta", -1, -1, n)
+        return n
+
+    def update_graph(self, delta=None, *, installs=None,
+                     invalidate=None) -> Dict[str, object]:
+        """Commit a graph delta behind the SAME fence as `update_params`:
+        block new assembles (the sequencing lock), drain every in-flight
+        flush, apply the batch to the bound `stream.StreamingTiledGraph`
+        (host pad-lane writes / tile spills + ONE batched device tile
+        swap), bump ``graph_version``, rebind the sealed AOT programs'
+        graph/table arguments (`BucketPrograms.rebind` — same shapes, no
+        recompile), and invalidate exactly the embedding-cache entries
+        whose k-hop closure touched a delta row (the versioned-node-stamp
+        rule; ``invalidate=`` overrides with a precomputed set — the dist
+        router passes the fleet-global closure). After the fence, when
+        the engine has an adaptive tier store + workload telemetry and
+        ``stream_adapt_tiers`` is on, one `adapt_tiers` pass runs so a
+        delta-hot subgraph pulls its rows off disk NOW (round-17
+        consumer (c)).
+
+        ``delta=None`` commits (and clears) ``pending_delta``. An empty
+        commit is a strict no-op — no fence, no version bump, no bit
+        moves: frozen-graph replay == delta-replay with an empty delta,
+        pinned in tests/test_stream.py. The appended edges are visible to
+        the next sample after this returns (copy-all semantics: a draw
+        with fanout >= degree must include them)."""
+        stream = getattr(self._sampler, "stream", None)
+        if stream is None:
+            raise ValueError(
+                "update_graph needs a stream-bound sampler — build a "
+                "stream.StreamingTiledGraph over the topology and call "
+                "sampler.bind_stream(stream) before constructing the "
+                "engine"
+            )
+        from_pending = delta is None
+        with self._lock:
+            if delta is None:
+                delta, self.pending_delta = self.pending_delta, None
+        n_edges = 0 if delta is None else len(delta)
+        if n_edges == 0 and not installs:
+            return {"edges": 0, "installs": 0, "cache_invalidated": 0,
+                    "affected_seeds": 0, "graph_version": self.graph_version}
+        applied = False
+        try:
+            with self._seq:
+                with self._fence:
+                    while self._inflight_flushes:
+                        self._fence.wait()
+                    summary = stream.apply(delta, installs=installs)
+                    applied = True
+                    self.graph_version += 1
+                    if self._programs is not None:
+                        # sealed executables take the graph/table as
+                        # ARGUMENTS: swap same-shaped arrays, never
+                        # recompile. The table is re-read only for
+                        # features with a dynamic jit spec
+                        # (ClosureFeature installs); a plain table never
+                        # changes under a topology delta.
+                        table = imap = None
+                        if hasattr(self._feature, "jit_gather_spec"):
+                            from ..inference import feature_gather_spec
+
+                            table, imap = feature_gather_spec(self._feature)
+                        self._programs.rebind(graph=stream.graph(),
+                                              table=table, index_map=imap)
+                    if invalidate is not None:
+                        affected = np.asarray(list(invalidate), np.int64)
+                    elif n_edges:
+                        hops = self.config.stream_invalidate_hops
+                        if hops is None:
+                            hops = max(len(self._sampler.sizes) - 1, 0)
+                        affected = stream.affected_seeds(delta.sources(),
+                                                         hops)
+                    else:
+                        affected = np.array([], np.int64)
+                    invalidated = self.cache.invalidate_keys(
+                        int(x) for x in affected
+                    )
+                    self.stats.graph_deltas += 1
+                    self.stats.delta_edges += n_edges
+                    self.stats.delta_tile_writes += summary["pad_writes"]
+                    self.stats.delta_tile_spills += summary["tile_spills"]
+                    self.stats.delta_cache_invalidated += invalidated
+        except BaseException:
+            # `stream.apply` is atomic (preflight before any mutation),
+            # so a commit that raised BEFORE apply returned left the
+            # graph untouched — re-stage a pending-sourced delta so the
+            # staged edges survive the failure (ahead of anything staged
+            # meanwhile: arrival order is the replay order). A failure
+            # AFTER apply (e.g. an interrupt mid-invalidation) must NOT
+            # re-stage: the edges are committed, and replaying them
+            # would double-append
+            if from_pending and n_edges and not applied:
+                with self._lock:
+                    if self.pending_delta is not None:
+                        delta.extend(self.pending_delta)
+                    self.pending_delta = delta
+            raise
+        self.journal.emit("delta_commit", -1, self.graph_version,
+                          n_edges, invalidated)
+        summary["cache_invalidated"] = invalidated
+        summary["affected_seeds"] = int(affected.size)
+        summary["graph_version"] = self.graph_version
+        if (self.config.stream_adapt_tiers
+                and self._tier_feature is not None
+                and self.workload is not None):
+            # consumer (c): re-place tiers at the commit (adapt_tiers
+            # takes its own fence; a failing pass is counted, never fatal
+            # — the tier-daemon contract)
+            try:
+                summary["tier_adapt"] = self.adapt_tiers()
+            except Exception:
+                self.tier_adapt_errors += 1
+        return summary
+
     # -- adaptive tier placement (round 14) --------------------------------
 
     def apply_placement(self, plan) -> Dict[str, object]:

@@ -13,6 +13,8 @@ prefix and flatter the cache).
 
 from __future__ import annotations
 
+from typing import Iterator, NamedTuple, Tuple
+
 import numpy as np
 
 
@@ -44,6 +46,71 @@ def poisson_arrivals(
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(1.0 / qps, size=n_requests)
     return np.cumsum(gaps)
+
+
+class DeltaTrace(NamedTuple):
+    """A request trace with seeded EDGE-ARRIVAL events woven in (round
+    17): ``requests`` is a plain `zipfian_trace` (byte-identical to the
+    frozen-graph trace at the same arguments — the empty-delta parity
+    legs ride that); arrival event ``i`` commits edges
+    ``(edge_src[i], edge_dst[i])`` immediately BEFORE request index
+    ``edge_pos[i]`` is submitted. Everything is derived from the seed, so
+    probes and tests drive graph churn deterministically."""
+
+    requests: np.ndarray   # [n_requests] int64 node ids
+    edge_pos: np.ndarray   # [n_events] int64 request index per event
+    edge_src: np.ndarray   # [n_events, edges_per_event] int64
+    edge_dst: np.ndarray   # [n_events, edges_per_event] int64
+
+    @property
+    def n_events(self) -> int:
+        return int(self.edge_pos.shape[0])
+
+    def events(self) -> Iterator[Tuple[str, object, object]]:
+        """The interleaved schedule: yields ``("edges", src_row,
+        dst_row)`` then ``("request", index, node)`` in commit order —
+        the one iteration a driver loop needs."""
+        e = 0
+        for i, node in enumerate(self.requests):
+            while e < self.n_events and int(self.edge_pos[e]) == i:
+                yield ("edges", self.edge_src[e], self.edge_dst[e])
+                e += 1
+            yield ("request", i, int(node))
+
+
+def delta_interleaved_trace(
+    n_nodes: int,
+    n_requests: int,
+    alpha: float = 0.99,
+    seed: int = 0,
+    edge_every: int = 32,
+    edges_per_event: int = 4,
+) -> DeltaTrace:
+    """Weave seeded edge arrivals into a `zipfian_trace`: one event every
+    ``edge_every`` requests, each carrying ``edges_per_event`` new edges.
+    Sources are drawn from the PREFIX of the request trace served so far
+    (arrivals correlate with live traffic — new edges land on nodes the
+    cache and sketches already consider hot, the feed/fraud shape);
+    destinations are uniform, self-loops nudged off. The request stream
+    is byte-identical to ``zipfian_trace(n_nodes, n_requests, alpha,
+    seed)`` — delta events ride a separate seeded generator, so the
+    frozen-graph and streaming runs compare like for like."""
+    if edge_every <= 0 or edges_per_event <= 0:
+        raise ValueError("edge_every and edges_per_event must be > 0")
+    requests = zipfian_trace(n_nodes, n_requests, alpha=alpha, seed=seed)
+    rng = np.random.default_rng([int(seed), 0x5EED])
+    pos = np.arange(edge_every, n_requests, edge_every, dtype=np.int64)
+    k = pos.shape[0]
+    src = np.zeros((k, edges_per_event), np.int64)
+    dst = np.zeros((k, edges_per_event), np.int64)
+    for i, p in enumerate(pos):
+        picks = rng.integers(0, int(p), edges_per_event)
+        src[i] = requests[picks]
+        dst[i] = rng.integers(0, n_nodes, edges_per_event)
+    loops = src == dst
+    dst[loops] = (dst[loops] + 1) % n_nodes
+    return DeltaTrace(requests=requests, edge_pos=pos, edge_src=src,
+                      edge_dst=dst)
 
 
 def trace_skew_stats(trace: np.ndarray, top_frac: float = 0.01) -> dict:

@@ -1,0 +1,716 @@
+"""Streaming graph deltas: serve on a graph that changes under live
+traffic (ROADMAP item 1, round 17).
+
+Every layer built through round 16 — tiled sampling, fused one-dispatch
+serving, the disk tier, replication, elastic resharding — assumes a frozen
+CSR/tile map built once at ingest. The north-star workload (feeds, fraud
+graphs) streams edges continuously, and the access-stream papers
+(PyTorch-Direct, arxiv 2101.07956; GPU-side sampling invariants, arxiv
+2009.06693) both argue the same discipline: mutation must ride the
+existing GATHER-ONLY formulations, never reintroduce host-side rebuilds on
+the hot path.
+
+The 128-lane tile layout (`ops.sample.build_tiled_host`) makes that
+possible almost for free. A node's edges live LANE-aligned in a
+``[M, 128]`` tile table, so ceil-padding to 128 leaves ``cap - deg`` slack
+pad lanes in every node's last tile row — lanes the degree mask already
+gates out of every draw. An edge append is therefore:
+
+- **pad-lane write** (the common case): put the new neighbor in the next
+  slack lane and bump the node's degree — one tile-row write + one
+  ``(base, deg)`` row write, no relayout, no shape change;
+- **tile spill** (a node's allocated rows filled): relocate the node to
+  fresh rows from a pre-reserved region at the table's tail (copy its old
+  rows, bump ``base``), then write. The old rows become dead padding the
+  degree mask never reads. Reserve exhaustion raises
+  `StreamCapacityError` — capacity is planned like the sampler's static
+  caps, never silently grown (a shape change would invalidate every
+  AOT-sealed serve executable).
+
+Deltas accumulate HOST-SIDE in a :class:`GraphDelta` buffer and land on
+device as **batched tile swaps**: the touched tile rows (and bd rows) go
+through one jitted bucketed row-scatter per commit
+(`shard_tensor._scatter_rows` semantics — the same idiom the round-14 tier
+promotions ride). Scatter-building big arrays is the compile trap
+PERF_NOTES pins; a bounded ``[K, 128]`` row scatter into an EXISTING
+same-shaped array is not. Every sampler path stays gather-only and
+bit-replayable: the device arrays keep their shapes for the life of the
+stream, so the sealed `inference.BucketPrograms` executables keep running
+— `BucketPrograms.rebind` swaps the argument arrays, never recompiles.
+
+Parity discipline (pinned in tests/test_stream.py): a draw from the
+streamed ``(bd, tiles)`` is bit-equal to a draw from a tile table freshly
+built over the materialized updated CSR (`to_csr_topo`) on the same key —
+appends preserve per-row edge order (base edges first, arrivals after),
+and `ops.sample._tiled_resolve` reads positions through the ``base``
+indirection, so relocation changes no drawn bit. Frozen-graph replay is
+bit-identical to delta-replay with an empty delta, and an appended edge is
+visible to the NEXT sample after the commit returns (copy-all semantics:
+any draw with fanout >= deg must include it).
+
+`StreamingAdjacency` is the host bookkeeping half: the base CSR plus the
+appended edges, with forward k-hop closures (the dist router's incremental
+owner-shard extension) and reverse k-hop closures (the versioned-node-
+stamp invalidation set — every seed whose k-hop expansion could reach a
+changed row). The serve engines wire all of this through
+``update_graph(delta)`` — see `serve.engine.ServeEngine.update_graph` and
+docs/api.md "Streaming graphs".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .ops.sample import LANE, build_tiled_host
+from .shard_tensor import _bucket, _scatter_rows
+
+# The batched tile-swap primitive: one bounded [K, ...] row scatter into
+# an existing same-shaped device table, out-of-range positions dropped as
+# padding (`shard_tensor._scatter_rows` — the round-14 promotion idiom,
+# NOT the PERF_NOTES scatter-build trap). Named here because every delta
+# consumer (tile sync below, `ClosureFeature.install_rows` in serve/dist)
+# must commit through this one shape-stable path.
+_swap_rows = _scatter_rows
+
+__all__ = [
+    "GraphDelta",
+    "StreamCapacityError",
+    "StreamingAdjacency",
+    "StreamingTiledGraph",
+    "validate_edge_ids",
+]
+
+
+class StreamCapacityError(RuntimeError):
+    """The stream's reserved tile (or feature) rows are exhausted. The
+    fix is capacity planning, not silent growth: growing the device
+    arrays would change their shapes and invalidate every sealed AOT
+    serve executable — rebuild the stream with a larger
+    ``reserve_frac``/``reserve_tiles`` (the same contract as the
+    sampler's static caps)."""
+
+
+def validate_edge_ids(src, dst, n: Optional[int] = None,
+                      what: str = "delta",
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten an edge batch to matched int64 ``(src, dst)`` arrays and
+    (when ``n`` is given) range-check every id against ``[0, n)`` — the
+    one validation every staging/commit entry point shares, so a bad
+    arrival raises AT ITS CALL SITE and never poisons a pending buffer
+    (a commit failure re-stages the delta; an unvalidated bad edge would
+    wedge every future ``update_graph``)."""
+    src = np.asarray(src, np.int64).reshape(-1)
+    dst = np.asarray(dst, np.int64).reshape(-1)
+    if src.shape != dst.shape:
+        raise ValueError(f"src {src.shape} / dst {dst.shape} mismatch")
+    if n is not None:
+        bad = (src < 0) | (src >= n) | (dst < 0) | (dst >= n)
+        if bad.any():
+            raise ValueError(
+                f"{what} edge ids outside [0, {n}): "
+                f"{np.stack([src[bad], dst[bad]], 1)[:4].tolist()}"
+            )
+    return src, dst
+
+
+class GraphDelta:
+    """Host-side edge-arrival buffer: ``(src, dst)`` pairs in arrival
+    order, held as ndarray CHUNKS (one per staged batch — the ingest
+    path is measured by bench's ``stream_append_s``, so no per-edge
+    Python boxing). Accumulation is cheap and lock-free per instance
+    (the serve engines guard their pending buffer with their own lock);
+    nothing touches the device until a fenced ``update_graph``/``apply``
+    commits the whole batch. Deterministic: two buffers fed the same
+    arrivals apply identically."""
+
+    __slots__ = ("_src", "_dst", "_n")
+
+    def __init__(self, src=None, dst=None):
+        self._src: List[np.ndarray] = []
+        self._dst: List[np.ndarray] = []
+        self._n = 0
+        if src is not None or dst is not None:
+            if (src is None) != (dst is None):
+                raise ValueError("src/dst lengths differ")
+            self.add_edges(src, dst)
+
+    def add_edge(self, src: int, dst: int) -> None:
+        self._src.append(np.asarray([src], np.int64))
+        self._dst.append(np.asarray([dst], np.int64))
+        self._n += 1
+
+    def add_edges(self, src, dst) -> None:
+        src, dst = validate_edge_ids(src, dst)
+        if src.size:
+            # copies: the caller may reuse its arrival buffers after
+            # staging, and staged chunks are never mutated in place (so
+            # `extend` may share them across buffers)
+            self._src.append(src.copy())
+            self._dst.append(dst.copy())
+            self._n += int(src.size)
+
+    def extend(self, other: "GraphDelta") -> None:
+        self._src.extend(other._src)
+        self._dst.extend(other._dst)
+        self._n += other._n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def edges(self) -> Tuple[np.ndarray, np.ndarray]:
+        """``(src, dst)`` int64 arrays in arrival order."""
+        if not self._src:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        return np.concatenate(self._src), np.concatenate(self._dst)
+
+    def sources(self) -> np.ndarray:
+        """Sorted unique source ids — the rows whose degree (and hence
+        whose downstream draws) this delta changes. Destinations are new
+        LEAVES: they change no other row's draw, so invalidation closures
+        seed from sources only."""
+        if not self._src:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(self._src))
+
+    def clear(self) -> None:
+        self._src.clear()
+        self._dst.clear()
+        self._n = 0
+
+
+class StreamingAdjacency:
+    """Host bookkeeping for a streaming graph: an immutable base CSR plus
+    per-node appended-edge lists, answering the three questions the delta
+    layer asks — current neighbors (in tile-lane order: base first,
+    arrivals after), forward k-hop closures over the UPDATED graph (the
+    dist router's incremental owner-mask extension), and reverse k-hop
+    closures (the invalidation set: every node whose ``hops``-hop
+    expansion could reach a changed row). Reverse adjacency of the base
+    CSR is built once (O(E) counting sort); appended edges ride small
+    per-node dicts, so a bounded delta batch costs O(batch), never
+    O(E)."""
+
+    def __init__(self, csr_topo):
+        self.indptr = np.asarray(csr_topo.indptr, np.int64)
+        self.indices = np.asarray(csr_topo.indices, np.int64)
+        self.n = self.indptr.shape[0] - 1
+        self._extra: Dict[int, List[int]] = {}
+        self._rev_extra: Dict[int, List[int]] = {}
+        self._n_extra = 0
+        # reverse base CSR (counting sort, same construction as CSRTopo)
+        order = np.argsort(self.indices, kind="stable")
+        counts = np.bincount(self.indices, minlength=self.n)
+        self.rev_indptr = np.zeros(self.n + 1, np.int64)
+        np.cumsum(counts, out=self.rev_indptr[1:])
+        src_per_edge = np.repeat(
+            np.arange(self.n, dtype=np.int64),
+            self.indptr[1:] - self.indptr[:-1],
+        )
+        self.rev_indices = src_per_edge[order]
+
+    @property
+    def extra_edges(self) -> int:
+        return self._n_extra
+
+    def add_edges(self, src, dst) -> None:
+        src, dst = validate_edge_ids(src, dst, self.n)
+        for u, v in zip(src, dst):
+            self._extra.setdefault(int(u), []).append(int(v))
+            self._rev_extra.setdefault(int(v), []).append(int(u))
+        self._n_extra += src.shape[0]
+
+    def pop_edges(self, src, dst) -> None:
+        """Reverse a JUST-APPLIED `add_edges(src, dst)` — the caller's
+        rollback when a downstream capacity preflight fails after the
+        adjacency already advanced (dist `update_graph` computes its
+        closure plans over the updated view, then commits or rolls
+        back). Only valid as the exact inverse of the last add: entries
+        pop from the tails the add appended to."""
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        for u, v in zip(src[::-1], dst[::-1]):
+            self._extra[int(u)].pop()
+            self._rev_extra[int(v)].pop()
+        self._n_extra -= src.shape[0]
+
+    def neighbors(self, node: int) -> np.ndarray:
+        """Current adjacency of ``node`` in TILE-LANE order: the base CSR
+        row first, appended arrivals after (the order `to_csr_topo`
+        materializes and the tile writes preserve — draw parity rides
+        it)."""
+        node = int(node)
+        base = self.indices[self.indptr[node]:self.indptr[node + 1]]
+        extra = self._extra.get(node)
+        if not extra:
+            return base.copy()
+        return np.concatenate([base, np.asarray(extra, np.int64)])
+
+    def degree(self, node: int) -> int:
+        node = int(node)
+        return int(self.indptr[node + 1] - self.indptr[node]) + len(
+            self._extra.get(node, ())
+        )
+
+    def forward_closure(self, seeds, hops: int) -> np.ndarray:
+        """Bool [N] mask of nodes reachable from ``seeds`` within
+        ``hops`` hops over the UPDATED graph (seeds included) — the
+        incremental owner-shard extension input: k-hop closures are
+        union-homomorphic, so a dist owner's new mask is old-mask OR
+        this."""
+        mask = np.zeros(self.n, bool)
+        seeds = np.asarray(seeds, np.int64).reshape(-1)
+        if seeds.size == 0:
+            return mask
+        mask[seeds] = True
+        frontier = np.unique(seeds)
+        for _ in range(max(int(hops), 0)):
+            if frontier.size == 0:
+                break
+            nxt = self._expand(frontier, self.indptr, self.indices,
+                               self._extra)
+            nxt = nxt[~mask[nxt]]
+            if nxt.size == 0:
+                break
+            mask[nxt] = True
+            frontier = nxt
+        return mask
+
+    def reverse_closure(self, srcs, hops: int) -> np.ndarray:
+        """Sorted ids of every node within ``hops`` REVERSE hops of
+        ``srcs`` over the updated graph (srcs included) — the
+        invalidation set: a seed's k-hop sample can only change if its
+        expansion reaches a changed row, i.e. the seed lies in the
+        changed rows' ``hops``-reverse closure."""
+        srcs = np.unique(np.asarray(srcs, np.int64).reshape(-1))
+        if srcs.size == 0:
+            return srcs
+        mask = np.zeros(self.n, bool)
+        mask[srcs] = True
+        frontier = srcs
+        for _ in range(max(int(hops), 0)):
+            if frontier.size == 0:
+                break
+            nxt = self._expand(frontier, self.rev_indptr, self.rev_indices,
+                               self._rev_extra)
+            nxt = nxt[~mask[nxt]]
+            if nxt.size == 0:
+                break
+            mask[nxt] = True
+            frontier = nxt
+        return np.nonzero(mask)[0]
+
+    @staticmethod
+    def _expand(frontier, indptr, indices, extra):
+        """One BFS hop: base-CSR rows vectorized, appended edges via the
+        per-node dicts (bounded by the delta volume, never O(E))."""
+        parts = []
+        starts = indptr[frontier]
+        ends = indptr[frontier + 1]
+        widths = ends - starts
+        if widths.sum() > 0:
+            flat = np.concatenate([
+                indices[s:e] for s, e in zip(starts, ends) if e > s
+            ])
+            parts.append(flat)
+        if extra:
+            ext = [extra[int(u)] for u in frontier if int(u) in extra]
+            if ext:
+                parts.append(np.concatenate(
+                    [np.asarray(x, np.int64) for x in ext]
+                ))
+        if not parts:
+            return np.array([], np.int64)
+        return np.unique(np.concatenate(parts))
+
+    def to_csr_topo(self):
+        """Materialize the UPDATED graph as a fresh `CSRTopo` (base edges
+        first per row, arrivals after — exactly the tile-lane order, so a
+        sampler freshly built over the result draws bit-identically to
+        the streamed tiles). This is the replay-oracle / rebuild surface,
+        NOT the serving path — serving mutates tiles in place."""
+        from .utils import CSRTopo
+
+        if not self._extra:
+            return CSRTopo(indptr=self.indptr.copy(),
+                           indices=self.indices.copy())
+        extra_deg = np.zeros(self.n, np.int64)
+        for u, vs in self._extra.items():
+            extra_deg[u] = len(vs)
+        base_deg = self.indptr[1:] - self.indptr[:-1]
+        new_deg = base_deg + extra_deg
+        new_indptr = np.zeros(self.n + 1, np.int64)
+        np.cumsum(new_deg, out=new_indptr[1:])
+        new_indices = np.empty(int(new_indptr[-1]), np.int64)
+        # base block copy: each row's base edges land at its new offset
+        src_per_edge = np.repeat(np.arange(self.n, dtype=np.int64), base_deg)
+        pos_in_row = np.arange(self.indices.shape[0], dtype=np.int64) - (
+            np.repeat(self.indptr[:-1], base_deg)
+        )
+        new_indices[new_indptr[src_per_edge] + pos_in_row] = self.indices
+        for u, vs in self._extra.items():
+            lo = int(new_indptr[u] + base_deg[u])
+            new_indices[lo:lo + len(vs)] = vs
+        return CSRTopo(indptr=new_indptr, indices=new_indices)
+
+
+def _bucketed(idx: np.ndarray, rows: np.ndarray, sentinel: int,
+              floor: int = 64) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad a row-swap batch to a power-of-two bucket so the jitted
+    `shard_tensor._scatter_rows` commit (one bounded [K, ...] row
+    scatter into an existing same-shaped device table — the round-14
+    promotion idiom, NOT the PERF_NOTES scatter-build trap) compiles
+    once per bucket, not once per delta size."""
+    b = _bucket(idx.shape[0], floor=floor)
+    pos = np.full(b, sentinel, np.int32)
+    pos[: idx.shape[0]] = idx
+    padded = np.zeros((b,) + rows.shape[1:], rows.dtype)
+    padded[: idx.shape[0]] = rows
+    return pos, padded
+
+
+class StreamingTiledGraph:
+    """The delta layer over the 128-lane tile layout: host ``(bd, tiles)``
+    mirrors with reserved slack rows, in-place pad-lane appends + staged
+    tile spills, and batched device tile swaps (module docstring has the
+    design; docs/api.md "Streaming graphs" the contract).
+
+    Parameters
+    ----------
+    csr_topo : CSRTopo — the ingest-time graph. Kept immutable; appended
+        edges live in the stream's own state.
+    reserve_tiles : explicit spare tile-row count for spills (default:
+        ``ceil(reserve_frac * M)``, min 8). A spill relocates a node to
+        ``old_rows + grow_tiles`` fresh rows from this reserve;
+        exhaustion raises `StreamCapacityError` (plan capacity like
+        sampler caps — shapes are frozen at construction).
+    grow_tiles : extra tile rows granted per spill (>=1; each buys 128
+        more slack lanes before the node spills again).
+    device_arrays : build and maintain the device ``(bd, tiles)`` pair
+        (the serving path). False = host bookkeeping only (the dist
+        router's full-graph view costs no device HBM).
+    id_dtype : tile dtype; defaults to the same `_best_id_dtype` rule as
+        `CSRTopo.to_device_tiled`, so a streamed sampler and a frozen one
+        run byte-identical programs.
+
+    Thread safety: `apply`/`install_rows` mutate under one lock, but the
+    serve engines additionally FENCE every commit (update_params-style
+    drain) so no in-flight flush ever reads a half-applied batch — the
+    lock only orders bare concurrent callers.
+    """
+
+    def __init__(self, csr_topo, reserve_tiles: Optional[int] = None,
+                 reserve_frac: float = 0.5, grow_tiles: int = 1,
+                 device_arrays: bool = True, id_dtype=None):
+        from .utils import _best_id_dtype
+
+        self.csr_topo = csr_topo
+        self.adj = StreamingAdjacency(csr_topo)
+        self.n = self.adj.n
+        if id_dtype is None:
+            id_dtype = _best_id_dtype(self.n + 1)
+        bd, tiles = build_tiled_host(
+            self.adj.indptr, self.adj.indices, id_dtype
+        )
+        m = tiles.shape[0]
+        if reserve_tiles is None:
+            reserve_tiles = max(8, int(np.ceil(float(reserve_frac) * m)))
+        self.m_base = m
+        self.m_cap = m + int(reserve_tiles)
+        self.grow_tiles = max(int(grow_tiles), 1)
+        self.bd = np.ascontiguousarray(bd)  # [N, 2] int32 (base, deg)
+        self.tiles = np.zeros((self.m_cap, LANE), tiles.dtype)
+        self.tiles[:m] = tiles
+        deg = self.bd[:, 1].astype(np.int64)
+        self.alloc_rows = (-(-deg // LANE)).astype(np.int32)  # rows held
+        self._free_row = m
+        self.version = 0
+        # versioned node stamps: the graph version at which a node's row
+        # last changed — the invalidation consumers (cache / replicas /
+        # tier placement) compare against these instead of guessing
+        self.node_version = np.zeros(self.n, np.int64)
+        self.stats = {"pad_writes": 0, "tile_spills": 0, "installs": 0,
+                      "tile_rows_swapped": 0, "bd_rows_swapped": 0,
+                      "edges": 0}
+        self._lock = threading.Lock()
+        self._bd_dev = None
+        self._tiles_dev = None
+        if device_arrays:
+            import jax.numpy as jnp
+
+            self._bd_dev = jnp.asarray(self.bd)
+            self._tiles_dev = jnp.asarray(self.tiles)
+
+    # ------------------------------------------------------------ reads
+    @property
+    def free_rows(self) -> int:
+        return self.m_cap - self._free_row
+
+    def graph(self):
+        """The CURRENT device ``(bd, tiles)`` pair — what a stream-bound
+        `GraphSageSampler` samples from (`bind_stream`). Array objects
+        change at every commit; shapes never do."""
+        if self._tiles_dev is None:
+            raise ValueError(
+                "stream was built with device_arrays=False (host "
+                "bookkeeping only)"
+            )
+        return self._bd_dev, self._tiles_dev
+
+    def neighbors(self, node: int) -> np.ndarray:
+        return self.adj.neighbors(node)
+
+    def degree(self, node: int) -> int:
+        return self.adj.degree(node)
+
+    def to_csr_topo(self):
+        return self.adj.to_csr_topo()
+
+    def affected_seeds(self, srcs, hops: int) -> np.ndarray:
+        """The invalidation set of changed rows ``srcs``: every node
+        whose ``hops``-hop EXPANSION could reach one (reverse closure
+        over the updated graph, srcs included). ``hops`` is the number of
+        expansion hops — ``len(sizes) - 1`` for an L-layer sampler, since
+        the final hop's frontier is gathered but never expanded."""
+        return self.adj.reverse_closure(srcs, hops)
+
+    # ----------------------------------------------------------- writes
+    def preflight(self, delta: Optional[GraphDelta] = None,
+                  installs: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
+                  ) -> int:
+        """Validate a WHOLE batch — edge ids, install constraints, and
+        reserve capacity (spills simulated in apply order) — without
+        mutating anything. Returns the reserve rows the batch would
+        consume; raises `StreamCapacityError`/`ValueError` exactly where
+        `apply` would, BEFORE any state moves. `apply` runs this first,
+        which is what makes a commit atomic: it either lands fully
+        (host + device + version stamps) or leaves the stream untouched.
+        Multi-stream callers (the dist router) preflight every stream
+        before applying to any."""
+        src, dst = delta.edges() if delta is not None else (
+            np.array([], np.int64), np.array([], np.int64)
+        )
+        installs = list(installs or ())
+        with self._lock:
+            return self._preflight_locked(src, dst, installs)
+
+    def _preflight_locked(self, src, dst, installs) -> int:
+        if src.size:
+            validate_edge_ids(src, dst, self.n)
+        need = 0
+        sim_alloc: Dict[int, int] = {}
+        sim_deg: Dict[int, int] = {}
+        for node, nbrs in installs:
+            node = int(node)
+            nbrs = np.asarray(nbrs, np.int64)
+            if not 0 <= node < self.n:
+                raise ValueError(
+                    f"install node {node} outside [0, {self.n})"
+                )
+            if nbrs.size and ((nbrs < 0) | (nbrs >= self.n)).any():
+                # same contract as edge appends: a bad id raises here,
+                # never lands in the tiles (clipped gathers would
+                # silently read the last row otherwise)
+                raise ValueError(
+                    f"install neighbors of node {node} outside "
+                    f"[0, {self.n}): "
+                    f"{nbrs[(nbrs < 0) | (nbrs >= self.n)][:4].tolist()}"
+                )
+            if node in sim_deg:
+                raise ValueError(
+                    f"duplicate install for node {node} in one batch"
+                )
+            if int(self.bd[node, 1]) != 0:
+                raise ValueError(
+                    f"install_rows targets degree-0 rows only (node "
+                    f"{node} has degree {int(self.bd[node, 1])}); use "
+                    "apply() appends for materialized rows"
+                )
+            rows = -(-int(nbrs.size) // LANE)
+            need += rows
+            sim_alloc[node] = rows
+            sim_deg[node] = int(nbrs.size)
+        for u in src:
+            u = int(u)
+            d = sim_deg.get(u, int(self.bd[u, 1]))
+            a = sim_alloc.get(u, int(self.alloc_rows[u]))
+            if d >= a * LANE:
+                a += self.grow_tiles
+                need += a
+                sim_alloc[u] = a
+            sim_deg[u] = d + 1
+        free = self.m_cap - self._free_row
+        if need > free:
+            raise StreamCapacityError(
+                f"tile reserve exhausted: batch needs {need} rows, "
+                f"{free} free of {self.m_cap - self.m_base} reserved — "
+                "rebuild the stream with a larger reserve (shapes are "
+                "frozen; see StreamingTiledGraph docstring)"
+            )
+        return need
+
+    def apply(self, delta: GraphDelta,
+              installs: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
+              ) -> Dict[str, int]:
+        """Commit one delta batch: host pad-lane writes / spills /
+        installs, then ONE batched device tile swap + one bd swap.
+        ATOMIC: the whole batch is preflighted (ids, install
+        constraints, reserve capacity) before any state moves, so a
+        raising apply leaves host, device, versions, and the adjacency
+        untouched. Returns the commit summary. Callers serving traffic
+        go through ``engine.update_graph`` (which fences in-flight
+        flushes first); the stream's own lock only orders bare
+        concurrent callers."""
+        src, dst = delta.edges() if delta is not None else (
+            np.array([], np.int64), np.array([], np.int64)
+        )
+        installs = list(installs or ())
+        if src.size == 0 and not installs:
+            return {"edges": 0, "pad_writes": 0, "tile_spills": 0,
+                    "installs": 0, "tile_rows_swapped": 0,
+                    "bd_rows_swapped": 0, "free_rows": self.free_rows,
+                    "version": self.version}
+        with self._lock:
+            self._preflight_locked(src, dst, installs)
+            touched_tiles: set = set()
+            touched_bd: set = set()
+            pad_writes = spills = 0
+            for node, nbrs in installs:
+                self._install_locked(int(node), np.asarray(nbrs, np.int64),
+                                     touched_tiles, touched_bd)
+            if src.size:
+                # adjacency bookkeeping feeds closures (ids validated by
+                # the preflight above)
+                self.adj.add_edges(src, dst)
+                for u, v in zip(src, dst):
+                    p, s = self._append_locked(int(u), int(v),
+                                               touched_tiles, touched_bd)
+                    pad_writes += p
+                    spills += s
+            self.version += 1
+            changed = np.fromiter(touched_bd, np.int64, len(touched_bd))
+            self.node_version[changed] = self.version
+            n_tiles, n_bd = self._sync_device_locked(touched_tiles,
+                                                     touched_bd)
+            self.stats["pad_writes"] += pad_writes
+            self.stats["tile_spills"] += spills
+            self.stats["installs"] += len(installs)
+            self.stats["edges"] += int(src.size)
+            self.stats["tile_rows_swapped"] += n_tiles
+            self.stats["bd_rows_swapped"] += n_bd
+            return {"edges": int(src.size), "pad_writes": pad_writes,
+                    "tile_spills": spills, "installs": len(installs),
+                    "tile_rows_swapped": n_tiles, "bd_rows_swapped": n_bd,
+                    "free_rows": self.free_rows, "version": self.version}
+
+    def install_rows(self, rows: Sequence[Tuple[int, np.ndarray]]
+                     ) -> Dict[str, int]:
+        """Materialize full adjacency rows for nodes currently reading
+        degree 0 — the dist router's incremental halo-closure extension
+        (a node newly entering an owner's closure carries its WHOLE
+        current edge list, not an append). One batched commit like
+        `apply`."""
+        return self.apply(None, installs=rows)
+
+    # ------------------------------------------------------- internals
+    def _append_locked(self, u: int, v: int, touched_tiles, touched_bd):
+        base = int(self.bd[u, 0])
+        deg = int(self.bd[u, 1])
+        cap = int(self.alloc_rows[u]) * LANE
+        spilled = 0
+        if deg >= cap:
+            base = self._relocate_locked(u, touched_tiles)
+            spilled = 1
+        row = base + deg // LANE
+        self.tiles[row, deg % LANE] = v
+        self.bd[u, 1] = deg + 1
+        touched_tiles.add(row)
+        touched_bd.add(u)
+        return 1 - spilled, spilled
+
+    def _relocate_locked(self, u: int, touched_tiles) -> int:
+        """Move node ``u`` to ``alloc + grow_tiles`` fresh rows from the
+        reserve (copy its existing tiles, bump base). The old rows become
+        dead padding the degree mask never reads — draws are unchanged
+        because `ops.sample._tiled_resolve` only ever dereferences
+        ``base + pos // 128`` for valid positions."""
+        old_base = int(self.bd[u, 0])
+        old_rows = int(self.alloc_rows[u])
+        need = old_rows + self.grow_tiles
+        if self._free_row + need > self.m_cap:
+            raise StreamCapacityError(
+                f"tile reserve exhausted: node {u} needs {need} rows, "
+                f"{self.m_cap - self._free_row} free of "
+                f"{self.m_cap - self.m_base} reserved — rebuild the "
+                "stream with a larger reserve (shapes are frozen; see "
+                "StreamingTiledGraph docstring)"
+            )
+        new_base = self._free_row
+        self._free_row += need
+        if old_rows:
+            self.tiles[new_base:new_base + old_rows] = (
+                self.tiles[old_base:old_base + old_rows]
+            )
+        touched_tiles.update(range(new_base, new_base + old_rows + 1))
+        self.bd[u, 0] = new_base
+        self.alloc_rows[u] = need
+        return new_base
+
+    def _install_locked(self, node: int, nbrs: np.ndarray, touched_tiles,
+                        touched_bd) -> None:
+        if not 0 <= node < self.n:
+            raise ValueError(f"install node {node} outside [0, {self.n})")
+        if int(self.bd[node, 1]) != 0:
+            raise ValueError(
+                f"install_rows targets degree-0 rows only (node {node} "
+                f"has degree {int(self.bd[node, 1])}); use apply() "
+                "appends for materialized rows"
+            )
+        if nbrs.size == 0:
+            return
+        need = -(-int(nbrs.size) // LANE)
+        if self._free_row + need > self.m_cap:
+            raise StreamCapacityError(
+                f"tile reserve exhausted installing node {node} "
+                f"({need} rows needed, {self.m_cap - self._free_row} free)"
+            )
+        base = self._free_row
+        self._free_row += need
+        flat = self.tiles[base:base + need].reshape(-1)
+        flat[: nbrs.size] = nbrs.astype(self.tiles.dtype)
+        flat[nbrs.size:] = 0
+        self.bd[node, 0] = base
+        self.bd[node, 1] = nbrs.size
+        self.alloc_rows[node] = need
+        touched_tiles.update(range(base, base + need))
+        touched_bd.add(node)
+        # bookkeeping: an installed row's neighbors enter the adjacency
+        # view as "extras" over its empty base row (same lane order)
+        self.adj._extra[node] = [int(x) for x in nbrs]
+        for v in nbrs:
+            self.adj._rev_extra.setdefault(int(v), []).append(node)
+        self.adj._n_extra += int(nbrs.size)
+
+    def _sync_device_locked(self, touched_tiles, touched_bd):
+        n_tiles, n_bd = len(touched_tiles), len(touched_bd)
+        if self._tiles_dev is None or (not n_tiles and not n_bd):
+            return n_tiles, n_bd
+        import jax.numpy as jnp
+
+        if n_tiles:
+            idx = np.fromiter(touched_tiles, np.int64, n_tiles)
+            idx.sort()
+            pos, rows = _bucketed(idx, self.tiles[idx], self.m_cap)
+            self._tiles_dev = _scatter_rows(
+                self._tiles_dev, jnp.asarray(pos), jnp.asarray(rows)
+            )
+        if n_bd:
+            idx = np.fromiter(touched_bd, np.int64, n_bd)
+            idx.sort()
+            pos, rows = _bucketed(idx, self.bd[idx], self.n)
+            self._bd_dev = _scatter_rows(
+                self._bd_dev, jnp.asarray(pos), jnp.asarray(rows)
+            )
+        return n_tiles, n_bd

@@ -537,6 +537,13 @@ EVENT_KINDS = (
     "migrate",           # -, mig, a=lo, b=hi     range handoff began (build)
     "migrate_commit",    # -, mig, a=src, b=dst   routing flipped to dst
     "migrate_rollback",  # -, mig, a=src, b=dst   range stayed with src
+    # round-17 streaming-graph journal (policy markers; fid carries the
+    # engine's GRAPH VERSION for delta_commit, -1 for staged arrivals —
+    # the flush fold ignores both kinds, so the collision is harmless).
+    # OBSERVE-ONLY like every journal event: the observe-only parity rule
+    # stays pinned — journal on changes no served bit.
+    "graph_delta",       # -,  -,  a=pending      edges staged host-side
+    "delta_commit",      # -, ver, a=edges, b=invalidated   fenced commit
 )
 
 # rough per-event host bytes: 6-slot tuple + boxed floats/small ints. Used
@@ -558,6 +565,7 @@ def _fold_flush_events(events) -> Dict[int, Dict[str, float]]:
             "submit", "cache_hit", "coalesce", "late_admit", "assemble",
             "shed", "hedge", "eject",
             "migrate", "migrate_commit", "migrate_rollback",
+            "graph_delta", "delta_commit",
         ):
             continue
         f = flushes.setdefault(fid, {})
@@ -1161,6 +1169,12 @@ def chrome_trace_events(
                     # index, a/b the range or src/dst per EVENT_KINDS
                     instants.append(
                         (pid, t, kind, {"mig": fid, "a": a, "b": b})
+                    )
+                elif kind in ("graph_delta", "delta_commit"):
+                    # streaming-graph markers: fid carries the graph
+                    # version for commits (EVENT_KINDS)
+                    instants.append(
+                        (pid, t, kind, {"version": fid, "a": a, "b": b})
                     )
             items = []
             for fid, f in sorted(flushes.items()):

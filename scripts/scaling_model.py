@@ -55,6 +55,18 @@ def main():
                     help="analytic Zipf alpha for the replication table "
                          "when no --skew artifact is given")
     ap.add_argument("--skew-nodes", type=int, default=100_000)
+    # round-17 streaming-graph ingest pricing (delta_table): measured
+    # per-edge append + per-commit swap costs from bench.py's stream leg
+    # (context stream_append_s / stream_swap_s, picked up via --bench)
+    # or passed directly
+    ap.add_argument("--stream-append-us", type=float, default=None,
+                    help="host pad-lane apply cost per edge (us; bench "
+                         "stream_append_s)")
+    ap.add_argument("--stream-swap-ms", type=float, default=None,
+                    help="batched device tile-swap cost per commit (ms; "
+                         "bench stream_swap_s)")
+    ap.add_argument("--stream-commit-s", type=float, default=1.0,
+                    help="commit period for the ingest table")
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
 
@@ -82,12 +94,20 @@ def main():
                 serve_source = f"{args.bench} serve_sample_s/serve_forward_s"
         if args.serve_overhead_ms is None and ctx.get("serve_split_minus_fused_s"):
             serve_overhead_s = ctx["serve_split_minus_fused_s"]
+        if (args.stream_append_us is None
+                and ctx.get("stream_append_s") is not None):
+            args.stream_append_us = ctx["stream_append_s"] * 1e6
+        if (args.stream_swap_ms is None
+                and ctx.get("stream_swap_s") is not None):
+            args.stream_swap_ms = ctx["stream_swap_s"] * 1e3
     if not step_s:
         step_s = 0.0415  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
         source = "PERF_NOTES.md round-4 default 41.5 ms"
 
     from quiver_tpu.parallel.scaling import (
         ShapeMesh,
+        delta_table,
+        format_delta_markdown,
         format_fetch_markdown,
         format_markdown,
         format_quant_markdown,
@@ -324,6 +344,50 @@ def main():
         "read latency\nlabeled in config).\n\n"
         + format_tier_markdown(tier_rows)
     )
+    # -- round-17: streaming-graph ingest pricing (delta_table) ----------
+    # each cost labels its own provenance: one measured + one
+    # placeholder input must never read as "measured" wholesale (the
+    # tier/skew sections' labeling discipline), and an explicit 0 is a
+    # measurement, not "unset"
+    append_s = (10e-6 if args.stream_append_us is None
+                else args.stream_append_us / 1e6)
+    swap_s = (2e-3 if args.stream_swap_ms is None
+              else args.stream_swap_ms / 1e3)
+    if args.stream_append_us is not None and args.stream_swap_ms is not None:
+        delta_source = "measured bench stream_append_s/stream_swap_s"
+    elif args.stream_append_us is None and args.stream_swap_ms is None:
+        # labeled placeholders — swap for bench.py's stream leg via
+        # --bench BENCH_r*.json or the explicit flags
+        delta_source = (
+            "analytic placeholder costs (pass --bench or "
+            "--stream-append-us/--stream-swap-ms)"
+        )
+    else:
+        measured, missing = (
+            ("stream_append_s", "stream_swap_s (placeholder 2 ms)")
+            if args.stream_swap_ms is None
+            else ("stream_swap_s", "stream_append_s (placeholder 10 us)")
+        )
+        delta_source = (
+            f"measured bench {measured}; {missing} — pass both flags "
+            "or --bench for a fully measured table"
+        )
+    delta_rows = delta_table(
+        [("feed_trickle", 100), ("feed_busy", 2_000),
+         ("fraud_burst", 20_000), ("ingest_storm", 200_000)],
+        append_s_per_edge=append_s, swap_s_per_commit=swap_s,
+        commit_period_s=args.stream_commit_s,
+    )
+    delta_md = (
+        "## Streaming-graph ingest: delta-apply cost vs edge rate "
+        "(round 17)\n\n"
+        f"Cost source: {delta_source}; commit period "
+        f"{args.stream_commit_s} s.\nMeasured counterpart: "
+        "scripts/serve_probe.py --stream -> STREAM_r01.json (served "
+        "Zipf\ntrace under live edge appends, empty-delta bit-parity, "
+        "invalidation counts).\n\n"
+        + format_delta_markdown(delta_rows)
+    )
     print(md, file=sys.stderr)
     print("\n" + fetch_md, file=sys.stderr)
     print("\n" + quant_md, file=sys.stderr)
@@ -331,6 +395,7 @@ def main():
     print("\n" + serve_dist_md, file=sys.stderr)
     print("\n" + skew_md, file=sys.stderr)
     print("\n" + tier_md, file=sys.stderr)
+    print("\n" + delta_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -345,7 +410,8 @@ def main():
             fh.write(
                 header + md + "\n\n" + fetch_md + "\n\n" + quant_md
                 + "\n\n" + serve_md + "\n\n" + serve_dist_md
-                + "\n\n" + skew_md + "\n\n" + tier_md + "\n"
+                + "\n\n" + skew_md + "\n\n" + tier_md + "\n\n"
+                + delta_md + "\n"
             )
     print(json.dumps({
         "step_s_1chip": step_s,
@@ -365,6 +431,8 @@ def main():
         "serve_dist": [r._asdict() for r in dist_rows],
         "skew_source": skew_source,
         "skew_replication": [r._asdict() for r in skew_rows],
+        "delta_source": delta_source,
+        "delta_table": [r._asdict() for r in delta_rows],
     }))
 
 

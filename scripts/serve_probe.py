@@ -179,6 +179,16 @@ def main():
                     help="SIMULATED per-row cold-read latency (this box's "
                          "page cache makes flat-file reads DRAM-speed; "
                          "production disk is not; 0 = raw page cache)")
+    ap.add_argument("--stream", action="store_true",
+                    help="round-17 streaming-graph leg: serve a Zipf "
+                         "trace while appending edges at a fixed rate — "
+                         "zero dropped requests, empty-delta bit-parity "
+                         "vs the frozen run, closure-touched "
+                         "invalidation counts (-> STREAM_r01.json)")
+    ap.add_argument("--stream-requests", type=int, default=400)
+    ap.add_argument("--stream-edge-every", type=int, default=40,
+                    help="requests between edge-arrival events")
+    ap.add_argument("--stream-edges-per-event", type=int, default=4)
     ap.add_argument("--scale", action="store_true",
                     help="round-16 elastic-fleet leg: ramp a Zipf trace "
                          "1->2->4->2 hosts with live resharding, zero "
@@ -331,6 +341,226 @@ def main():
                     )
                     parity_rows += 1
         return dist, trace, wall, parity_rows
+
+    # -- round-17 streaming-graph leg (--stream -> STREAM_r01.json) ----------
+    if args.stream:
+        from quiver_tpu.ops.sample import tiled_sample_layer
+        from quiver_tpu.serve import delta_interleaved_trace
+        from quiver_tpu.stream import GraphDelta, StreamingTiledGraph
+
+        dt = delta_interleaved_trace(
+            n, args.stream_requests, alpha=1.1, seed=31,
+            edge_every=args.stream_edge_every,
+            edges_per_event=args.stream_edges_per_event,
+        )
+        # cross-community arrivals: half the destinations re-drawn into
+        # a DIFFERENT community than their source, so commits exercise
+        # real closure extension, not just pad-lane appends
+        rng_x = np.random.default_rng(32)
+        per_comm = n // 4
+        for i in range(dt.n_events):
+            for j in range(0, args.stream_edges_per_event, 2):
+                cu = int(dt.edge_src[i, j]) // per_comm
+                cv = (cu + 1 + rng_x.integers(0, 3)) % 4
+                dt.edge_dst[i, j] = cv * per_comm + rng_x.integers(
+                    0, per_comm
+                )
+
+        def make_single(stream=None):
+            smp = GraphSageSampler(topo, sizes=SIZES, mode="TPU",
+                                   seed=SEED)
+            if stream is not None:
+                smp.bind_stream(stream)
+            return ServeEngine(
+                model, params, smp, feat,
+                ServeConfig(max_batch=args.max_batch,
+                            max_delay_ms=1e9,
+                            record_dispatches=True),
+            )
+
+        # (a) PARITY LEG: frozen-graph run vs streaming run committing an
+        # EMPTY delta at every event position — bit-identical logits and
+        # dispatch logs, asserted in-run
+        eng_f = make_single()
+        eng_f.warmup()
+        rows_f = [eng_f.predict([node])[0]
+                  for _, _, node in
+                  (e for e in dt.events() if e[0] == "request")]
+        stream_e = StreamingTiledGraph(topo, reserve_frac=0.5)
+        eng_e = make_single(stream_e)
+        eng_e.warmup()
+        rows_e = []
+        for ev in dt.events():
+            if ev[0] == "edges":
+                s = eng_e.update_graph(GraphDelta())
+                assert s["edges"] == 0 and eng_e.graph_version == 0
+            else:
+                rows_e.append(eng_e.predict([ev[2]])[0])
+        assert all(np.array_equal(a, b) for a, b in zip(rows_f, rows_e)), \
+            "EMPTY-DELTA PARITY VIOLATION"
+        assert len(eng_f.dispatch_log) == len(eng_e.dispatch_log)
+        for (pa, na), (pb, nb) in zip(eng_f.dispatch_log,
+                                      eng_e.dispatch_log):
+            assert na == nb and np.array_equal(pa, pb)
+        parity_rows = len(rows_f)
+
+        # (b) LIVE single-host stream: commit real deltas at the event
+        # positions, count closure-touched invalidations, assert
+        # per-commit visibility (copy-all draw of the appended source
+        # must include the new destination), zero dropped requests
+        stream_l = StreamingTiledGraph(topo, reserve_frac=0.5)
+        eng_l = make_single(stream_l)
+        eng_l.warmup()
+        commits = []
+        dropped = visibility_checked = 0
+        t0 = time.perf_counter()
+        for ev in dt.events():
+            if ev[0] == "edges":
+                d = GraphDelta()
+                d.add_edges(ev[1], ev[2])
+                s = eng_l.update_graph(d)
+                commits.append({
+                    "edges": s["edges"],
+                    "pad_writes": s["pad_writes"],
+                    "tile_spills": s["tile_spills"],
+                    "affected_seeds": s["affected_seeds"],
+                    "cache_invalidated": s["cache_invalidated"],
+                })
+                u, v = int(ev[1][0]), int(ev[2][0])
+                k = stream_l.degree(u)
+                bd_d, tiles_d = stream_l.graph()
+                nb, vl = tiled_sample_layer(
+                    bd_d, tiles_d, jnp.asarray([u]),
+                    jnp.ones((1,), bool), k, jax.random.key(7),
+                )
+                assert v in set(
+                    np.asarray(nb)[0][np.asarray(vl)[0]].tolist()
+                ), "VISIBILITY VIOLATION: appended edge not drawable"
+                visibility_checked += 1
+            else:
+                try:
+                    eng_l.predict([ev[2]])
+                except Exception:
+                    dropped += 1
+        wall_live = time.perf_counter() - t0
+        assert dropped == 0, f"{dropped} dropped requests under streaming"
+        assert sum(c["cache_invalidated"] for c in commits) > 0
+
+        # (c) STREAMING FLEET at hosts=2 with replication: same schedule
+        # through the routed engine; every completed row must bit-match
+        # a pre- or post-delta full-graph oracle candidate
+        # reserve 1.0x the built size: cross-community arrivals pull
+        # whole communities into an owner's closure, so the fleet plans
+        # for up to a full doubling (capacity planning IS the contract —
+        # exhaustion is a loud StreamCapacityError, never silent growth)
+        cfg2 = DistServeConfig(
+            hosts=2, max_batch=args.max_batch, max_delay_ms=1e9,
+            exchange="host", record_dispatches=True, streaming=True,
+            stream_reserve_frac=1.0,
+            replicate_top_k=16, workload=WorkloadConfig(topk=64),
+        )
+        dist = DistServeEngine.build(
+            model, params, topo, feat, SIZES, hosts=2, config=cfg2,
+            sampler_seed=SEED,
+        )
+        dist.warmup()
+        rows_d, nodes_d = [], []
+        dropped_d = 0
+        refreshed = False
+        topo_versions = [topo]  # every graph version the fleet served
+        t0 = time.perf_counter()
+        for ev in dt.events():
+            if ev[0] == "edges":
+                dist.stage_edges(ev[1], ev[2])
+                s = dist.update_graph()
+                topo_versions.append(dist._stream_adj.to_csr_topo())
+                if not refreshed and dist.workload.hot_set(16).size >= 8:
+                    # replicate the live head once telemetry has one
+                    dist.refresh_replicas(k=16)
+                    refreshed = True
+            else:
+                h = dist.submit(ev[2])
+                while dist._drainable():
+                    dist.flush()
+                try:
+                    rows_d.append(h.result(60))
+                    nodes_d.append(ev[2])
+                except Exception:
+                    dropped_d += 1
+        wall_dist = time.perf_counter() - t0
+        assert dropped_d == 0, f"{dropped_d} dropped routed requests"
+        # parity across graph VERSIONS: a row served between commits v
+        # and v+1 was computed on graph version v — it must bit-match a
+        # candidate from the fleet replay over SOME version the fleet
+        # actually served (the per-version replay is exhaustive because
+        # every version's topology was snapshotted at its commit)
+        oracles = []
+        for tv in topo_versions:
+            def mk(tv=tv):
+                return GraphSageSampler(tv, sizes=SIZES, mode="TPU",
+                                        seed=SEED)
+            oracles.append(replay_fleet_oracle(dist, model, params, mk,
+                                               feat))
+        parity_dist = 0
+        for node, row in zip(nodes_d, rows_d):
+            cands = [c for o in oracles for c in o.get(int(node), [])]
+            assert any(np.array_equal(row, c) for c in cands), \
+                f"STREAM-PARITY VIOLATION at node {int(node)}"
+            parity_dist += 1
+
+        out = {
+            "metric": "serve_probe_stream",
+            "git_revision": git_revision(),
+            "backend": jax.devices()[0].platform,
+            "config": {
+                "requests": args.stream_requests, "alpha": 1.1,
+                "edge_every": args.stream_edge_every,
+                "edges_per_event": args.stream_edges_per_event,
+                "max_batch": args.max_batch, "sizes": SIZES,
+                "nodes": n, "stream_reserve_frac": 0.5,
+            },
+            "note": (
+                "sequential deterministic drive (QPS numbers are 1-core "
+                "loopback walls, read the structure not the absolute); "
+                "empty-delta parity, per-commit visibility, zero-drop "
+                "and fleet oracle parity are asserted in-run — a "
+                "written artifact means they held"
+            ),
+            "empty_delta_parity_rows": parity_rows,
+            "single_host_live": {
+                "dropped_requests": dropped,
+                "commits": commits,
+                "graph_version": eng_l.graph_version,
+                "delta_edges": eng_l.stats.delta_edges,
+                "tile_writes": eng_l.stats.delta_tile_writes,
+                "tile_spills": eng_l.stats.delta_tile_spills,
+                "cache_invalidated": eng_l.stats.delta_cache_invalidated,
+                "visibility_checks": visibility_checked,
+                "free_tile_rows_left": stream_l.free_rows,
+                "qps": round(args.stream_requests / wall_live, 1),
+            },
+            "fleet_hosts2": {
+                "dropped_requests": dropped_d,
+                "parity_rows_checked": parity_dist,
+                "graph_version": dist.graph_version,
+                "delta_edges": dist.stats.delta_edges,
+                "closure_installs": dist.stats.delta_closure_installs,
+                "router_cache_invalidated": (
+                    dist.stats.delta_cache_invalidated
+                ),
+                "replica_delta_invalidations": (
+                    dist.stats.replica_delta_invalidations
+                ),
+                "replica_version": dist.replica_version,
+                "qps": round(args.stream_requests / wall_dist, 1),
+            },
+        }
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return
 
     # -- round-16 elastic-fleet leg (--scale -> SERVE_r08.json) --------------
     if args.scale:

@@ -368,3 +368,39 @@ def test_fleet_table_prices_add_host_vs_replicate():
     assert pick_fleet_action(rows, budget_bytes_per_host=1.0) is None
     md = format_fleet_markdown(rows)
     assert "add host" in md and "replicate top-k" in md
+
+
+def test_delta_table_prices_streaming_ingest():
+    """Round-17 ingest pricing: duty scales linearly in the edge rate on
+    top of the fixed per-commit swap floor, longer commit periods
+    amortize the swap, and `sustainable` flips exactly at duty 1."""
+    from quiver_tpu.parallel.scaling import delta_table, format_delta_markdown
+
+    append_s, swap_s = 2e-6, 5e-3
+    rows = delta_table(
+        [("idle", 0.0), ("feed", 1e3), ("storm", 1e5)],
+        append_s_per_edge=append_s, swap_s_per_commit=swap_s,
+        commit_period_s=1.0,
+    )
+    idle, feed, storm = rows
+    # rate 0 still pays the swap floor — the fence stall is never free
+    assert idle.commit_s == pytest.approx(swap_s)
+    assert idle.fence_stall_s == idle.commit_s
+    # linear in rate above the floor
+    assert feed.commit_s == pytest.approx(swap_s + 1e3 * append_s)
+    assert storm.edges_per_commit == pytest.approx(1e5)
+    assert all(r.sustainable for r in rows)
+    # a longer period amortizes the swap: duty strictly drops
+    amortized = delta_table([("storm", 1e5)], append_s, swap_s,
+                            commit_period_s=10.0)[0]
+    assert amortized.duty_frac < storm.duty_frac
+    assert amortized.fence_stall_s > storm.fence_stall_s  # the trade
+    # sustainability flips exactly where append work alone fills the wall
+    over = delta_table([("melt", 1.1 / append_s)], append_s, swap_s)[0]
+    assert not over.sustainable and over.duty_frac > 1.0
+    with pytest.raises(ValueError):
+        delta_table([("x", -1.0)], append_s, swap_s)
+    with pytest.raises(ValueError):
+        delta_table([("x", 1.0)], append_s, swap_s, commit_period_s=0.0)
+    md = format_delta_markdown(rows)
+    assert "storm" in md and "sustainable" in md

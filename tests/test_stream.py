@@ -1,0 +1,760 @@
+"""Round-17 streaming-graph tests: the delta layer over the tiled layout
+(quiver_tpu/stream.py), `ServeEngine.update_graph` /
+`DistServeEngine.update_graph`, and the three fence consumers ROADMAP
+item 1 names.
+
+The acceptance contract (ISSUE 12 / docs/api.md "Streaming graphs"):
+
+- a draw from the streamed ``(bd, tiles)`` is bit-equal to a draw from a
+  tile table freshly built over the materialized updated CSR, through
+  pad-lane appends AND tile spills;
+- frozen-graph replay is bit-identical to delta-replay with an empty
+  delta; identical delta schedules replay bit-identically at
+  max_in_flight 1/2 and hosts 1/2;
+- an appended edge is visible to the next sample after `update_graph`
+  returns (copy-all semantics);
+- `update_graph` fences exactly like `update_params`, and its three
+  consumers each hold: (a) exactly the closure-touched cache entries
+  invalidate, (b) a stale hot-set replica is dropped + rebuilt, (c) a
+  delta-hot subgraph pulls its rows off disk at the commit.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo, Feature
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops.sample import LANE, build_tiled_host, tiled_sample_layer
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    ClosureFeature,
+    DistServeConfig,
+    DistServeEngine,
+    ServeConfig,
+    ServeEngine,
+    delta_interleaved_trace,
+    replay_fleet_oracle,
+    zipfian_trace,
+)
+from quiver_tpu.stream import (
+    GraphDelta,
+    StreamCapacityError,
+    StreamingAdjacency,
+    StreamingTiledGraph,
+)
+from quiver_tpu.trace import WorkloadConfig
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+EDGE_INDEX = make_random_graph(N_NODES, 1200, seed=0)
+
+
+def make_topo():
+    return CSRTopo(edge_index=EDGE_INDEX)
+
+
+def make_sampler(stream=None, topo=None):
+    s = GraphSageSampler(
+        topo if topo is not None else make_topo(), sizes=SIZES,
+        mode="TPU", seed=SAMPLER_SEED,
+    )
+    if stream is not None:
+        s.bind_stream(stream)
+    return s
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    sampler = make_sampler()
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def draws_equal(graph_a, graph_b, k=4, n_draws=48, seed=99):
+    """Bit-compare one-hop draws from two (bd, tiles) pairs on one key."""
+    rng = np.random.default_rng(seed)
+    seeds = jnp.asarray(rng.integers(0, N_NODES, n_draws))
+    valid = jnp.ones((n_draws,), bool)
+    key = jax.random.key(seed)
+    na, va = tiled_sample_layer(graph_a[0], graph_a[1], seeds, valid, k, key)
+    nb, vb = tiled_sample_layer(graph_b[0], graph_b[1], seeds, valid, k, key)
+    return (np.array_equal(np.asarray(na), np.asarray(nb))
+            and np.array_equal(np.asarray(va), np.asarray(vb)))
+
+
+def rebuilt_graph(stream):
+    topo = stream.to_csr_topo()
+    bd, tiles = build_tiled_host(topo.indptr, topo.indices,
+                                 stream.tiles.dtype)
+    return jnp.asarray(bd), jnp.asarray(tiles)
+
+
+# -- the delta layer itself ---------------------------------------------------
+
+def test_graph_delta_buffer_basics():
+    d = GraphDelta()
+    d.add_edge(1, 2)
+    d.add_edges([3, 3], [4, 5])
+    assert len(d) == 3
+    src, dst = d.edges()
+    assert src.tolist() == [1, 3, 3] and dst.tolist() == [2, 4, 5]
+    assert d.sources().tolist() == [1, 3]
+    d2 = GraphDelta()
+    d2.extend(d)
+    assert len(d2) == 3
+    d.clear()
+    assert len(d) == 0 and len(d2) == 3
+    with pytest.raises(ValueError):
+        GraphDelta([1], [2, 3])
+
+
+def test_pad_lane_append_vs_rebuilt_tiled_draw_parity():
+    """Appends landing in pad lanes (no spill) leave the streamed tiles
+    draw-identical to a tile table freshly built over the materialized
+    updated CSR — the tentpole parity pin."""
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=0.5)
+    # pick sources with slack in their last tile row (deg % 128 != 0 —
+    # every node here, degrees are ~6)
+    d = GraphDelta()
+    rng = np.random.default_rng(5)
+    for u in rng.integers(0, N_NODES, 16):
+        d.add_edge(int(u), int((u + 3) % N_NODES))
+    before_rows = stream._free_row
+    out = stream.apply(d)
+    assert out["pad_writes"] == 16 and out["tile_spills"] == 0
+    assert stream._free_row == before_rows  # nothing relocated
+    assert draws_equal(stream.graph(), rebuilt_graph(stream))
+    # host adjacency agrees with the tiles
+    u = int(d.edges()[0][0])
+    assert stream.degree(u) == stream.bd[u, 1]
+
+
+def test_tile_spill_relocation_parity_and_capacity_error():
+    """A node filling its allocated lanes relocates to reserve rows
+    (base bump) and stays draw-identical to the rebuilt layout; reserve
+    exhaustion raises StreamCapacityError instead of growing shapes."""
+    stream = StreamingTiledGraph(make_topo(), reserve_tiles=16)
+    u = int(np.argmin(make_topo().degree))
+    deg0 = stream.degree(u)
+    need = (LANE - deg0) + 5  # cross the 128-lane boundary
+    d = GraphDelta()
+    for i in range(need):
+        d.add_edge(u, int((u + 1 + i) % N_NODES))
+    out = stream.apply(d)
+    assert out["tile_spills"] >= 1
+    assert stream.degree(u) == deg0 + need
+    assert draws_equal(stream.graph(), rebuilt_graph(stream))
+    # the appended neighbors are exactly the materialized tail of u's row
+    nbrs = stream.neighbors(u)
+    assert nbrs.shape[0] == deg0 + need
+    # reserve exhaustion is a loud, typed error
+    d2 = GraphDelta()
+    for i in range(16 * LANE):
+        d2.add_edge(u, int(i % N_NODES))
+    with pytest.raises(StreamCapacityError, match="reserve exhausted"):
+        stream.apply(d2)
+
+
+def test_streaming_adjacency_closures_exact():
+    """Forward/reverse k-hop closures over a line graph with an appended
+    shortcut — exact, hand-checkable expectations."""
+    n = 10
+    src = np.arange(n - 1)
+    dst = np.arange(1, n)
+    adj = StreamingAdjacency(CSRTopo(edge_index=np.stack([src, dst]),
+                                     num_nodes=n))
+    fwd = adj.forward_closure([0], 2)
+    assert np.nonzero(fwd)[0].tolist() == [0, 1, 2]
+    assert adj.reverse_closure([5], 2).tolist() == [3, 4, 5]
+    adj.add_edges([0], [7])  # shortcut 0 -> 7
+    fwd = adj.forward_closure([0], 2)
+    assert np.nonzero(fwd)[0].tolist() == [0, 1, 2, 7, 8]
+    # 7's draws now depend on 0's row? No — reverse: seeds reaching 7
+    assert adj.reverse_closure([7], 1).tolist() == [0, 6, 7]
+    assert adj.neighbors(0).tolist() == [1, 7]
+    assert adj.degree(0) == 2
+    topo2 = adj.to_csr_topo()
+    assert topo2.indices[topo2.indptr[0]:topo2.indptr[1]].tolist() == [1, 7]
+    with pytest.raises(ValueError, match="outside"):
+        adj.add_edges([0], [n + 5])
+
+
+def test_install_rows_materializes_degree0_rows():
+    """install_rows lands a full adjacency row for a degree-0 node (the
+    dist closure-extension unit) and refuses materialized rows."""
+    n = 12
+    src = np.array([0, 0, 1])
+    dst = np.array([1, 2, 3])
+    stream = StreamingTiledGraph(
+        CSRTopo(edge_index=np.stack([src, dst]), num_nodes=n),
+        reserve_tiles=8,
+    )
+    assert stream.degree(5) == 0
+    out = stream.install_rows([(5, np.array([2, 7, 9]))])
+    assert out["installs"] == 1
+    assert stream.neighbors(5).tolist() == [2, 7, 9]
+    assert stream.bd[5, 1] == 3
+    assert draws_equal(stream.graph(), rebuilt_graph(stream), n_draws=12)
+    with pytest.raises(ValueError, match="degree-0"):
+        stream.install_rows([(0, np.array([4]))])
+    # neighbor ids are range-checked like edge appends: a bad id must
+    # raise, never land in the tiles (clipped gathers would silently
+    # read the last row)
+    with pytest.raises(ValueError, match="install neighbors"):
+        stream.install_rows([(6, np.array([2, n + 5]))])
+    assert stream.degree(6) == 0
+
+
+# -- engine-level parity + determinism ---------------------------------------
+
+def make_engine(setup, stream=None, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("buckets", (8,))
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    return ServeEngine(model, params, make_sampler(stream=stream), feat,
+                       ServeConfig(**cfg_kw))
+
+
+def test_frozen_replay_bit_identical_to_empty_delta_replay(setup):
+    """THE parity pin: a frozen-graph engine and a streaming engine
+    committing an EMPTY delta mid-run serve bit-identical logits and
+    dispatch logs — streaming with no deltas is the round-16 engine."""
+    trace = zipfian_trace(N_NODES, 48, alpha=1.1, seed=7)
+    eng_f = make_engine(setup)
+    eng_f.warmup()
+    rows_f = eng_f.predict(trace)
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=0.5)
+    eng_s = make_engine(setup, stream=stream)
+    eng_s.warmup()
+    rows_a = eng_s.predict(trace[:20])
+    out = eng_s.update_graph(GraphDelta())
+    assert out["edges"] == 0 and out["cache_invalidated"] == 0
+    assert eng_s.graph_version == 0          # strict no-op, no fence
+    rows_b = eng_s.predict(trace[20:])
+    assert np.array_equal(rows_f, np.concatenate([rows_a, rows_b]))
+    assert len(eng_f.dispatch_log) == len(eng_s.dispatch_log)
+    for (pa, na), (pb, nb) in zip(eng_f.dispatch_log, eng_s.dispatch_log):
+        assert na == nb and np.array_equal(pa, pb)
+
+
+def test_appended_edge_visible_to_next_sample(setup):
+    """An appended edge must be drawable by the NEXT sample after
+    `update_graph` returns, and the post-commit served row must
+    bit-match an offline replay through a fresh sampler over the
+    UPDATED graph at the same key index."""
+    model, params, feat = setup
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=0.5)
+    eng = make_engine(setup, stream=stream, cache_entries=0)
+    eng.warmup()
+    u = int(np.argmin(make_topo().degree))
+    eng.predict([u])  # pre-delta traffic advances the key stream
+    v = int((u + 11) % N_NODES)
+    d = GraphDelta()
+    d.add_edge(u, v)
+    out = eng.update_graph(d)
+    assert out["edges"] == 1 and eng.graph_version == 1
+    # sampler-level visibility: copy-all at fanout >= deg must include v
+    k = stream.degree(u)
+    bd_dev, tiles_dev = stream.graph()
+    nbrs, valid = tiled_sample_layer(
+        bd_dev, tiles_dev, jnp.asarray([u]), jnp.ones((1,), bool), k,
+        jax.random.key(1),
+    )
+    assert v in set(np.asarray(nbrs)[0][np.asarray(valid)[0]].tolist())
+    # engine-level: the next served row for u == offline replay over the
+    # UPDATED graph (replay the whole log through a fresh sampler so the
+    # key index lines up; only post-commit entries must match)
+    row = eng.predict([u])[0]
+    from quiver_tpu.inference import _cached_apply, batch_logits
+
+    apply = _cached_apply(model)
+    twin = make_sampler(topo=stream.to_csr_topo())
+    for padded, nvalid in eng.dispatch_log:
+        logits = np.asarray(
+            batch_logits(apply, params, twin, feat, padded)
+        )
+    assert np.array_equal(row, logits[list(eng.dispatch_log[-1][0]).index(u)])
+
+
+@pytest.mark.parametrize("mif", [1, 2])
+def test_delta_replay_determinism_single_host(setup, mif):
+    """Identical (trace, delta) schedules replay bit-identically at
+    max_in_flight 1 and 2 — commits are fenced and key draws sequenced,
+    so streaming never breaks the standing determinism contract."""
+    dt = delta_interleaved_trace(N_NODES, 60, alpha=1.1, seed=11,
+                                 edge_every=20, edges_per_event=3)
+
+    def run():
+        stream = StreamingTiledGraph(make_topo(), reserve_frac=0.5)
+        eng = make_engine(setup, stream=stream, max_in_flight=mif)
+        eng.warmup()
+        rows = []
+        for ev in dt.events():
+            if ev[0] == "edges":
+                eng.stage_edges(ev[1], ev[2])
+                eng.update_graph()
+            else:
+                rows.append(eng.predict([ev[2]])[0])
+        return np.stack(rows), eng
+
+    rows_a, eng_a = run()
+    rows_b, eng_b = run()
+    assert np.array_equal(rows_a, rows_b)
+    assert eng_a.stats.graph_deltas == eng_b.stats.graph_deltas == dt.n_events
+    assert eng_a.stats.delta_edges == dt.n_events * 3
+    assert len(eng_a.dispatch_log) == len(eng_b.dispatch_log)
+    for (pa, na), (pb, nb) in zip(eng_a.dispatch_log, eng_b.dispatch_log):
+        assert na == nb and np.array_equal(pa, pb)
+
+
+def test_update_graph_fences_inflight_flush(setup):
+    """`update_graph` must drain in-flight flushes before touching the
+    tiles — no flush ever straddles a delta commit (the update_params
+    fence, third consumer set or not)."""
+    from test_serve import _GateFeature
+
+    model, params, feat = setup
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=0.5)
+    gate = _GateFeature(feat)
+    eng = ServeEngine(
+        model, params, make_sampler(stream=stream), gate,
+        ServeConfig(max_batch=4, buckets=(4,), max_delay_ms=1e9,
+                    max_in_flight=2, record_dispatches=True),
+    )
+    eng.warmup()
+    gate.delays = [1.5]
+    gate.started.clear()
+    h = eng.submit(7)
+    t_a = threading.Thread(target=eng.flush)
+    t_a.start()
+    assert gate.started.wait(30)       # flush held in its dispatch stage
+    d = GraphDelta()
+    d.add_edge(7, 99)
+    eng.update_graph(d)                # must FENCE: wait for the flush
+    assert h.done()                    # drained before the commit landed
+    assert eng.graph_version == 1
+    t_a.join()
+    assert np.isfinite(h.result()).all()
+
+
+def test_closure_touched_cache_invalidation_exact(setup):
+    """Consumer (a), pinned exactly: on a line graph, a delta at row u
+    invalidates precisely the cached seeds within len(sizes)-1 REVERSE
+    hops of u; every other entry stays warm."""
+    model, params, feat = setup
+    n = N_NODES
+    src = np.arange(n - 1)
+    dst = np.arange(1, n)
+    line = CSRTopo(edge_index=np.stack([src, dst]), num_nodes=n)
+    stream = StreamingTiledGraph(line, reserve_frac=0.5)
+    sampler = GraphSageSampler(line, sizes=SIZES, mode="TPU",
+                               seed=SAMPLER_SEED).bind_stream(stream)
+    eng = ServeEngine(model, params, sampler, feat,
+                      ServeConfig(max_batch=8, buckets=(8,),
+                                  max_delay_ms=1e9, cache_entries=512))
+    eng.warmup()
+    u = 100
+    seeds = [u - 2, u - 1, u, u + 1, 5]  # u-1, u reach u in <= 1 hop
+    eng.predict(seeds)
+    assert all(eng.cache.entry_version(s) == 0 for s in seeds)
+    d = GraphDelta()
+    d.add_edge(u, 7)
+    out = eng.update_graph(d)
+    # expansion hops = len(SIZES)-1 = 1: affected = {u-1, u} (of cached)
+    assert out["cache_invalidated"] == 2
+    assert eng.cache.entry_version(u) is None
+    assert eng.cache.entry_version(u - 1) is None
+    assert eng.cache.entry_version(u - 2) == 0   # 2 hops away: warm
+    assert eng.cache.entry_version(u + 1) == 0   # downstream: unaffected
+    assert eng.cache.entry_version(5) == 0
+    assert eng.stats.delta_cache_invalidated == 2
+
+
+# -- dist: incremental closure extension, replica, determinism ---------------
+
+def two_community_graph():
+    """Two dense halves joined by nothing — cross-community deltas force
+    real closure extension (a random graph's 1-hop closures already span
+    everything)."""
+    rng = np.random.default_rng(4)
+    half = N_NODES // 2
+    src_a = rng.integers(0, half, 600)
+    dst_a = rng.integers(0, half, 600)
+    src_b = rng.integers(half, N_NODES, 600)
+    dst_b = rng.integers(half, N_NODES, 600)
+    return CSRTopo(edge_index=np.stack([
+        np.concatenate([src_a, src_b]), np.concatenate([dst_a, dst_b])
+    ]), num_nodes=N_NODES)
+
+
+def make_dist(setup, topo, hosts=2, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("exchange", "host")
+    cfg_kw.setdefault("streaming", True)
+    return DistServeEngine.build(
+        model, params, topo, feat, SIZES, hosts=hosts,
+        config=DistServeConfig(hosts=hosts, **cfg_kw),
+        sampler_seed=SAMPLER_SEED,
+    )
+
+
+def serve_all(dist, trace):
+    handles = [dist.submit(int(x)) for x in trace]
+    while dist._drainable():
+        dist.flush()
+    return np.stack([h.result(timeout=60) for h in handles])
+
+
+def test_dist_owner_closure_extension_and_parity(setup):
+    """A cross-community delta edge EXTENDS the owning shard's halo
+    closure incrementally (rows install into the reserve, never a
+    reshard) and post-delta served rows bit-match an offline replay over
+    the updated full graph."""
+    model, params, feat = setup
+    topo = two_community_graph()
+    dist = make_dist(setup, topo, hosts=2)
+    dist.warmup()
+    half = N_NODES // 2
+    trace = np.concatenate([
+        zipfian_trace(half, 16, alpha=1.0, seed=5),
+        half + zipfian_trace(half, 16, alpha=1.0, seed=6),
+    ])
+    rows1 = serve_all(dist, trace)
+    # community A's closure cannot contain community B nodes yet
+    topo_mask0 = dist._owner_masks[0][0]
+    assert not topo_mask0[half:].any()
+    u = int(trace[0])          # an A-community node (owned by host 0)
+    v = half + 3               # B-community target
+    d = GraphDelta()
+    d.add_edge(u, v)
+    out = dist.update_graph(d)
+    assert out["closure_installs"] > 0      # rows INSTALLED, no reshard
+    assert dist.graph_version == 1
+    assert dist._owner_masks[0][0][v]       # v entered host 0's closure
+    assert v in set(dist._owner_streams[0].neighbors(u).tolist())
+    rows2 = serve_all(dist, trace)
+    # parity: pre-delta rows against the old graph, post-delta against
+    # the updated one (each row must match a candidate of its era)
+    def mk_old():
+        return GraphSageSampler(topo, sizes=SIZES, mode="TPU",
+                                seed=SAMPLER_SEED)
+    topo2 = dist._stream_adj.to_csr_topo()
+
+    def mk_new():
+        return GraphSageSampler(topo2, sizes=SIZES, mode="TPU",
+                                seed=SAMPLER_SEED)
+    oracle_old = replay_fleet_oracle(dist, model, params, mk_old, feat)
+    oracle_new = replay_fleet_oracle(dist, model, params, mk_new, feat)
+    for nid, row in zip(np.concatenate([trace, trace]),
+                        np.concatenate([rows1, rows2])):
+        cands = oracle_old.get(int(nid), []) + oracle_new.get(int(nid), [])
+        assert any(np.array_equal(row, c) for c in cands), int(nid)
+
+
+def test_dist_boundary_closure_extension_three_layer(setup):
+    """A delta edge landing on a node ALREADY inside the owner mask —
+    at the closure boundary, row kept but its own k-hop closure not —
+    must still extend the mask: the node is now reachable shallower, so
+    a >=3-layer sampler EXPANDS it and reads rows beyond the old
+    boundary. Pinned structurally (chain tail enters the mask) and by
+    served-row parity against an offline replay of the updated graph."""
+    _, _, feat = setup
+    half = N_NODES // 2
+    rng = np.random.default_rng(11)
+    # community A dense (host 0 owns it, all depth 0); community B dense
+    # EXCEPT a directed chain v->w->x->y->z whose nodes carry only their
+    # chain out-edge, so forward closures over the chain are exact:
+    # closure(v, 2) = {v, w, x}, never a shortcut past the boundary
+    v, w, x, y, z = half, half + 1, half + 2, half + 3, half + 4
+    src_a = rng.integers(0, half, 600)
+    dst_a = rng.integers(0, half, 600)
+    src_b = rng.integers(half + 5, N_NODES, 600)
+    dst_b = rng.integers(half, N_NODES, 600)
+    chain_src = np.array([v, w, x, y], np.int64)
+    chain_dst = np.array([w, x, y, z], np.int64)
+    topo = CSRTopo(edge_index=np.stack([
+        np.concatenate([src_a, src_b, chain_src]),
+        np.concatenate([dst_a, dst_b, chain_dst]),
+    ]), num_nodes=N_NODES)
+    sizes3 = [2, 2, 2]
+    model3 = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=3, dropout=0.0)
+    s3 = GraphSageSampler(topo, sizes=sizes3, mode="TPU",
+                          seed=SAMPLER_SEED)
+    ds0 = s3.sample_dense(np.arange(8, dtype=np.int64))
+    params3 = model3.init(jax.random.key(0),
+                          jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32),
+                          ds0.adjs)
+    dist = DistServeEngine.build(
+        model3, params3, topo, feat, sizes3, hosts=2,
+        config=DistServeConfig(hosts=2, max_batch=8, max_delay_ms=1e9,
+                               record_dispatches=True, exchange="host",
+                               streaming=True),
+        sampler_seed=SAMPLER_SEED,
+    )
+    dist.warmup()
+    s1, s2 = 3, 7          # A-community seeds, owned by host 0
+    d = GraphDelta()
+    d.add_edge(s1, v)
+    dist.update_graph(d)
+    mask0 = dist._owner_masks[0][0]
+    # precondition: the chain head's closure landed, its tail is OUTSIDE
+    # — x is now a boundary node of host 0's mask
+    assert mask0[v] and mask0[w] and mask0[x]
+    assert not mask0[y] and not mask0[z]
+    d = GraphDelta()
+    d.add_edge(s2, x)      # dst already in-mask: the boundary case
+    dist.update_graph(d)
+    mask0 = dist._owner_masks[0][0]
+    assert mask0[y] and mask0[z], (
+        "boundary dst must re-seed the closure BFS — x is expanded at "
+        "layer 2 now, so y's row is read at layer 3"
+    )
+    trace = np.array([s1, s2, 0, 1, 5], np.int64)
+    rows = serve_all(dist, trace)
+    topo2 = dist._stream_adj.to_csr_topo()
+
+    def mk_new():
+        return GraphSageSampler(topo2, sizes=sizes3, mode="TPU",
+                                seed=SAMPLER_SEED)
+    oracle = replay_fleet_oracle(dist, model3, params3, mk_new, feat)
+    for nid, row in zip(trace, rows):
+        cands = oracle.get(int(nid), [])
+        assert any(np.array_equal(row, c) for c in cands), int(nid)
+
+
+@pytest.mark.parametrize("hosts", [1, 2])
+def test_dist_delta_replay_determinism(setup, hosts):
+    """Identical delta-interleaved schedules replay bit-identically at
+    hosts 1 and 2."""
+    dt = delta_interleaved_trace(N_NODES, 48, alpha=1.1, seed=13,
+                                 edge_every=16, edges_per_event=2)
+    topo = two_community_graph()
+
+    def run():
+        dist = make_dist(setup, topo, hosts=hosts)
+        dist.warmup()
+        rows = []
+        for ev in dt.events():
+            if ev[0] == "edges":
+                dist.stage_edges(ev[1], ev[2])
+                dist.update_graph()
+            else:
+                rows.append(serve_all(dist, [ev[2]])[0])
+        return np.stack(rows), dist
+
+    rows_a, dist_a = run()
+    rows_b, dist_b = run()
+    assert np.array_equal(rows_a, rows_b)
+    assert (dist_a.stats.graph_deltas == dist_b.stats.graph_deltas
+            == dt.n_events)
+    assert dist_a.stats.delta_closure_installs == (
+        dist_b.stats.delta_closure_installs
+    )
+    for h in dist_a.engines:
+        la, lb = dist_a.engines[h].dispatch_log, dist_b.engines[h].dispatch_log
+        assert len(la) == len(lb)
+        for (pa, na), (pb, nb) in zip(la, lb):
+            assert na == nb and np.array_equal(pa, pb)
+
+
+def test_stale_replica_invalidated_and_rebuilt(setup):
+    """Consumer (b): a delta whose closure touches the replicated head
+    DROPS the live replica under the fence (it would serve pre-delta
+    draws) and rebuilds it over the updated graph; a delta elsewhere
+    leaves it alone."""
+    model, params, feat = setup
+    topo = two_community_graph()
+    dist = make_dist(setup, topo, hosts=2)
+    dist.warmup()
+    half = N_NODES // 2
+    rep_ids = np.array([3, 5, 9], np.int64)
+    dist.refresh_replicas(ids=rep_ids)
+    assert dist.replica is not None
+    v0 = dist.replica_version
+    # a delta far from the head (community B): replica untouched
+    d_far = GraphDelta()
+    d_far.add_edge(half + 20, half + 40)
+    out = dist.update_graph(d_far)
+    assert not out["replica_invalidated"]
+    assert dist.replica_version == v0
+    # a delta AT a replicated seed: drop + rebuild
+    d_hot = GraphDelta()
+    d_hot.add_edge(3, half + 1)
+    out = dist.update_graph(d_hot)
+    assert out["replica_invalidated"]
+    assert dist.stats.replica_delta_invalidations == 1
+    assert "replica_refresh" in out
+    assert dist.replica is not None and dist.replica_version > v0
+    # the rebuilt replica serves the POST-delta graph: its sampler's
+    # shard topology contains the new edge
+    rep_sampler = dist.replica.engine._sampler
+    row = rep_sampler.csr_topo
+    nbrs = row.indices[row.indptr[3]:row.indptr[4]]
+    assert (half + 1) in set(np.asarray(nbrs).tolist())
+    # and replica-served traffic still resolves
+    rows = serve_all(dist, rep_ids)
+    assert np.isfinite(rows).all()
+    assert dist.stats.replica_hits > 0
+
+
+def test_tier_replacement_on_delta_hot_subgraph(setup):
+    """Consumer (c): an engine with a disk-backed adaptive tier store
+    runs one fenced adapt pass at the delta commit — the delta-hot
+    subgraph's rows come off disk NOW, not at the next timer tick."""
+    model, params, _ = setup
+    rng = np.random.default_rng(1)
+    feat_full = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    tdir = tempfile.mkdtemp(prefix="qt_stream_tiers_")
+    f = Feature(rank=0, device_cache_size=24 * DIM * 4,
+                host_memory_budget=48 * DIM * 4,
+                disk_path=os.path.join(tdir, "t.npy"),
+                adaptive_tiers=True)
+    f.from_cpu_tensor(feat_full)
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=0.5)
+    eng = ServeEngine(
+        model, params, make_sampler(stream=stream), f,
+        ServeConfig(max_batch=8, buckets=(8,), max_delay_ms=1e9,
+                    cache_entries=0, tier_promote_min=1.0,
+                    workload=WorkloadConfig(topk=64, row_topk=128)),
+    )
+    eng.warmup()
+    # hot traffic builds sketch weight on rows still on disk
+    trace = zipfian_trace(N_NODES, 120, alpha=1.3, seed=3)
+    eng.predict(trace)
+    d = GraphDelta()
+    d.add_edge(int(trace[0]), int(trace[1]))
+    out = eng.update_graph(d)
+    assert "tier_adapt" in out
+    assert out["tier_adapt"]["moves"] > 0
+    assert eng.stats.tier_promoted > 0 and eng.placement_version >= 1
+    # streaming + tiers = split dispatch path; the commit still landed
+    assert eng.graph_version == 1 and eng._programs is None
+
+
+# -- satellites: trace gen, ClosureFeature reserve, metrics, journal ---------
+
+def test_delta_interleaved_trace_deterministic():
+    dt1 = delta_interleaved_trace(500, 100, alpha=0.9, seed=5,
+                                  edge_every=25, edges_per_event=4)
+    dt2 = delta_interleaved_trace(500, 100, alpha=0.9, seed=5,
+                                  edge_every=25, edges_per_event=4)
+    assert np.array_equal(dt1.requests, dt2.requests)
+    assert np.array_equal(dt1.edge_src, dt2.edge_src)
+    assert np.array_equal(dt1.edge_dst, dt2.edge_dst)
+    # the request stream IS the frozen-graph trace (like-for-like parity)
+    assert np.array_equal(dt1.requests, zipfian_trace(500, 100, alpha=0.9,
+                                                      seed=5))
+    assert dt1.n_events == 3 and dt1.edge_pos.tolist() == [25, 50, 75]
+    assert not (dt1.edge_src == dt1.edge_dst).any()
+    # sources come from the already-served prefix (traffic-correlated)
+    for i, p in enumerate(dt1.edge_pos):
+        assert set(dt1.edge_src[i]) <= set(dt1.requests[:p].tolist())
+    ev = list(dt1.events())
+    assert sum(1 for e in ev if e[0] == "edges") == 3
+    assert sum(1 for e in ev if e[0] == "request") == 100
+    # edges precede the request at their position
+    idx = ev.index(("request", 25, int(dt1.requests[25])))
+    assert ev[idx - 1][0] == "edges"
+
+
+def test_closure_feature_reserve_install_and_gather():
+    rng = np.random.default_rng(2)
+    rows = rng.standard_normal((4, 8)).astype(np.float32)
+    local_map = np.full(10, -1, np.int32)
+    local_map[[1, 3, 5, 7]] = np.arange(4, dtype=np.int32)
+    cf = ClosureFeature(rows, local_map, reserve_rows=2)
+    assert cf.resident_rows == 4 and cf.capacity_rows == 6
+    cf.jit_gather_spec()  # materialize device arrays BEFORE the install
+    new_row = np.ones((1, 8), np.float32) * 3.5
+    assert cf.install_rows([8], new_row) == 1
+    assert cf.resident_rows == 5
+    assert np.array_equal(np.asarray(cf[np.array([8])])[0], new_row[0])
+    # the DEVICE arrays were updated in place (fused gather path)
+    table, imap = cf.jit_gather_spec()
+    r = int(np.asarray(imap)[8])
+    assert np.array_equal(np.asarray(table)[r], new_row[0])
+    cf.install_rows([9], new_row)
+    with pytest.raises(StreamCapacityError):
+        cf.install_rows([0], new_row)
+
+
+def test_stream_metrics_and_journal(setup):
+    """Satellite pin: graph_version / delta_pending_edges gauges + the
+    delta counter families are real Prometheus metrics, and the journal
+    carries graph_delta / delta_commit markers."""
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=0.5)
+    eng = make_engine(setup, stream=stream, journal_events=4096)
+    eng.warmup()
+    eng.predict(zipfian_trace(N_NODES, 16, alpha=1.0, seed=2))
+    eng.stage_edges([1, 2], [3, 4])
+    reg = eng.register_metrics()
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE quiver_serve_graph_version gauge" in lines
+    assert "quiver_serve_graph_version 0" in lines
+    assert "quiver_serve_delta_pending_edges 2" in lines
+    for fam in ("graph_deltas", "delta_edges", "delta_tile_writes",
+                "delta_tile_spills", "delta_cache_invalidated"):
+        assert f"# TYPE quiver_serve_{fam}_total counter" in lines, fam
+    eng.update_graph()
+    text = reg.to_prometheus()
+    assert "quiver_serve_graph_version 1" in text
+    assert "quiver_serve_delta_pending_edges 0" in text
+    kinds = [ev[1] for ev in eng.journal.snapshot()]
+    assert "graph_delta" in kinds and "delta_commit" in kinds
+    # the commit marker carries (version, edges, invalidated)
+    commit = [ev for ev in eng.journal.snapshot()
+              if ev[1] == "delta_commit"][0]
+    assert commit[3] == 1 and commit[4] == 2
+    # dist counters exist too
+    topo = two_community_graph()
+    dist = make_dist(setup, topo, hosts=2)
+    dtext = dist.register_metrics().to_prometheus()
+    assert "# TYPE quiver_router_graph_deltas_total counter" in dtext
+    assert "quiver_router_graph_version 0" in dtext
+    assert "quiver_router_delta_pending_edges 0" in dtext
+
+
+def test_update_graph_requires_stream_binding(setup):
+    eng = make_engine(setup)  # frozen sampler
+    with pytest.raises(ValueError, match="stream-bound"):
+        eng.update_graph(GraphDelta())
+    # staging validates ids even WITHOUT a bound stream (against the
+    # sampler's own graph) — a later bind_stream + commit must never
+    # wedge on a poisoned pending buffer
+    with pytest.raises(ValueError, match="outside"):
+        eng.stage_edges([N_NODES + 1], [0])
+    model, params, feat = setup
+    with pytest.raises(ValueError, match="streaming"):
+        DistServeEngine.build(
+            model, params, make_topo(), feat, SIZES, hosts=2,
+            config=DistServeConfig(hosts=2, exchange="host",
+                                   streaming=True,
+                                   feature_residency="exchange"),
+            sampler_seed=SAMPLER_SEED,
+        )
+    dist = make_dist(setup, make_topo(), hosts=1, streaming=False)
+    with pytest.raises(ValueError, match="streaming is off"):
+        dist.update_graph(GraphDelta())
