@@ -755,6 +755,35 @@ def bench_tier_rows(context, n=8192, dim=100, reps=5):
         f"{context['tier_disk_row_s']*1e6:.2f} us, disk(single-thread) "
         f"{context['tier_disk_row_single_s']*1e6:.2f} us"
     )
+    # round-18 flush-ahead staging costs: what a PREFETCHED disk row
+    # costs the gather (issue ahead, reads land, take() consumes from
+    # DRAM) vs the same rows read in-path. The consume number is why
+    # `scaling.tier_table(prefetch_hit_rate=)` prices staged rows near
+    # host_row_s — the backing read happened off the critical path.
+    pf = store.enable_prefetch(max_rows=4096)
+    batch = store.placement.residents(TIER_DISK)[:256]
+    for _ in range(2):  # warm: thread-local fds/buffers + code paths
+        store.prefetch_rows(batch)
+        while len(pf):
+            pf.take(batch)
+    t_issue = t_take = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        store.prefetch_rows(batch)
+        t_issue += time.perf_counter() - t0
+        time.sleep(0.01)  # let the pool land the reads (the hidden part)
+        t0 = time.perf_counter()
+        pos, rows = pf.take(batch)
+        t_take += time.perf_counter() - t0
+        assert pos.shape[0] == batch.size
+    context["tier_prefetch_issue_row_s"] = t_issue / reps / batch.size
+    context["tier_prefetch_consume_row_s"] = t_take / reps / batch.size
+    log(
+        "tier prefetch staging: issue "
+        f"{context['tier_prefetch_issue_row_s']*1e6:.2f} us/row, consume "
+        f"{context['tier_prefetch_consume_row_s']*1e6:.2f} us/row "
+        "(vs the in-path pooled disk read above)"
+    )
 
 
 def bench_tiered_pipeline(

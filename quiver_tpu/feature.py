@@ -87,7 +87,7 @@ def validate_lookup_ids(
 
 
 def attribute_gather_tiers(shard_tensor, rank, stored_ids, counter,
-                           valid=None) -> None:
+                           valid=None, staged=None) -> None:
     """OBSERVE-ONLY per-tier attribution of a tiered gather (round-13
     workload telemetry): count how many of ``stored_ids`` resolve in each
     tier — ``hbm`` (this rank's own device shard), ``ici`` (another
@@ -98,7 +98,14 @@ def attribute_gather_tiers(shard_tensor, rank, stored_ids, counter,
     per shard); never touches the gather itself, so attaching a counter
     changes no gathered byte. ``valid`` masks out pad/invalid lanes —
     those gather row 0 physically but are not real feature requests, and
-    counting them would inflate the hot tier."""
+    counting them would inflate the hot tier.
+
+    ``staged`` (round 18): a callable ``stored_ids -> bool mask`` naming
+    disk-tier rows a flush-ahead prefetch already landed in DRAM (e.g.
+    ``PrefetchBuffer.staged_mask`` over the disk shard's LOCAL ids) —
+    those count as ``disk_prefetched`` instead of ``disk``, so the tier
+    labels report where bytes actually come from, not just where the
+    placement says they live."""
     if counter is None or shard_tensor is None:
         return
     ids = np.asarray(stored_ids).reshape(-1)
@@ -119,9 +126,15 @@ def attribute_gather_tiers(shard_tensor, rank, stored_ids, counter,
     if getattr(shard_tensor, "disk_shard", None) is not None and off is not None:
         # the round-14 flat-file tail: REAL disk-hit counts (the "disk"
         # label register_hit_rate has carried since round 13, now fed)
-        n = int(((ids >= off.start) & (ids < off.end)).sum())
-        if n:
-            counter.hit(n, tier="disk")
+        sel = (ids >= off.start) & (ids < off.end)
+        n = int(sel.sum())
+        pre = 0
+        if n and staged is not None:
+            pre = int(np.asarray(staged(ids[sel] - off.start)).sum())
+            if pre:
+                counter.hit(pre, tier="disk_prefetched")
+        if n - pre:
+            counter.hit(n - pre, tier="disk")
 
 
 @jax.jit
@@ -230,6 +243,12 @@ class Feature:
         # STORED row id (`WorkloadMonitor.observe_rows`) — the gather-
         # frequency sketch the tier planner reads. Observe-only too.
         self.row_tap = None
+        # round-18: a callable (disk-LOCAL ids -> bool mask) naming rows
+        # a flush-ahead prefetch staged in DRAM — installed by whoever
+        # runs the prefetch (the train pipeline for static disk tails;
+        # adaptive stores carry their own PrefetchBuffer) so attribution
+        # can report `disk_prefetched` honestly. Observe-only.
+        self.disk_staged = None
 
     # ------------------------------------------------------------------ build
     def from_cpu_tensor(self, cpu_tensor) -> None:
@@ -436,7 +455,8 @@ class Feature:
                     tc.hit(n, tier=tier)
             return
         attribute_gather_tiers(
-            self.shard_tensor, self.rank, stored, tc, valid=valid
+            self.shard_tensor, self.rank, stored, tc, valid=valid,
+            staged=self.disk_staged,
         )
 
     def gather_stored(self, stored) -> jax.Array:

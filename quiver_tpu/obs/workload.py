@@ -739,7 +739,13 @@ class WorkloadMonitor:
                        lambda: self.cache_misses,
                        "embedding-cache misses seen by the tap", labels)
         register_hit_rate(reg, f"{prefix}_gather", lambda: self.gathers,
-                          labels, tiers=("hbm", "ici", "host", "disk"))
+                          labels,
+                          # disk_prefetched (round 18): disk-placed rows a
+                          # flush-ahead prefetch staged in DRAM before the
+                          # gather — where the bytes CAME from, vs where
+                          # the placement says they live
+                          tiers=("hbm", "ici", "host", "disk",
+                                 "disk_prefetched"))
         owner_ids = sorted(
             set(int(h) for h in owners) | set(self.owners.seeds_by_owner())
         )

@@ -960,6 +960,7 @@ class TierPrediction(NamedTuple):
     flush_s: float         # gather + device dispatch (split path: serial)
     qps: float             # bucket / flush_s
     slowdown_vs_hbm: float # flush_s over the all-HBM flush_s
+    prefetch_hit_rate: float = 0.0  # disk rows already staged at gather
 
 
 def tier_table(
@@ -972,6 +973,7 @@ def tier_table(
     feature_dim: int = 100,
     bytes_per_elem: float = 4.0,
     read_workers: int = 4,
+    prefetch_hit_rate: float = 0.0,
 ) -> List[TierPrediction]:
     """Price disk/DRAM/HBM HIT MIXES for the round-14 tiered serve path
     — the `scaling` face of the disk tier, answering "what does a
@@ -998,12 +1000,27 @@ def tier_table(
     gather is host-mediated (split dispatch path), so a flush costs
     ``gather + dispatch`` serially — the honest upper bound the probe's
     measured p99 is compared against.
+
+    ``prefetch_hit_rate`` (round 18): the measured fraction of disk rows
+    a flush-ahead prefetch already staged in DRAM when the gather ran
+    (``tier_prefetch_hit / tier_prefetch_issued``-weighted attribution,
+    or the probe's `disk_prefetched` gather share over the disk total).
+    A staged row costs the DRAM-staging consume (priced at
+    ``host_row_s``) instead of the pooled backing read — the column this
+    knob adds is how the table prices "hide the read" against "shorten
+    the read".
     """
     if bucket < 1:
         raise ValueError("bucket must be >= 1")
     if read_workers < 1:
         raise ValueError("read_workers must be >= 1")
+    p = float(prefetch_hit_rate)
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"prefetch_hit_rate must be in [0, 1]: {p}")
     base = dispatch_s + bucket * hbm_row_s
+    # a staged disk row is consumed from DRAM at gather time; the
+    # remainder pays the pooled backing read
+    disk_eff_s = (1.0 - p) * disk_row_s / read_workers + p * host_row_s
     rows: List[TierPrediction] = []
     for name, f_hbm, f_host, f_disk in mixes:
         fracs = (float(f_hbm), float(f_host), float(f_disk))
@@ -1015,7 +1032,7 @@ def tier_table(
         gather_s = bucket * (
             f_hbm * hbm_row_s
             + f_host * host_row_s
-            + f_disk * disk_row_s / read_workers
+            + f_disk * disk_eff_s
         )
         h2d = bucket * (f_host + f_disk) * feature_dim * bytes_per_elem
         flush_s = dispatch_s + gather_s
@@ -1030,6 +1047,7 @@ def tier_table(
                 flush_s=flush_s,
                 qps=bucket / flush_s if flush_s > 0 else 0.0,
                 slowdown_vs_hbm=flush_s / base if base > 0 else 0.0,
+                prefetch_hit_rate=p,
             )
         )
     return rows
@@ -1037,13 +1055,14 @@ def tier_table(
 
 def format_tier_markdown(rows: Sequence[TierPrediction]) -> str:
     lines = [
-        "| mix | hbm | dram | disk | gather ms | H2D KB | flush ms | QPS bound | vs all-HBM |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| mix | hbm | dram | disk | pf hit | gather ms | H2D KB | flush ms | QPS bound | vs all-HBM |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         lines.append(
             f"| {r.mix} | {r.hbm_frac:.0%} | {r.host_frac:.0%} "
-            f"| {r.disk_frac:.0%} | {r.gather_s*1e3:.3f} "
+            f"| {r.disk_frac:.0%} | {r.prefetch_hit_rate:.0%} "
+            f"| {r.gather_s*1e3:.3f} "
             f"| {r.h2d_bytes/1e3:.1f} | {r.flush_s*1e3:.2f} "
             f"| {r.qps:.0f} | {r.slowdown_vs_hbm:.2f}x |"
         )
@@ -1052,7 +1071,10 @@ def format_tier_markdown(rows: Sequence[TierPrediction]) -> str:
         "Hit mixes priced with MEASURED per-row tier costs (bench/probe "
         "inputs; disk term divided by the read pool width). Feed measured "
         "attribution (skew_report tiers) or Che-predicted hit rates at a "
-        "candidate capacity — the round-14 placement planning table."
+        "candidate capacity — the round-14 placement planning table. "
+        "`pf hit` (round 18) is the measured flush-ahead prefetch hit "
+        "rate: that fraction of disk rows is priced at the DRAM-staging "
+        "consume instead of the pooled backing read."
     )
     return "\n".join(lines)
 

@@ -201,17 +201,75 @@ class TieredFeaturePipeline:
     by the cache boundary, native-gather the cold rows, enqueue ONE async H2D
     copy. All device work this object dispatches is async; the caller's train
     step consumes the arrays without further host syncs.
+
+    Round 18 (ROADMAP item 3b — train THROUGH the disk tier): the cold
+    stage now spans the whole hierarchy. A static 4-tier feature
+    (``disk_path`` without ``adaptive_tiers``) gathers its DRAM middle
+    from the host tail and its cold tail from the flat-file
+    `tiers.DiskShard` (through the feature's `AsyncReadPool`); an
+    adaptive feature (``adaptive_tiers=True``) snapshots its
+    `tiers.TierStore` placement at construction and routes each batch by
+    it — HBM-resident rows ride the fused in-jit gather exactly like the
+    round-3 hot prefix (``mapped`` then carries HBM SLOTS), DRAM/disk
+    rows assemble host-side. Bytes are identical to an all-DRAM epoch by
+    construction (the backing file is the same stored table), so epoch
+    loss curves are bit-parity-pinned in tests/test_prefetch.py.
+
+    ``prefetch=True`` adds the flush-ahead leg: the SAMPLE stage issues
+    `AsyncReadPool` reads for a batch's disk-resident rows one stage
+    before the gather stage consumes them (`tiers.PrefetchBuffer` — the
+    exact ids, no closure walk needed: the sample already materialized
+    ``n_id``), so the gather finds the bytes in DRAM staging. Strictly
+    observe-only on bits, same contract as the serve engines.
+
+    PLACEMENT FREEZE: an adaptive pipeline reads a placement SNAPSHOT
+    (maps copied, table references pinned — jax arrays are immutable, so
+    promotions cannot corrupt the pinned HBM view) taken at
+    construction. Do not run `adapt_tiers`/`apply_placement` against the
+    same store mid-epoch: a host-DRAM promotion mutates the store's
+    ``host_cache`` in place, which the snapshot cannot defend against.
+    Build a fresh pipeline after a placement batch instead.
     """
 
-    def __init__(self, feature: Feature, device=None):
-        if getattr(feature, "tier_store", None) is not None:
-            # adaptive disk-backed features have no shard book at all —
-            # name the real reason before the generic not-built error
-            raise ValueError(
-                "the train pipeline does not span the disk tier (its cold "
-                "stage gathers the host tail only); adaptive disk-backed "
-                "features serve through the engines' tiered __getitem__ path"
+    def __init__(self, feature: Feature, device=None, prefetch: bool = False,
+                 prefetch_max_rows: int = 8192):
+        from .tiers import TIER_HBM, TIER_HOST
+
+        self.feature = feature
+        self.device = device or jax.local_devices()[0]
+        self.dtype = getattr(feature, "dtype", np.dtype(np.float32))
+        self._order = feature.feature_order  # old id -> stored row (or None)
+        from .ops import cpu_kernels
+
+        self._gather = cpu_kernels.gather_rows
+        # true tier traffic (padding excluded), accumulated across prepare()
+        self.cold_rows_seen = 0
+        self.rows_seen = 0
+        self.disk_rows_seen = 0
+        self._prefetch = None  # tiers.PrefetchBuffer when enabled
+        store = getattr(feature, "tier_store", None)
+        if store is not None:
+            # adaptive: freeze the placement (see docstring). The HBM
+            # table reference is pinned — placement applies build NEW
+            # arrays, never mutate this one.
+            self.mode = "adaptive"
+            self._store = store
+            self._tier_of = store.placement.tier_of.copy()
+            self._slot_of = store.placement.slot_of.copy()
+            self._tier_hbm, self._tier_host = TIER_HBM, TIER_HOST
+            self.hot_rows = store.placement.hbm_rows
+            self.hot_table = (
+                store.hbm_table if store.hbm_table is not None
+                else jnp.zeros((0, feature.dim), self.dtype,
+                               device=self.device)
             )
+            self._host_cache = store.host_cache
+            self._disk_read = None  # adaptive reads go through the store
+            if prefetch:
+                self._prefetch = store.enable_prefetch(
+                    max_rows=prefetch_max_rows
+                )
+            return
         st = feature.shard_tensor
         if st is None:
             raise ValueError("feature not built; call from_cpu_tensor first")
@@ -220,15 +278,7 @@ class TieredFeaturePipeline:
                 "tiered pipeline expects one hot shard + optional host tail; "
                 "use the mesh-sharded gather for clique-striped features"
             )
-        if getattr(st, "disk_shard", None) is not None:
-            raise ValueError(
-                "the train pipeline does not span the disk tier (its cold "
-                "stage gathers the host tail only); disk-backed features "
-                "serve through the engines' tiered __getitem__ path"
-            )
-        self.feature = feature
-        self.device = device or jax.local_devices()[0]
-        self.dtype = getattr(feature, "dtype", np.dtype(np.float32))
+        self._store = None
         if st.device_shards:
             _, self.hot_table, off = st.device_shards[0]
             self.hot_rows = off.end - off.start
@@ -236,13 +286,31 @@ class TieredFeaturePipeline:
             self.hot_table = jnp.zeros((0, feature.dim), self.dtype, device=self.device)
             self.hot_rows = 0
         self.cold_np = st.cpu_tensor  # may be None (fully resident)
-        self._order = feature.feature_order  # old id -> stored row (or None)
-        from .ops import cpu_kernels
+        self._disk = getattr(st, "disk_shard", None)
+        if self._disk is not None:
+            self.mode = "disk"
+            self._disk_start = st.disk_offset.start
+            self._disk_pool = getattr(st, "read_pool", None) \
+                or getattr(feature, "read_pool", None)
+            if prefetch:
+                if self._disk_pool is None:
+                    raise ValueError(
+                        "prefetch needs an AsyncReadPool (build the "
+                        "Feature with read_pool=/disk_read_workers=)"
+                    )
+                from .tiers import PrefetchBuffer
 
-        self._gather = cpu_kernels.gather_rows
-        # true tier traffic (padding excluded), accumulated across prepare()
-        self.cold_rows_seen = 0
-        self.rows_seen = 0
+                self._prefetch = PrefetchBuffer(
+                    lambda ids: self._disk.read_block(ids),
+                    self._disk_pool, max_rows=prefetch_max_rows,
+                )
+                # attribution honesty (round-18 satellite): the feature's
+                # observe-only tier counter reports staged disk rows as
+                # `disk_prefetched`
+                if hasattr(feature, "disk_staged"):
+                    feature.disk_staged = self._prefetch.staged_mask
+        else:
+            self.mode = "dram"
 
     def prepare_host(
         self, ids: np.ndarray, valid_count: Optional[int] = None
@@ -264,10 +332,13 @@ class TieredFeaturePipeline:
             if valid_count is not None and valid_count < W:
                 invalid[valid_count:] = True
             safe = np.where(invalid, 0, ids)
-            mapped = self._order[safe] if self._order is not None else safe
-            mapped = np.where(invalid, -1, mapped).astype(np.int32)
+            stored = self._order[safe] if self._order is not None else safe
+            stored = np.where(invalid, -1, stored)
             self.rows_seen += W
-            if self.cold_np is None:
+            if self.mode == "adaptive":
+                return self._prepare_adaptive(stored, W)
+            mapped = stored.astype(np.int32)
+            if self.cold_np is None and self.mode != "disk":
                 return HostStaged(mapped, None, None)
             (cold_sel,) = np.nonzero(mapped >= self.hot_rows)
             if cold_sel.size == 0:
@@ -279,11 +350,119 @@ class TieredFeaturePipeline:
             pos = np.full(b, W, np.int32)  # W == out-of-range -> dropped
             pos[: cold_sel.shape[0]] = cold_sel
             rows = np.zeros((b, self.feature.dim), self.dtype)
+            cold_ids = mapped[cold_sel].astype(np.int64)
             with trace_scope("pipeline.cold_gather"):
-                rows[: cold_sel.size] = self._gather(
-                    self.cold_np, mapped[cold_sel] - self.hot_rows
-                )
+                if self.mode == "disk":
+                    host_sel = np.nonzero(cold_ids < self._disk_start)[0]
+                    if host_sel.size and self.cold_np is not None:
+                        rows[host_sel] = self._gather(
+                            self.cold_np, cold_ids[host_sel] - self.hot_rows
+                        )
+                    disk_sel = np.nonzero(cold_ids >= self._disk_start)[0]
+                    if disk_sel.size:
+                        self.disk_rows_seen += int(disk_sel.size)
+                        rows[disk_sel] = self._read_disk(
+                            cold_ids[disk_sel] - self._disk_start
+                        )
+                else:
+                    rows[: cold_sel.size] = self._gather(
+                        self.cold_np, cold_ids - self.hot_rows
+                    )
             return HostStaged(mapped, rows, pos)
+
+    def _read_disk(self, local_ids: np.ndarray) -> np.ndarray:
+        """Disk-tier rows for the static layout, staging-aware: rows the
+        sample stage prefetched come out of DRAM, the rest through the
+        pooled flat-file read — byte-identical either way."""
+        def read(ids):
+            return self._disk.read_rows(ids, pool=self._disk_pool)
+
+        pf = self._prefetch
+        if pf is None:
+            return read(local_ids)
+        return pf.take_or_read(local_ids, read)
+
+    def _prepare_adaptive(self, stored: np.ndarray, W: int) -> "HostStaged":
+        """Adaptive-placement staging against the frozen snapshot:
+        ``mapped`` carries HBM SLOTS (the pinned hot table is
+        slot-indexed), -1 elsewhere; DRAM/disk rows assemble host-side
+        — DRAM from the store's cache slots, disk through
+        `TierStore.gather`'s own staging-aware read path semantics
+        (prefetched rows out of DRAM, the rest from the backing file)."""
+        valid = stored >= 0
+        safe = np.where(valid, stored, 0)
+        tiers = self._tier_of[safe]
+        is_hbm = valid & (tiers == self._tier_hbm)
+        mapped = np.where(is_hbm, self._slot_of[safe], -1).astype(np.int32)
+        (cold_sel,) = np.nonzero(valid & ~is_hbm)
+        if cold_sel.size == 0:
+            return HostStaged(mapped, None, None)
+        self.cold_rows_seen += int(cold_sel.shape[0])
+        b = round_up_pow2(cold_sel.shape[0], floor=256)
+        pos = np.full(b, W, np.int32)
+        pos[: cold_sel.shape[0]] = cold_sel
+        rows = np.zeros((b, self.feature.dim), self.dtype)
+        cold_ids = stored[cold_sel]
+        cold_tiers = tiers[cold_sel]
+        with trace_scope("pipeline.cold_gather"):
+            host_sel = np.nonzero(cold_tiers == self._tier_host)[0]
+            if host_sel.size and self._host_cache is not None:
+                rows[host_sel] = self._gather(
+                    self._host_cache, self._slot_of[cold_ids[host_sel]]
+                )
+            disk_sel = np.nonzero(cold_tiers != self._tier_host)[0]
+            if disk_sel.size:
+                self.disk_rows_seen += int(disk_sel.size)
+                rows[disk_sel] = self._read_backing(cold_ids[disk_sel])
+        return HostStaged(mapped, rows, pos)
+
+    def _read_backing(self, stored_ids: np.ndarray) -> np.ndarray:
+        """Adaptive disk rows: staged prefetch bytes first, backing-file
+        reads for the rest (the store's read pool chunks them)."""
+        store = self._store
+
+        def read(ids):
+            return store.backing.read_rows(ids, pool=store.read_pool)
+
+        pf = self._prefetch
+        if pf is None:
+            return read(stored_ids)
+        return pf.take_or_read(stored_ids, read)
+
+    # -- flush-ahead prefetch (round 18; issued by the SAMPLE stage) -------
+
+    @property
+    def prefetch_stats(self) -> dict:
+        return self._prefetch.stats() if self._prefetch is not None else {}
+
+    def prefetch(self, ids: np.ndarray,
+                 valid_count: Optional[int] = None) -> int:
+        """Issue `AsyncReadPool` reads for the DISK-resident rows of a
+        batch's ``n_id`` — called by the sample stage, one stage before
+        the gather consumes them. Exact ids (the sample already
+        materialized them), so nothing here is speculative; returns rows
+        issued. Observe-only on bits."""
+        pf = self._prefetch
+        if pf is None:
+            return 0
+        ids = np.asarray(ids).astype(np.int64).reshape(-1)
+        if valid_count is not None and valid_count < ids.shape[0]:
+            ids = ids[:valid_count]
+        n_total = self.feature.shape[0]
+        ids = ids[(ids >= 0) & (ids < n_total)]
+        if ids.size == 0:
+            return 0
+        stored = self._order[ids] if self._order is not None else ids
+        if self.mode == "adaptive":
+            disk = stored[self._tier_of[stored] > self._tier_host]
+            return pf.issue(disk) if disk.size else 0
+        local = stored[stored >= self._disk_start] - self._disk_start
+        return pf.issue(local) if local.size else 0
+
+    def cancel_prefetch(self) -> int:
+        """Drop staged rows (mid-epoch error unwind / epoch end): see
+        `tiers.PrefetchBuffer.cancel`."""
+        return self._prefetch.cancel() if self._prefetch is not None else 0
 
     def upload(
         self, staged: "HostStaged"
@@ -445,6 +624,11 @@ class TrainPipeline:
         if seeds is None:
             # the seed batch is always the n_id prefix (both pipelines)
             seeds = ids[: ds.batch_size]
+        # flush-ahead prefetch (round 18): issue this batch's disk reads
+        # NOW — the gather stage consumes them one stage later, so the
+        # reads overlap the PREVIOUS batch's gather/upload/step instead
+        # of sitting on the cold-gather critical path
+        self.tiered.prefetch(ids, valid_count=vc)
         return ds, seeds, ids, vc
 
     def _gather_body(self, ds, seeds, ids, vc):
@@ -487,6 +671,18 @@ class TrainPipeline:
         reg.counter_fn(f"{prefix}_tier_cold_rows_seen_total",
                        lambda: self.tiered.cold_rows_seen,
                        "rows answered by the cold tier", labels)
+        reg.counter_fn(f"{prefix}_tier_disk_rows_seen_total",
+                       lambda: self.tiered.disk_rows_seen,
+                       "cold rows answered by the disk tier", labels)
+        reg.counter_fn(
+            f"{prefix}_tier_prefetch_issued_total",
+            lambda: self.tiered.prefetch_stats.get("issued", 0),
+            "disk rows issued flush-ahead by the sample stage", labels)
+        reg.counter_fn(
+            f"{prefix}_tier_prefetch_hits_total",
+            lambda: self.tiered.prefetch_stats.get("hits", 0),
+            "prefetched rows the gather stage consumed from staging",
+            labels)
         return reg
 
     def export_chrome_trace(self, path: str, metadata=None):
@@ -641,6 +837,10 @@ class TrainPipeline:
                     f.add_done_callback(
                         lambda fut: fut.cancelled() or fut.exception()
                     )
+            # flush-ahead reads issued for batches nobody will gather:
+            # cancel + observe them so the unwind leaves no pool zombies
+            # (the r7/r14 error contract extended to the prefetch leg)
+            self.tiered.cancel_prefetch()
             raise
         finally:
             spool.shutdown(wait=True)

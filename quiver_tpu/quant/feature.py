@@ -184,6 +184,22 @@ class QuantizedFeature:
         static) — placement moves operate on ENCODED rows."""
         return None if self.inner is None else self.inner.tier_store
 
+    @property
+    def disk_staged(self):
+        """Flush-ahead staging mask hook (round 18), delegated to the
+        inner Feature: whoever runs the prefetch installs it through the
+        wrapper and the ENCODED store's attribution reports
+        ``disk_prefetched`` truthfully (staged rows are encoded rows —
+        the staging buffer holds codec-width bytes)."""
+        return None if self.inner is None else self.inner.disk_staged
+
+    @disk_staged.setter
+    def disk_staged(self, fn):
+        if self.inner is None:
+            raise ValueError("disk_staged needs a built feature "
+                             "(call from_cpu_tensor first)")
+        self.inner.disk_staged = fn
+
     def tier_bytes(self):
         """Live ENCODED-payload bytes per tier (see
         `Feature.tier_bytes`); side tables are reported separately by
@@ -290,6 +306,7 @@ class QuantizedFeature:
                 attribute_gather_tiers(
                     self.inner.shard_tensor, self.rank, stored,
                     self.tier_counter, valid=~invalid,
+                    staged=self.inner.disk_staged,
                 )
         if self.row_tap is not None:
             self.row_tap(stored[~invalid])

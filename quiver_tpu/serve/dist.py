@@ -735,6 +735,14 @@ class DistServeConfig:
     tier_promote_min: float = 2.0
     tier_hysteresis: float = 1.25
     tier_adapt_every_s: float = 0.0
+    # round-18 flush-ahead prefetch (same semantics as the ServeConfig
+    # fields, inherited by the default shard config). The ROUTER
+    # additionally prefetches per owner off the routed sub-batches at
+    # its own seal — one window EARLIER than the owner's assemble; the
+    # staging buffer dedups, so router + owner double-issue is free.
+    tier_prefetch: bool = False
+    tier_prefetch_hops: Optional[int] = None
+    tier_prefetch_max_rows: int = 4096
     # -- round-16 elastic fleet (ROADMAP item 2; docs/api.md "Elastic
     # fleet") --------------------------------------------------------------
     # migrate_batch_seeds: the BOUNDED migration unit — a range handoff
@@ -806,6 +814,9 @@ class DistServeConfig:
             tier_promote_batch=self.tier_promote_batch,
             tier_promote_min=self.tier_promote_min,
             tier_hysteresis=self.tier_hysteresis,
+            tier_prefetch=self.tier_prefetch,
+            tier_prefetch_hops=self.tier_prefetch_hops,
+            tier_prefetch_max_rows=self.tier_prefetch_max_rows,
             # round-16 owner-side tenant scheduling: the router forwards
             # each sub-batch's submitting tenants, and owner engines
             # apply the SAME weighted flush quotas — a tenant's share
@@ -1619,6 +1630,20 @@ class DistServeEngine:
                 self.dispatch_log.append(
                     (arr.copy(), [(h, ids.copy()) for h, ids, _ in fl.split])
                 )
+            if self.config.tier_prefetch:
+                # round-18: flush-ahead prefetch PER OWNER off the routed
+                # sub-batches — one window earlier than each owner's own
+                # assemble-time prefetch (their buffers dedup the
+                # overlap). Observe-only: a failing issue never fails the
+                # routed flush, and no owner key is consumed.
+                for h, ids, _ in fl.split:
+                    eng = self.engines.get(h)
+                    if eng is None:  # replica / retired host
+                        continue
+                    try:
+                        eng.prefetch_seeds(ids, fid=fl.fid)
+                    except Exception:
+                        pass
         except BaseException as exc:
             fl.error = exc
 
@@ -3332,6 +3357,11 @@ class DistServeEngine:
                 while self._inflight_flushes and self._clock() < deadline:
                     self._fence.wait(timeout=0.05)
             abandon_undrained(self, drained=drain)
+            # owner engines run un-started in dist mode (the router
+            # drives them synchronously), so their staged prefetch rows
+            # must be cancelled here — futures observed, no worker leaks
+            for eng in self.engines.values():
+                eng._cancel_prefetch()
         finally:
             # _draining stays TRUE after stop: a rebalance loop still
             # holding batches must keep halting even though stop already

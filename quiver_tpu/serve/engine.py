@@ -409,6 +409,45 @@ class ServeConfig:
                      a delta-hot subgraph pulls its rows off disk at the
                      commit, not at the next background timer tick).
                      False = timer/manual adaptation only.
+    tier_prefetch  : round-18 flush-ahead prefetch (ROADMAP item 3a).
+                     At assemble time the engine knows a flush's seed
+                     set one window before dispatch — it walks the
+                     EXPECTED k-hop closure (`tiers.expected_closure`
+                     over the sampler's current graph) and issues
+                     `AsyncReadPool` reads for the disk-resident rows,
+                     so by the time the gather runs the bytes sit in
+                     DRAM staging. STRICTLY OBSERVE-ONLY ON BITS:
+                     staged rows are the same backing-file bytes the
+                     direct read returns, no key is consumed, placement
+                     never moves, and flush composition is untouched —
+                     prefetch on/off serve bit-identical logits and
+                     dispatch logs (pinned at mif 1/2 and hosts 1/2 in
+                     tests/test_prefetch.py). Needs an adaptive
+                     `tiers.TierStore` with a read pool under the
+                     feature; silently inert otherwise. Counters:
+                     ``stats.tier_prefetch_{issued,hit,wasted}``,
+                     journal kinds ``prefetch_issue``/``prefetch_hit``.
+    tier_prefetch_hops : closure depth of the prefetch walk. None
+                     (default) = ``len(sampler.sizes)`` — the GATHERED
+                     closure is one hop deeper than the expansion
+                     closure (the round-11 closure-hops rule: the final
+                     frontier is gathered, never expanded).
+    tier_prefetch_max_rows : bound on closure rows walked AND rows
+                     staged at once (BFS order, so truncation keeps the
+                     nearest rows) — a super-hub seed can never turn
+                     one flush's prefetch into a full-table scan.
+    tier_prefetch_at : when the walk+issue runs. ``"submit"`` (default):
+                     the submit that fills a bucket issues the pending
+                     keys' closure reads BEFORE calling flush, so when
+                     another flush is already in the dispatch path the
+                     reads overlap that flush's ENTIRE service time —
+                     genuinely one window before dispatch — and the
+                     assemble-time pass only walks seeds the submit
+                     batch missed (late admits, window flushes).
+                     ``"assemble"``: walk only at assemble time (the
+                     overlap is the window wait + sample stage). Both
+                     spellings serve identical bits — the knob moves
+                     WHEN reads are issued, never what is served.
     """
 
     max_batch: int = 64
@@ -432,6 +471,10 @@ class ServeConfig:
     drain_deadline_s: float = 30.0
     stream_invalidate_hops: Optional[int] = None
     stream_adapt_tiers: bool = True
+    tier_prefetch: bool = False
+    tier_prefetch_hops: Optional[int] = None
+    tier_prefetch_max_rows: int = 4096
+    tier_prefetch_at: str = "submit"
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -558,6 +601,13 @@ class ServeStats:
     tier_promoted: int = 0      # rows moved UP a tier (round 14)
     tier_demoted: int = 0       # rows moved DOWN a tier
     placement_batches: int = 0  # fenced placement applies
+    # round-18 flush-ahead prefetch ledger: issued counts disk rows
+    # submitted to the read pool ahead of their gather, hit the rows a
+    # gather consumed from staging, wasted the rows staged but dropped
+    # (fence cancels, failed reads, closure rows the draw never touched)
+    tier_prefetch_issued: int = 0
+    tier_prefetch_hit: int = 0
+    tier_prefetch_wasted: int = 0
     shed: int = 0               # requests refused at admission (round 15)
     request_errors: int = 0     # slots resolved with a per-request error
     undrained: int = 0          # slots abandoned by a bounded stop() drain
@@ -611,6 +661,9 @@ class ServeStats:
         self.tier_promoted += other.tier_promoted
         self.tier_demoted += other.tier_demoted
         self.placement_batches += other.placement_batches
+        self.tier_prefetch_issued += other.tier_prefetch_issued
+        self.tier_prefetch_hit += other.tier_prefetch_hit
+        self.tier_prefetch_wasted += other.tier_prefetch_wasted
         self.shed += other.shed
         self.request_errors += other.request_errors
         self.undrained += other.undrained
@@ -642,6 +695,9 @@ class ServeStats:
             "tier_promoted": self.tier_promoted,
             "tier_demoted": self.tier_demoted,
             "placement_batches": self.placement_batches,
+            "tier_prefetch_issued": self.tier_prefetch_issued,
+            "tier_prefetch_hit": self.tier_prefetch_hit,
+            "tier_prefetch_wasted": self.tier_prefetch_wasted,
             "shed": self.shed,
             "request_errors": self.request_errors,
             "undrained": self.undrained,
@@ -717,6 +773,10 @@ class ServeEngine:
             raise ValueError(
                 f"unknown dispatch_mode {self.config.dispatch_mode!r}"
             )
+        if self.config.tier_prefetch_at not in ("submit", "assemble"):
+            raise ValueError(
+                f"unknown tier_prefetch_at {self.config.tier_prefetch_at!r}"
+            )
         self._buckets = self.config.resolved_buckets()
         self._apply = _cached_apply(model)
         self._params = params
@@ -783,6 +843,24 @@ class ServeEngine:
         self._tier_feature = find_tiered_feature(feature)
         self.placement_version = 0
         self.tier_adapt_errors = 0  # failed background adapt passes
+        # round-18 flush-ahead prefetch: bind the tier store's staging
+        # buffer when the config asks for it AND the feature can serve it
+        # (adaptive store + read pool); inert otherwise — a prefetch-on
+        # config over a DRAM-resident feature costs nothing
+        self._prefetch_store = None
+        # seeds the last submit-time walk covered (tier_prefetch_at=
+        # "submit"): the assemble-time catch-all only walks what the
+        # submit batch missed. Safe across flushes — staged rows outlive
+        # their issuer until consumed, and every fence clears both.
+        self._pf_walked: frozenset = frozenset()
+        if self.config.tier_prefetch and self._tier_feature is not None:
+            store = self._tier_feature.tier_store
+            if store.read_pool is not None:
+                store.enable_prefetch(
+                    max_rows=self.config.tier_prefetch_max_rows,
+                    listener=self._on_prefetch_event,
+                )
+                self._prefetch_store = store
         self.params_version = 0
         # round-17 streaming graphs: graph_version counts fenced delta
         # commits (the analog of params_version for topology);
@@ -900,8 +978,36 @@ class ServeEngine:
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
         if need_flush:
+            # flush-ahead prefetch at SUBMIT time (round 18): issue the
+            # filled bucket's closure reads on THIS thread before the
+            # flush work starts — when another flush already holds the
+            # dispatch path, the reads overlap its whole service time.
+            # Observe-only: never reorders admission, never fails a
+            # submit (the assemble-time pass is the catch-all).
+            if (self._prefetch_store is not None
+                    and self.config.tier_prefetch_at == "submit"):
+                self._prefetch_pending()
             self.flush()
         return ServeResult(slot=slot)
+
+    def _prefetch_pending(self) -> None:
+        """Walk+issue the current pending keys' expected closure and
+        remember them so the assemble-time pass skips the repeat walk
+        (`PrefetchBuffer` dedups the READS either way; this skips the
+        redundant closure BFS on the serve path)."""
+        with self._lock:
+            keys = tuple(self._pending.keys())
+        if not keys:
+            return
+        try:
+            self.prefetch_seeds(np.asarray(keys, np.int64))
+            # REPLACE the memo (never union): it must mean "walked and
+            # certainly still staged" — keys from older batches may have
+            # been consumed already, and skipping their re-walk would
+            # quietly zero their hit rate on a later arrival
+            self._pf_walked = frozenset(keys)
+        except Exception:
+            pass
 
     def _shed_locked(self, tenant: str) -> bool:
         return shed_decision(
@@ -1129,6 +1235,14 @@ class ServeEngine:
                     self.stats.spans.record("assemble", t0, self._clock())
                 if fl is None:
                     return 0
+                # flush-ahead prefetch: issue the expected closure's disk
+                # reads NOW, before the window wait — they land while the
+                # previous flush's dispatch (and this one's window wait)
+                # runs, so the gather below finds them in DRAM
+                if self._prefetch_store is not None:
+                    t0p = self._clock()
+                    self._prefetch_flush(fl)
+                    self.stats.spans.record("prefetch", t0p, self._clock())
                 try:
                     jr = self.journal
                     t_w0 = self._clock() if jr.enabled else 0.0
@@ -1183,6 +1297,79 @@ class ServeEngine:
         with self._lock:
             return bool(self._pending)
 
+    # -- flush-ahead prefetch (round 18, ROADMAP item 3a) ------------------
+
+    def _on_prefetch_event(self, kind: str, n: int) -> None:
+        """Staging-buffer tap: mirrors consumption/waste into ServeStats
+        and the journal (plain ints under the GIL — the ServeStats
+        discipline). ``hit`` fires at gather time, which may be a
+        different flush than the issuer, so the event carries no fid."""
+        if kind == "hit":
+            self.stats.tier_prefetch_hit += n
+            self.journal.emit("prefetch_hit", -1, -1, n)
+        elif kind == "wasted":
+            self.stats.tier_prefetch_wasted += n
+
+    def prefetch_seeds(self, seed_ids, fid: int = -1) -> int:
+        """Issue flush-ahead disk reads for the expected k-hop closure
+        of ``seed_ids`` (OBSERVE-ONLY: no key consumed, no placement
+        moved, no served bit changed — see ``ServeConfig.tier_prefetch``).
+        Returns rows issued. The engine calls this itself at assemble
+        time; `DistServeEngine` calls it per owner off the routed
+        sub-batches, one window earlier still. Dedup in the staging
+        buffer makes the double-issue free."""
+        store = self._prefetch_store
+        if store is None:
+            return 0
+        from ..tiers import expected_closure
+
+        hops = self.config.tier_prefetch_hops
+        if hops is None:
+            hops = len(self._sampler.sizes)
+        nodes = expected_closure(
+            self._sampler, np.asarray(seed_ids, np.int64), hops,
+            max_nodes=self.config.tier_prefetch_max_rows,
+        )
+        if nodes.size == 0:
+            return 0
+        stored = self._tier_feature.stored_rows_of(nodes)
+        issued = store.prefetch_rows(stored[stored >= 0])
+        if issued:
+            self.stats.tier_prefetch_issued += issued
+            self.journal.emit("prefetch_issue", -1, fid, issued,
+                              int(nodes.size))
+        return issued
+
+    def _prefetch_flush(self, fl: "_Flush") -> None:
+        """Assemble-time prefetch for a drained flush (called under
+        ``_seq``, before the window wait — the reads overlap the
+        PREVIOUS flush's dispatch). With ``tier_prefetch_at="submit"``
+        this is the catch-all for seeds the submit-time walk missed
+        (late admits, window flushes). Never fails a flush: prefetch is
+        a hint, and any error here would break the on/off parity pin."""
+        if self._prefetch_store is None:
+            return
+        keys = fl.keys
+        if self._pf_walked:
+            missed = [k for k in keys if k not in self._pf_walked]
+            if not missed:
+                return
+            keys = missed
+        try:
+            self.prefetch_seeds(keys, fid=fl.fid)
+        except Exception:
+            pass
+
+    def _cancel_prefetch(self) -> None:
+        """Fence hook: drop staged prefetch rows (counted as wasted).
+        Callers hold the fence (no gather in flight), so nothing races
+        the staging map. The submit-walk memo clears with it — staged
+        rows are gone, so "already walked" no longer implies "already
+        staged"."""
+        self._pf_walked = frozenset()
+        if self._prefetch_store is not None:
+            self._prefetch_store.cancel_prefetch()
+
     def reset_stats(self) -> None:
         """Zero every counter/histogram AND re-point the embedding cache's
         counter at the fresh `ServeStats` (the two must move together — a
@@ -1223,7 +1410,9 @@ class ServeEngine:
         for f in ("requests", "coalesced", "dispatches", "dispatched_seeds",
                   "padded_seeds", "dispatch_calls", "execute_calls",
                   "late_admitted", "tier_promoted", "tier_demoted",
-                  "placement_batches", "shed", "request_errors",
+                  "placement_batches", "tier_prefetch_issued",
+                  "tier_prefetch_hit", "tier_prefetch_wasted",
+                  "shed", "request_errors",
                   "undrained", "graph_deltas", "delta_edges",
                   "delta_tile_writes", "delta_tile_spills",
                   "delta_cache_invalidated"):
@@ -1265,6 +1454,11 @@ class ServeEngine:
         reg.gauge_fn(f"{prefix}_tier_adapt_errors",
                      lambda: self.tier_adapt_errors,
                      "failed background tier-adaptation passes", labels)
+        reg.gauge_fn(
+            f"{prefix}_tier_prefetch_hit_rate",
+            lambda: (self.stats.tier_prefetch_hit
+                     / max(self.stats.tier_prefetch_issued, 1)),
+            "flush-ahead prefetch rows consumed over rows issued", labels)
         if self._tier_feature is not None:
             reg.gauge_fn(
                 f"{prefix}_tier_hbm_rows",
@@ -1390,6 +1584,11 @@ class ServeEngine:
             with self._fence:
                 while self._inflight_flushes:
                     self._fence.wait()
+                # a prefetch issued for a pre-fence flush may still be in
+                # flight: drop the staging (bytes stay valid forever, but
+                # the rows' consumers are gone — holding them would only
+                # skew waste accounting). Never blocks on the pool.
+                self._cancel_prefetch()
                 self._params = params
                 self.params_version += 1
                 self.cache.invalidate()
@@ -1473,6 +1672,10 @@ class ServeEngine:
                 with self._fence:
                     while self._inflight_flushes:
                         self._fence.wait()
+                    # graph deltas change the expected closure: staged
+                    # prefetch rows keep valid bytes but stale intent —
+                    # drop them with the other fence consumers
+                    self._cancel_prefetch()
                     summary = stream.apply(delta, installs=installs)
                     applied = True
                     self.graph_version += 1
@@ -1562,6 +1765,13 @@ class ServeEngine:
             with self._fence:
                 while self._inflight_flushes:
                     self._fence.wait()
+                # TierStore.apply cancels the staged rows itself, but the
+                # ENGINE's submit-walk memo must clear with them: after a
+                # placement batch "already walked" no longer implies
+                # "already staged", and a stale memo would quietly skip
+                # re-staging at the next assemble (hit-rate loss, not a
+                # bit error)
+                self._cancel_prefetch()
                 summary = feat.tier_store.apply(plan)
                 self.placement_version += 1
                 self.stats.tier_promoted += summary["promoted_rows"]
@@ -1725,6 +1935,10 @@ class ServeEngine:
         with self._fence:
             while self._inflight_flushes and self._clock() < deadline:
                 self._fence.wait(timeout=0.05)
+        # staged prefetch rows outlive their flushes at stop: cancel so
+        # the pool's futures are observed (no GC log spam) and the waste
+        # ledger closes — pinned leak-free in tests/test_prefetch.py
+        self._cancel_prefetch()
         abandon_undrained(self, drained=drain)
 
     def _poll_loop(self) -> None:

@@ -448,6 +448,53 @@ class StreamingTiledGraph:
     def free_rows(self) -> int:
         return self.m_cap - self._free_row
 
+    def _reserve_report_locked(self) -> Dict[str, object]:
+        used = self._free_row - self.m_base
+        free = self.m_cap - self._free_row
+        commits = self.version
+        per_commit = used / commits if commits else 0.0
+        return {
+            "tiles_base": self.m_base,
+            "tiles_cap": self.m_cap,
+            "reserve_tiles": self.m_cap - self.m_base,
+            "reserve_used": used,
+            "reserve_free": free,
+            "commits": commits,
+            "rows_per_commit": per_commit,
+            # None = no consumption observed yet (or none at all): there
+            # is nothing honest to project from
+            "projected_commits_to_exhaustion": (
+                free / per_commit if per_commit > 0 else None
+            ),
+            "tile_spills": self.stats["tile_spills"],
+            "installs": self.stats["installs"],
+        }
+
+    def reserve_report(self) -> Dict[str, object]:
+        """Live reserve budget (round-18 satellite — the r17 "capacity
+        exhaustion is a planned hard error" leftover made diagnosable):
+        tiles used / remaining, consumption rate per commit, and the
+        projected commits left at that rate (None before any
+        consumption). `StreamCapacityError` messages carry the same
+        numbers, so the planned hard error names its own runway."""
+        with self._lock:
+            return self._reserve_report_locked()
+
+    def _capacity_error(self, prefix: str) -> StreamCapacityError:
+        """Build the planned hard error WITH the reserve diagnosis
+        (caller holds ``_lock``)."""
+        r = self._reserve_report_locked()
+        proj = r["projected_commits_to_exhaustion"]
+        return StreamCapacityError(
+            f"{prefix} — reserve {r['reserve_used']}/{r['reserve_tiles']} "
+            f"rows used over {r['commits']} commit(s) "
+            f"({r['rows_per_commit']:.2f} rows/commit"
+            + (f", ~{proj:.0f} commits of runway were left"
+               if proj is not None else "")
+            + "); rebuild the stream with a larger reserve_frac/"
+            "reserve_tiles (shapes are frozen — see StreamingTiledGraph)"
+        )
+
     def graph(self):
         """The CURRENT device ``(bd, tiles)`` pair — what a stream-bound
         `GraphSageSampler` samples from (`bind_stream`). Array objects
@@ -543,11 +590,9 @@ class StreamingTiledGraph:
             sim_deg[u] = d + 1
         free = self.m_cap - self._free_row
         if need > free:
-            raise StreamCapacityError(
+            raise self._capacity_error(
                 f"tile reserve exhausted: batch needs {need} rows, "
-                f"{free} free of {self.m_cap - self.m_base} reserved — "
-                "rebuild the stream with a larger reserve (shapes are "
-                "frozen; see StreamingTiledGraph docstring)"
+                f"{free} free"
             )
         return need
 
@@ -640,12 +685,9 @@ class StreamingTiledGraph:
         old_rows = int(self.alloc_rows[u])
         need = old_rows + self.grow_tiles
         if self._free_row + need > self.m_cap:
-            raise StreamCapacityError(
+            raise self._capacity_error(
                 f"tile reserve exhausted: node {u} needs {need} rows, "
-                f"{self.m_cap - self._free_row} free of "
-                f"{self.m_cap - self.m_base} reserved — rebuild the "
-                "stream with a larger reserve (shapes are frozen; see "
-                "StreamingTiledGraph docstring)"
+                f"{self.m_cap - self._free_row} free"
             )
         new_base = self._free_row
         self._free_row += need
@@ -672,7 +714,7 @@ class StreamingTiledGraph:
             return
         need = -(-int(nbrs.size) // LANE)
         if self._free_row + need > self.m_cap:
-            raise StreamCapacityError(
+            raise self._capacity_error(
                 f"tile reserve exhausted installing node {node} "
                 f"({need} rows needed, {self.m_cap - self._free_row} free)"
             )

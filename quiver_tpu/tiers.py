@@ -63,6 +63,59 @@ TIER_HOST = 1
 TIER_DISK = 2
 TIER_NAMES = ("hbm", "host", "disk")
 
+# O_DIRECT reads must be aligned to the device's logical block size in
+# offset, length AND buffer address; 4096 covers every common device
+# (512e drives accept it too). Anonymous mmap buffers are page-aligned,
+# which is what makes the direct path possible from Python at all.
+DIRECT_ALIGN = 4096
+
+
+def drop_page_cache(path: str) -> bool:
+    """Ask the kernel to evict ``path``'s pages from the page cache
+    (``posix_fadvise(DONTNEED)`` over the whole file) — the portable
+    page-cache defeat for real-disk measurement when the filesystem
+    refuses O_DIRECT. Best-effort: returns False (instead of raising)
+    on platforms without the syscall, so probes can record WHICH method
+    actually ran."""
+    if not hasattr(os, "posix_fadvise"):
+        return False
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return False
+    try:
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        return True
+    except OSError:
+        return False
+    finally:
+        os.close(fd)
+
+
+def o_direct_supported(path: str) -> bool:
+    """Whether ``path``'s filesystem accepts an O_DIRECT aligned read —
+    probed by actually doing one (overlayfs/tmpfs commonly refuse with
+    EINVAL; the only honest answer is empirical). The probe reads the
+    first aligned block into a page-aligned anonymous mmap buffer."""
+    if not hasattr(os, "O_DIRECT"):
+        return False
+    import mmap as _mmap
+
+    try:
+        fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+    except OSError:
+        return False
+    try:
+        buf = _mmap.mmap(-1, DIRECT_ALIGN)
+        try:
+            return os.preadv(fd, [buf], 0) >= 0
+        finally:
+            buf.close()
+    except OSError:
+        return False
+    finally:
+        os.close(fd)
+
 
 class DiskShard:
     """Flat-file ``[R, D]`` row shard on disk (``.npy`` format, read
@@ -76,15 +129,145 @@ class DiskShard:
     Out-of-range ids raise loudly: unlike lookup padding (which the
     callers mask BEFORE reaching the disk tier), a bad local id here
     means a corrupt placement map, not padding.
+
+    ``direct=True`` (round 18, real-disk measurement) reads through an
+    ``O_DIRECT`` descriptor instead of the memmap: every ``read_block``
+    is an aligned pread into a page-aligned buffer, bypassing the page
+    cache entirely — the honest cold-read path a 10x-DRAM claim must be
+    measured on. Bytes are identical to the memmap path by construction
+    (same file, same offsets); only the cache behavior differs. Raises
+    at open when the filesystem refuses O_DIRECT (probe with
+    :func:`o_direct_supported` first; fall back to
+    :func:`drop_page_cache` between measurement legs).
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, direct: bool = False):
         self.path = path
         # mmap_mode='r': reads hit the page cache; nothing is resident
         # until touched, which is the whole point of the tier
         self._mm = np.load(path, mmap_mode="r")
         if self._mm.ndim != 2:
             raise ValueError(f"disk shard {path} must be [R, D]")
+        self.direct = bool(direct)
+        self._fd = None
+        if self.direct:
+            if not hasattr(os, "O_DIRECT"):
+                raise OSError("platform has no O_DIRECT")
+            # raises OSError where the filesystem refuses — callers that
+            # want a fallback probe o_direct_supported() first
+            self._fd = os.open(path, os.O_RDONLY | os.O_DIRECT)
+            if not o_direct_supported(path):
+                os.close(self._fd)
+                self._fd = None
+                raise OSError(f"filesystem refuses O_DIRECT reads: {path}")
+            # the npy payload offset: np.load's memmap records where the
+            # header ends — direct preads address rows relative to it
+            self._data_off = int(self._mm.offset)
+            # PER-THREAD descriptors for pooled reads: concurrent preads
+            # on one shared fd serialize in the kernel (measured SLOWER
+            # than single-threaded on this box's filesystem), so each
+            # pool worker reads through its own fd. _fd above stays the
+            # probe/owner descriptor; _all_fds tracks every lazy open
+            # for close.
+            self._tls = threading.local()
+            self._all_fds: List[int] = [self._fd]
+            self._fd_lock = threading.Lock()
+
+    def _direct_fd(self) -> int:
+        fd = getattr(self._tls, "fd", None)
+        if fd is None:
+            fd = os.open(self.path, os.O_RDONLY | os.O_DIRECT)
+            self._tls.fd = fd
+            with self._fd_lock:
+                self._all_fds.append(fd)
+        return fd
+
+    def _direct_buf(self, nbytes: int) -> np.ndarray:
+        """This thread's persistent block-address-aligned read buffer,
+        grown (never shrunk) to ``nbytes``."""
+        buf = getattr(self._tls, "buf", None)
+        if buf is None or buf.shape[0] < nbytes:
+            base = np.empty(nbytes + DIRECT_ALIGN, np.uint8)
+            shift = (-base.ctypes.data) % DIRECT_ALIGN
+            buf = base[shift: shift + nbytes]
+            self._tls.buf_base = base  # keeps the allocation alive
+            self._tls.buf = buf
+        return buf
+
+    def __del__(self):
+        fds = getattr(self, "_all_fds", None)
+        if fds is None:
+            fds = [f for f in (getattr(self, "_fd", None),)
+                   if f is not None]
+        for fd in fds:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    # contiguous aligned spans merge into one pread up to this many
+    # bytes: amortizes the per-syscall cost (and the Python dispatch
+    # around it, which holds the GIL) without unbounded buffer growth
+    DIRECT_RUN_BYTES = 1 << 20
+
+    def _read_block_direct(self, ids: np.ndarray) -> np.ndarray:
+        """Aligned O_DIRECT gather, span-grouped: rows are bucketed by
+        the aligned block span enclosing them, spans dedup (rows smaller
+        than a block share one read), and CONTIGUOUS spans merge into a
+        single pread up to ``DIRECT_RUN_BYTES``. A naive per-row pread
+        loop is GIL-bound from Python — per-row slicing serializes pool
+        workers and 128-byte rows re-read the same 4 KiB block 32 times
+        — so grouping is what makes the direct path pool-parallel at
+        all. Reads land in a PERSISTENT per-thread block-aligned buffer
+        (O_DIRECT requires the buffer ADDRESS aligned too): a fresh
+        anonymous mmap per call would serialize pool workers on the
+        process mmap lock and pay a TLB shootdown at every munmap —
+        measured 4x slower across 4 workers than one thread. Never
+        touches the page cache; bytes equal the memmap path (same file
+        region)."""
+        rb = self.row_bytes
+        out = np.empty((ids.shape[0], self._mm.shape[1]), self._mm.dtype)
+        row_u8 = out.view(np.uint8).reshape(ids.shape[0], rb)
+        offs = self._data_off + ids.astype(np.int64) * rb
+        a0 = (offs // DIRECT_ALIGN) * DIRECT_ALIGN           # span start
+        a1 = (-(-(offs + rb) // DIRECT_ALIGN)) * DIRECT_ALIGN  # span end
+        # merge the sorted spans into contiguous runs, recording which
+        # run each row landed in (a span near the cap boundary may start
+        # inside run i yet belong to run i+1 — membership must be
+        # tracked, not re-derived from positions)
+        order = np.argsort(a0, kind="stable")
+        runs: List[Tuple[int, int]] = []        # (run_start, run_end)
+        rows_of: List[List[int]] = []           # run -> row indices
+        for j in order.tolist():
+            s, e = int(a0[j]), int(a1[j])
+            if (runs and s <= runs[-1][1]
+                    and e - runs[-1][0] <= self.DIRECT_RUN_BYTES):
+                if e > runs[-1][1]:
+                    runs[-1] = (runs[-1][0], e)
+            else:
+                # new run; when the cap split a contiguous stretch the
+                # boundary block re-reads, which is correct just not free
+                runs.append((s, e))
+                rows_of.append([])
+            rows_of[-1].append(j)
+        buf_bytes = max((e - s for s, e in runs), default=DIRECT_ALIGN)
+        buf_np = self._direct_buf(buf_bytes)
+        mv = memoryview(buf_np)
+        fd = self._direct_fd()  # this thread's own descriptor
+        for (s, e), members in zip(runs, rows_of):
+            got = os.preadv(fd, [mv[: e - s]], s)
+            for j in members:
+                # the DATA extent is what must be covered: the last
+                # row's aligned span may exceed EOF, where pread
+                # honestly returns only what exists
+                lo = int(offs[j]) - s
+                if lo + rb > got:
+                    raise OSError(
+                        f"short O_DIRECT read at row {int(ids[j])}: "
+                        f"run [{s}, {e}) got {got}"
+                    )
+                row_u8[j] = buf_np[lo: lo + rb]
+        return out
 
     @classmethod
     def create(cls, path: str, rows: np.ndarray) -> "DiskShard":
@@ -125,7 +308,15 @@ class DiskShard:
                 "corrupt placement map (callers mask padding before the "
                 "disk tier)"
             )
+        if self._fd is not None:
+            return self._read_block_direct(ids)
         return np.ascontiguousarray(self._mm[ids])
+
+    def drop_cache(self) -> bool:
+        """Evict this shard's pages from the page cache (see
+        :func:`drop_page_cache`); the measurement-leg reset for real-disk
+        probes on filesystems without O_DIRECT."""
+        return drop_page_cache(self.path)
 
     def read_rows(self, local_ids: np.ndarray, pool=None) -> np.ndarray:
         ids = np.asarray(local_ids, np.int64).reshape(-1)
@@ -140,6 +331,270 @@ def _set_rows(table: jax.Array, slots: jax.Array, rows: jax.Array) -> jax.Array:
     # bounded batched row-scatter per PROMOTION batch (a placement
     # update, not a per-gather build)
     return table.at[slots].set(rows, mode="drop")
+
+
+class PrefetchBuffer:
+    """Flush-ahead staging for disk-tier reads (round 18, ROADMAP item
+    3a): the serve/train engines know a gather's row set one stage
+    before the gather runs, so they ``issue()`` `AsyncReadPool` reads
+    then and the gather ``take()``s the landed rows out of DRAM instead
+    of waiting on the device path's critical section.
+
+    STRICTLY OBSERVE-ONLY ON BITS: staged rows are read by the SAME
+    ``read_fn`` the direct path uses (resolved at call time, so probe
+    wrappers and simulated latencies apply identically), so a taken row
+    is byte-identical to an unstaged read — prefetch can change WHEN a
+    byte is read, never WHICH byte. A staged read that failed is simply
+    not a hit: the gather falls back to the direct read and surfaces the
+    same error the prefetch-off run would (error parity).
+
+    Accounting: ``issued`` counts rows submitted to the pool (after
+    dedup against in-flight stages and the ``max_rows`` bound),
+    ``hits`` rows a gather consumed from staging, ``wasted`` rows
+    staged but never consumed (cleared by ``cancel()`` — the fence
+    hook). An optional ``listener(kind, n)`` mirrors hit/wasted counts
+    into engine stats without a second source of truth.
+
+    Thread safety: the map mutates under one small lock; futures are
+    observed on cancel so a fenced-away prefetch never logs "exception
+    was never retrieved" at GC (the r7/r14 error-contract discipline).
+    """
+
+    def __init__(self, read_fn: Callable[[np.ndarray], np.ndarray],
+                 pool, max_rows: int = 8192):
+        if pool is None:
+            raise ValueError("PrefetchBuffer needs an AsyncReadPool")
+        self._read_fn = read_fn
+        self._pool = pool
+        self.max_rows = int(max_rows)
+        # local row id -> (chunk future, lane within the chunk's rows)
+        self._staged: Dict[int, Tuple[object, int]] = {}
+        self._lock = threading.Lock()
+        self.issued = 0
+        self.hits = 0
+        self.wasted = 0
+        self.errors = 0
+        self.listener: Optional[Callable[[str, int], None]] = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._staged)
+
+    def _emit(self, kind: str, n: int) -> None:
+        if n and self.listener is not None:
+            try:
+                self.listener(kind, n)
+            except Exception:
+                pass  # observe-only: a broken tap never breaks reads
+
+    def issue(self, local_ids: np.ndarray) -> int:
+        """Submit pool reads for the not-yet-staged subset of
+        ``local_ids`` (bounded by ``max_rows`` total staged); returns
+        rows actually issued. Duplicate/in-flight ids are free — the
+        router and its owner engines may both prefetch the same rows."""
+        ids = np.asarray(local_ids, np.int64).reshape(-1)
+        if ids.size == 0:
+            return 0
+        # dedup WITHOUT sorting: callers pass BFS-ordered closures, and
+        # when max_rows bites the truncation below must keep the nearest
+        # (most-certainly-gathered) rows, not the lowest ids
+        _, first = np.unique(ids, return_index=True)
+        ids = ids[np.sort(first)]
+        chunk = max(int(getattr(self._pool, "chunk_rows", 1024)), 1)
+        read = self._read_fn
+        with self._lock:
+            fresh = [int(i) for i in ids if int(i) not in self._staged]
+            room = self.max_rows - len(self._staged)
+            if room <= 0 or not fresh:
+                return 0
+            fresh = fresh[:room]
+            arr = np.asarray(fresh, np.int64)
+            for lo in range(0, arr.shape[0], chunk):
+                part = arr[lo : lo + chunk]
+                fut = self._pool.submit(read, part)
+                for lane, sid in enumerate(part.tolist()):
+                    self._staged[sid] = (fut, lane)
+            self.issued += len(fresh)
+        return len(fresh)
+
+    def staged_mask(self, local_ids: np.ndarray) -> np.ndarray:
+        """Bool mask of ``local_ids`` currently staged (peek, no
+        consume) — the `disk_prefetched` attribution input."""
+        ids = np.asarray(local_ids, np.int64).reshape(-1)
+        with self._lock:
+            staged = self._staged
+            return np.fromiter(
+                (int(i) in staged for i in ids), bool, ids.shape[0]
+            )
+
+    def take(self, local_ids: np.ndarray
+             ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Consume the staged subset of ``local_ids``: returns
+        ``(positions, rows)`` where ``positions`` indexes into
+        ``local_ids`` and ``rows`` are the staged bytes (None when no
+        position hit). A staged read still in flight is waited on (the
+        bytes must be right; most of its latency is already hidden); a
+        staged read that FAILED is dropped from the result so the caller
+        re-reads directly and surfaces the prefetch-off error."""
+        ids = np.asarray(local_ids, np.int64).reshape(-1)
+        with self._lock:
+            if not self._staged:
+                return np.empty(0, np.int64), None
+            entries = []
+            for j, i in enumerate(ids.tolist()):
+                e = self._staged.pop(int(i), None)
+                if e is not None:
+                    entries.append((j, e))
+        # group by chunk future: one wait + one fancy-index per CHUNK
+        # (a per-row python loop here costs more than the rows at batch
+        # scale — this runs inside the gather's critical section)
+        by_fut: Dict[int, Tuple[object, List[int], List[int]]] = {}
+        for j, (fut, lane) in entries:
+            g = by_fut.get(id(fut))
+            if g is None:
+                g = by_fut[id(fut)] = (fut, [], [])
+            g[1].append(j)
+            g[2].append(lane)
+        pos_parts, row_parts = [], []
+        failed = 0
+        for fut, js, lanes in by_fut.values():
+            try:
+                chunk_rows = fut.result()
+            except BaseException:
+                failed += len(js)
+                continue
+            pos_parts.append(np.asarray(js, np.int64))
+            row_parts.append(chunk_rows[np.asarray(lanes)])
+        hits = sum(p.shape[0] for p in pos_parts)
+        self.hits += hits
+        # a failed staged read is BOTH an error (diagnostic) and waste
+        # (the issue bought nothing) — keeping the two ledgers in step
+        # with the listener mirror, which reports it as wasted
+        self.errors += failed
+        self.wasted += failed
+        self._emit("hit", hits)
+        self._emit("wasted", failed)
+        if not pos_parts:
+            return np.empty(0, np.int64), None
+        return np.concatenate(pos_parts), np.concatenate(row_parts)
+
+    def take_or_read(self, local_ids: np.ndarray,
+                     read_fn: Callable[[np.ndarray], np.ndarray]
+                     ) -> np.ndarray:
+        """Assemble ``[n, D]`` rows for ``local_ids``: staged bytes for
+        the rows a prefetch landed, ``read_fn(rest)`` for the remainder
+        — byte-identical either way (staged rows came through the same
+        read path, earlier). THE single consume-side helper: every
+        gather that can hit staging routes here, so the hit/fallback
+        semantics live in one place."""
+        ids = np.asarray(local_ids, np.int64).reshape(-1)
+        if not len(self):
+            return read_fn(ids)
+        hit_pos, hit_rows = self.take(ids)
+        if hit_pos.size == 0:
+            return read_fn(ids)
+        out = np.empty((ids.shape[0], hit_rows.shape[1]), hit_rows.dtype)
+        out[hit_pos] = hit_rows
+        rest = np.ones(ids.shape[0], bool)
+        rest[hit_pos] = False
+        if rest.any():
+            out[rest] = read_fn(ids[rest])
+        return out
+
+    def cancel(self) -> int:
+        """Drop every staged row (the FENCE hook — update_params /
+        apply_placement / update_graph / stop all route here): cancel
+        what the pool has not started, observe every future so nothing
+        logs at GC, count the unconsumed rows as wasted. Returns the
+        rows dropped. Never blocks on an in-flight read."""
+        with self._lock:
+            staged, self._staged = self._staged, {}
+        if not staged:
+            return 0
+        seen = set()
+        for fut, _ in staged.values():
+            if id(fut) in seen:
+                continue
+            seen.add(id(fut))
+            fut.cancel()
+            fut.add_done_callback(
+                lambda f: f.cancelled() or f.exception()
+            )
+        n = len(staged)
+        self.wasted += n
+        self._emit("wasted", n)
+        return n
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            staged = len(self._staged)
+        return {"issued": self.issued, "hits": self.hits,
+                "wasted": self.wasted, "errors": self.errors,
+                "staged": staged, "max_rows": self.max_rows}
+
+
+def expected_closure(sampler, seeds, hops: int,
+                     max_nodes: Optional[int] = None) -> np.ndarray:
+    """The rows a ``hops``-layer sample of ``seeds`` can GATHER: the
+    forward k-hop closure over the sampler's CURRENT graph (the
+    streamed adjacency when the sampler is stream-bound, the frozen CSR
+    otherwise), in BFS order so a ``max_nodes`` truncation keeps the
+    nearest — most-certainly-gathered — rows. A sampled draw touches a
+    SUBSET of this closure (fanouts cap each hop), which is exactly why
+    prefetching it is observe-only: a superset staged early costs wasted
+    reads, never wrong bytes.
+
+    ``hops`` for an L-layer sampler is ``len(sizes)`` — one MORE than
+    the cache-invalidation depth, because the final hop's frontier is
+    gathered even though it is never expanded (the round-11
+    closure-hops rule)."""
+    seeds = np.unique(np.asarray(seeds, np.int64).reshape(-1))
+    stream = getattr(sampler, "stream", None)
+    if stream is not None:
+        adj = stream.adj
+        n = adj.n
+
+        def expand(frontier):
+            return adj._expand(frontier, adj.indptr, adj.indices,
+                               adj._extra)
+    else:
+        topo = getattr(sampler, "csr_topo", None)
+        if topo is None:
+            return seeds
+        indptr = np.asarray(topo.indptr)
+        indices = np.asarray(topo.indices)
+        n = indptr.shape[0] - 1
+
+        def expand(frontier):
+            parts = [indices[s:e] for s, e in
+                     zip(indptr[frontier], indptr[frontier + 1]) if e > s]
+            if not parts:
+                return np.array([], np.int64)
+            return np.unique(np.concatenate(parts))
+
+    seeds = seeds[(seeds >= 0) & (seeds < n)]
+    if seeds.size == 0:
+        return seeds
+    mask = np.zeros(n, bool)
+    mask[seeds] = True
+    order = [seeds]
+    frontier = seeds
+    for _ in range(max(int(hops), 0)):
+        if frontier.size == 0:
+            break
+        if max_nodes is not None and sum(p.size for p in order) >= max_nodes:
+            break
+        nxt = expand(frontier)
+        nxt = nxt[~mask[nxt]]
+        if nxt.size == 0:
+            break
+        mask[nxt] = True
+        order.append(nxt)
+        frontier = nxt
+    out = np.concatenate(order)
+    if max_nodes is not None and out.shape[0] > max_nodes:
+        out = out[:max_nodes]
+    return out
 
 
 class TierPlacement:
@@ -425,6 +880,9 @@ class TierStore:
         self._lock = threading.Lock()
         self.rows_promoted = 0
         self.rows_demoted = 0
+        # round-18 flush-ahead prefetch staging (enable_prefetch);
+        # strictly observe-only on bits — see PrefetchBuffer
+        self.prefetch: Optional[PrefetchBuffer] = None
 
     @classmethod
     def build(
@@ -486,13 +944,69 @@ class TierStore:
 
     def tier_split(self, stored_ids: np.ndarray) -> Dict[str, int]:
         """Host-side per-tier row counts for a gather batch (the
-        attribution the workload monitor records)."""
-        t = self.placement.tier_of[np.asarray(stored_ids, np.int64)]
-        return {
+        attribution the workload monitor records). Disk rows a prefetch
+        already STAGED in DRAM report as ``disk_prefetched`` — the tier
+        labels tell the truth about where the bytes actually come from
+        (round-18 satellite), while the placement itself is unchanged."""
+        ids = np.asarray(stored_ids, np.int64)
+        t = self.placement.tier_of[ids]
+        disk = int((t == TIER_DISK).sum())
+        staged = 0
+        pf = self.prefetch
+        if pf is not None and disk and len(pf):
+            staged = int(pf.staged_mask(ids[t == TIER_DISK]).sum())
+        out = {
             "hbm": int((t == TIER_HBM).sum()),
             "host": int((t == TIER_HOST).sum()),
-            "disk": int((t == TIER_DISK).sum()),
+            "disk": disk - staged,
         }
+        if staged:
+            out["disk_prefetched"] = staged
+        return out
+
+    # ----------------------------------------------------------- prefetch
+    def enable_prefetch(self, max_rows: int = 8192,
+                        listener: Optional[Callable[[str, int], None]] = None,
+                        ) -> PrefetchBuffer:
+        """Attach (or retune) the flush-ahead staging buffer. Requires a
+        read pool (the reads must land off the caller's thread to hide
+        anything). Idempotent: a second call updates the bound/listener
+        on the existing buffer so router + owner engines can share."""
+        if self.read_pool is None:
+            raise ValueError(
+                "prefetch needs an AsyncReadPool (build the Feature with "
+                "read_pool=/disk_read_workers=)"
+            )
+        if self.prefetch is None:
+            self.prefetch = PrefetchBuffer(
+                lambda ids: self.backing.read_block(ids),
+                self.read_pool, max_rows=max_rows,
+            )
+        else:
+            self.prefetch.max_rows = int(max_rows)
+        if listener is not None:
+            self.prefetch.listener = listener
+        return self.prefetch
+
+    def prefetch_rows(self, stored_ids) -> int:
+        """Issue flush-ahead reads for the DISK-resident subset of
+        ``stored_ids`` (no-op rows already in a fast tier or already
+        staged). Returns rows issued. Call `enable_prefetch` first."""
+        if self.prefetch is None:
+            return 0
+        ids = np.asarray(stored_ids, np.int64).reshape(-1)
+        ids = ids[(ids >= 0) & (ids < self.placement.n)]
+        if ids.size == 0:
+            return 0
+        disk = ids[self.placement.tier_of[ids] == TIER_DISK]
+        if disk.size == 0:
+            return 0
+        return self.prefetch.issue(disk)
+
+    def cancel_prefetch(self) -> int:
+        """Drop staged prefetch rows (fence hook); see
+        `PrefetchBuffer.cancel`."""
+        return self.prefetch.cancel() if self.prefetch is not None else 0
 
     def gather_np(self, stored_ids: np.ndarray) -> np.ndarray:
         """Host-side oracle gather straight from the backing file — the
@@ -546,9 +1060,17 @@ class TierStore:
             disk_sel = np.nonzero(tiers == TIER_DISK)[0]
             if disk_sel.size:
                 lanes = np.searchsorted(cold_sel, disk_sel)
-                rows_np[lanes] = self.backing.read_rows(
-                    ids[disk_sel], pool=self.read_pool
-                )
+                disk_ids = ids[disk_sel]
+                pf = self.prefetch
+
+                def read(i):
+                    return self.backing.read_rows(i, pool=self.read_pool)
+
+                # flush-ahead staging: rows a prefetch landed in DRAM
+                # skip the backing read — SAME bytes (the buffer read
+                # them through the same read path), earlier
+                rows_np[lanes] = (read(disk_ids) if pf is None
+                                  else pf.take_or_read(disk_ids, read))
             rows = jax.device_put(jnp.asarray(rows_np), target)
             out = _scatter_rows(out, jnp.asarray(pos), rows)
         return out
@@ -564,6 +1086,12 @@ class TierStore:
         in-flight flushes first); the store's own lock only orders bare
         concurrent callers."""
         with self._lock:
+            # staged prefetch rows predate this placement: a promoted row
+            # would stop being consumed (wasted forever) and attribution
+            # would lie — drop the staging at every placement batch (the
+            # engine fence calls apply under its drain, so nothing is
+            # mid-gather here)
+            self.cancel_prefetch()
             pl = self.placement
             promote_hbm: List[Tuple[int, int]] = []   # (stored, slot)
             promote_host: List[Tuple[int, int]] = []

@@ -544,6 +544,14 @@ EVENT_KINDS = (
     # stays pinned — journal on changes no served bit.
     "graph_delta",       # -,  -,  a=pending      edges staged host-side
     "delta_commit",      # -, ver, a=edges, b=invalidated   fenced commit
+    # round-18 predictive-IO journal (policy markers; observe-only —
+    # prefetch changes WHEN a disk byte is read, never which byte, so
+    # the journal-on parity rule carries over unchanged). prefetch_issue
+    # rides the issuing flush's fid; prefetch_hit is emitted at gather
+    # consumption, which may serve a different flush than the issuer
+    # (fid -1 — staging is engine-global, not per-flush).
+    "prefetch_issue",    # -, fid, a=rows_issued, b=closure_rows
+    "prefetch_hit",      # -,  -,  a=rows_consumed_from_staging
 )
 
 # rough per-event host bytes: 6-slot tuple + boxed floats/small ints. Used
@@ -566,6 +574,7 @@ def _fold_flush_events(events) -> Dict[int, Dict[str, float]]:
             "shed", "hedge", "eject",
             "migrate", "migrate_commit", "migrate_rollback",
             "graph_delta", "delta_commit",
+            "prefetch_issue", "prefetch_hit",
         ):
             continue
         f = flushes.setdefault(fid, {})
@@ -1175,6 +1184,11 @@ def chrome_trace_events(
                     # version for commits (EVENT_KINDS)
                     instants.append(
                         (pid, t, kind, {"version": fid, "a": a, "b": b})
+                    )
+                elif kind in ("prefetch_issue", "prefetch_hit"):
+                    # round-18 predictive-IO markers (rows per EVENT_KINDS)
+                    instants.append(
+                        (pid, t, kind, {"fid": fid, "rows": a, "b": b})
                     )
             items = []
             for fid, f in sorted(flushes.items()):

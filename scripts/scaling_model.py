@@ -48,6 +48,13 @@ def main():
                     help="TIER_r01.json tiers artifact to read measured "
                          "row costs + hit mixes from (default: analytic "
                          "placeholder costs, labeled)")
+    # round-18 flush-ahead prefetch pricing: the measured fraction of
+    # disk rows already staged in DRAM when the gather runs
+    ap.add_argument("--tier-prefetch", default=None,
+                    help="flush-ahead prefetch hit rate for the tier "
+                         "table: a fraction in [0,1], or a TIER_r02.json "
+                         "real-disk artifact to read the measured "
+                         "median hit rate from (default: 0, labeled)")
     ap.add_argument("--skew", default=None,
                     help="SERVE_r06.json skew artifact to read the "
                          "measured head-concentration curve from")
@@ -303,6 +310,22 @@ def main():
         + format_skew_markdown(skew_rows)
     )
     # -- round-14: disk/DRAM/HBM hit-mix pricing (tier_table) ------------
+    # round-18: flush-ahead prefetch hit rate — a measured fraction (or
+    # a TIER_r02 artifact carrying one) prices staged disk rows at the
+    # DRAM-staging consume instead of the pooled backing read
+    if args.tier_prefetch is None:
+        pf_rate, pf_source = 0.0, "no prefetch (pass --tier-prefetch)"
+    else:
+        try:
+            pf_rate = float(args.tier_prefetch)
+            pf_source = f"--tier-prefetch {pf_rate}"
+        except ValueError:
+            with open(args.tier_prefetch) as fh:
+                pf_rate = float(
+                    json.load(fh)["prefetch_hit_rate_measured"]["median"]
+                )
+            pf_source = (f"{args.tier_prefetch} measured median "
+                         "tier_prefetch hit rate")
     if args.tier:
         with open(args.tier) as fh:
             tier_doc = json.load(fh)
@@ -321,6 +344,7 @@ def main():
             host_row_s=cost["host"],
             disk_row_s=cost["disk_pooled"] * workers,
             feature_dim=t_cfg.get("dim", 100), read_workers=workers,
+            prefetch_hit_rate=pf_rate,
         )
         tier_source = f"{args.tier} measured row costs + hit mixes"
     else:
@@ -333,15 +357,19 @@ def main():
              ("adapted", 0.26, 0.19, 0.55)],
             bucket=32, dispatch_s=3.5e-3, hbm_row_s=4e-6,
             host_row_s=6e-6, disk_row_s=1e-4, feature_dim=100,
-            read_workers=4,
+            read_workers=4, prefetch_hit_rate=pf_rate,
         )
         tier_source = "analytic placeholder costs (pass --tier TIER_r01.json)"
     tier_md = (
         "## Tiered storage: disk/DRAM/HBM hit-mix pricing (round 14)\n\n"
-        f"Cost source: {tier_source}.\nMeasured counterpart: "
+        f"Cost source: {tier_source}.\n"
+        f"Prefetch hit-rate source (round 18): {pf_source}.\n"
+        "Measured counterpart: "
         "scripts/serve_probe.py --tiers -> TIER_r01.json (static vs\n"
         "sketch-driven adaptive placement, median-of-3, simulated cold-"
-        "read latency\nlabeled in config).\n\n"
+        "read latency\nlabeled in config) and --tiers --real-disk -> "
+        "TIER_r02.json (page-cache-\ndefeated reads, mid-run hot-set "
+        "shift, prefetch on/off/all-DRAM\ninterleaved median-of-3).\n\n"
         + format_tier_markdown(tier_rows)
     )
     # -- round-17: streaming-graph ingest pricing (delta_table) ----------
