@@ -706,6 +706,117 @@ def bench_stream(context, n=50_000, deg=8, edges_per_commit=512, reps=5):
     )
 
 
+def bench_workloads(context, n=50_000, deg=8, reps=5):
+    """Round-19 workload costs — the MEASURED inputs of
+    `scaling.lp_table` and the temporal rows of SCALING.md:
+
+    - ``temporal_draw_s``: one masked tiled temporal draw
+      (`ops.sample.tiled_temporal_sample_layer`) at [B=1024, k=8] — the
+      marginal cost of the timestamp mask + recency weighting over the
+      uniform tiled draw (compare ``sample_layer`` sections).
+    - ``temporal_step_s``: one fused temporal serve flush (sample +
+      gather + forward + the query-time argument) at bucket 64 through a
+      `workloads.TemporalServeEngine` — the t_node_step_s input of
+      `lp_table`.
+    - ``lp_pair_step_s`` / ``lp_head_s``: measured per-pair cost of a
+      64-pair `predict_pairs` batch (cache disabled — the honest
+      two-endpoints-per-pair device cost) and the scoring head alone.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops.sample import tiled_temporal_sample_layer
+    from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+    from quiver_tpu.serve import ServeConfig
+    from quiver_tpu.workloads import (
+        PairHead,
+        TemporalServeEngine,
+        TemporalTiledGraph,
+    )
+
+    rng = np.random.default_rng(29)
+    src = np.repeat(np.arange(n, dtype=np.int64), deg)
+    dst = rng.integers(0, n, src.shape[0])
+    topo = CSRTopo(edge_index=np.stack([src, dst]))
+    ts = rng.uniform(0.0, 1000.0, topo.indices.shape[0]).astype(np.float32)
+    tg = TemporalTiledGraph(topo, ts)
+    bd, tiles, tt = tg.temporal_graph()
+    B, k = 1024, 8
+    seeds = jnp.asarray(rng.integers(0, n, B))
+    valid = jnp.ones((B,), bool)
+    tvec = jnp.asarray(rng.uniform(0, 1000, B).astype(np.float32))
+    key = jax.random.key(11)
+    out = tiled_temporal_sample_layer(
+        bd, tiles, tt, seeds, valid, k, key, tvec, max_deg=512, recency=0.01
+    )
+    jax.block_until_ready(out[0])  # warm the compile
+    t0 = time.perf_counter()
+    for i in range(reps):
+        out = tiled_temporal_sample_layer(
+            bd, tiles, tt, seeds, valid, k,
+            jax.random.fold_in(key, i), tvec, max_deg=512, recency=0.01,
+        )
+    jax.block_until_ready(out[0])
+    context["temporal_draw_s"] = round((time.perf_counter() - t0) / reps, 6)
+
+    dim, bucket = 64, 64
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=64, out_dim=32, num_layers=2, dropout=0.0)
+    smp = GraphSageSampler(topo, sizes=[8, 8], mode="TPU", seed=7,
+                           dedup=False)
+    smp.bind_temporal(tg, recency=0.01)
+    init_ds = GraphSageSampler(
+        topo, sizes=[8, 8], mode="TPU", seed=7, dedup=False
+    ).bind_temporal(tg, recency=0.01).sample_dense(
+        np.arange(bucket, dtype=np.int64), t=1e9
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((init_ds.n_id.shape[0], dim)),
+        init_ds.adjs,
+    )
+    eng = TemporalServeEngine(
+        model, params, smp, feat,
+        ServeConfig(max_batch=bucket, buckets=(bucket,), max_delay_ms=1e9,
+                    cache_entries=0),
+        t_quantum=0.0, pair_head=PairHead("dot"),
+    )
+    eng.warmup()
+    nodes = rng.integers(0, n, (reps + 1, bucket))
+    times = rng.uniform(0, 1000, (reps + 1, bucket))
+    eng.predict(nodes[0], t=times[0])  # warm
+    t0 = time.perf_counter()
+    for i in range(1, reps + 1):
+        eng.predict(nodes[i], t=times[i])
+    context["temporal_step_s"] = round((time.perf_counter() - t0) / reps, 6)
+
+    pairs = rng.integers(0, n, (reps + 1, bucket // 2, 2))
+    eng.predict_pairs(pairs[0], t=500.0)  # warm (head compile included)
+    t0 = time.perf_counter()
+    for i in range(1, reps + 1):
+        eng.predict_pairs(pairs[i], t=float(times[i][0]))
+    per_batch = (time.perf_counter() - t0) / reps
+    context["lp_pair_step_s"] = round(per_batch / (bucket // 2), 8)
+    head = eng.pair_head
+    hu = rng.standard_normal((bucket // 2, 32)).astype(np.float32)
+    hv = rng.standard_normal((bucket // 2, 32)).astype(np.float32)
+    head.score(hu, hv)  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        head.score(hu, hv)
+    context["lp_head_s"] = round(
+        (time.perf_counter() - t0) / reps / (bucket // 2), 9
+    )
+    log(
+        f"workloads: temporal draw {context['temporal_draw_s']*1e3:.2f} "
+        f"ms/call@{B}, fused temporal step "
+        f"{context['temporal_step_s']*1e3:.2f} ms@{bucket}, LP pair "
+        f"{context['lp_pair_step_s']*1e6:.1f} us/pair (head "
+        f"{context['lp_head_s']*1e9:.0f} ns/pair)"
+    )
+
+
 def bench_tier_rows(context, n=8192, dim=100, reps=5):
     """Round-14 per-row tier gather costs — the MEASURED inputs of
     `scaling.tier_table` (``tier_hbm_row_s`` / ``tier_host_row_s`` /
@@ -1562,6 +1673,13 @@ def main():
             log("budget exhausted before stream bench")
     except Exception as exc:
         log(f"stream bench failed: {exc}")
+    try:
+        if remaining() > 120:
+            bench_workloads(context)
+        else:
+            log("budget exhausted before workloads bench")
+    except Exception as exc:
+        log(f"workloads bench failed: {exc}")
 
     seps_fused = results.get("fused", 0.0)
     print(

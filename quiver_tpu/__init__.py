@@ -30,6 +30,7 @@ from . import comm, obs, pyg, tiers, trace
 from . import quant
 from . import serve
 from . import stream
+from . import workloads
 from .stream import GraphDelta, StreamingAdjacency, StreamingTiledGraph
 from .tiers import DiskShard, PlacementPlan, TierPlacement, TierStore
 from .quant import QuantizedFeature
@@ -75,6 +76,7 @@ __all__ = [
     "QuantizedFeature",
     "serve",
     "stream",
+    "workloads",
     "GraphDelta",
     "StreamingAdjacency",
     "StreamingTiledGraph",

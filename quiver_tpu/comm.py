@@ -256,6 +256,7 @@ def exchange_serve_all(
     answer_fn,
     out_dim: int,
     tenant_requests: Optional[np.ndarray] = None,
+    ts_requests: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Serve-shaped exchange, single-controller surface: ship SEED IDS to
     their owners, run each owner's host-side compute, ship LOGITS back.
@@ -285,6 +286,15 @@ def exchange_serve_all(
     so owner engines can apply the submitting tenants' flush quotas
     end-to-end. When None, the wire and the answerer call are
     byte-identical to round 15.
+
+    ``ts_requests`` (round 19, temporal workloads) carries per-seed
+    QUERY TIMES, a float32 array lane-aligned with ``requests``. It
+    ships BITCAST to int32 over another launch of the same id
+    all_to_all (the collective moves lanes, it never interprets them —
+    bitcasting keeps every float bit exact, which the temporal replay
+    parity rides) and lands at each owner as the ``ts=`` keyword of
+    ``answer_fn``, float32 again. Lanes whose id is -1 padding carry
+    meaningless times the owner must ignore.
     """
     h = mesh.shape[axis]
     with _SC_COLLECTIVE_LOCK:
@@ -305,17 +315,33 @@ def exchange_serve_all(
                 NamedSharding(mesh, P(axis)),
             )
             recv_tenants = np.asarray(_a2a_ids_jit(treq, mesh=mesh, axis=axis))
+        recv_ts = None
+        if ts_requests is not None:
+            if ts_requests.shape != requests.shape:
+                raise ValueError(
+                    f"ts_requests {ts_requests.shape} must match "
+                    f"requests {requests.shape}"
+                )
+            tsreq = jax.device_put(
+                jnp.asarray(
+                    np.ascontiguousarray(
+                        np.asarray(ts_requests, np.float32)
+                    ).view(np.int32)
+                ),
+                NamedSharding(mesh, P(axis)),
+            )
+            recv_ts = np.ascontiguousarray(
+                np.asarray(_a2a_ids_jit(tsreq, mesh=mesh, axis=axis))
+            ).view(np.float32)
         L = recv.shape[2]
         rows = np.zeros((h, h, L, out_dim), np.float32)
         for host in range(h):
             try:
-                if recv_tenants is None:
-                    ans = np.asarray(answer_fn(host, recv[host]), np.float32)
-                else:
-                    ans = np.asarray(
-                        answer_fn(host, recv[host], recv_tenants[host]),
-                        np.float32,
-                    )
+                args = [host, recv[host]]
+                if recv_tenants is not None:
+                    args.append(recv_tenants[host])
+                kwargs = {} if recv_ts is None else {"ts": recv_ts[host]}
+                ans = np.asarray(answer_fn(*args, **kwargs), np.float32)
             except OwnerAnswerError:
                 raise
             except Exception as exc:
@@ -539,6 +565,7 @@ class TpuComm:
         out_dim: int,
         budget: Optional[int] = None,
         host2tenants: Optional[Sequence[Sequence[int]]] = None,
+        host2ts: Optional[Sequence[Sequence[float]]] = None,
     ) -> List[Optional[np.ndarray]]:
         """Serve-shaped collective: ship per-owner SEED-ID lists out, run
         each owner's registered answerer (its local serve engine), get
@@ -561,6 +588,15 @@ class TpuComm:
         now — the multi-process path drops the tenant payload (owner
         quotas degrade to router-admission-only, the round-15
         semantics).
+
+        ``host2ts`` (round 19) carries per-seed float32 QUERY TIMES
+        aligned with ``host2ids`` — the temporal workload's sub-batch
+        shape: paired/temporal seeds ship their t beside their id
+        (bitcast over the id all_to_all, see `exchange_serve_all`) and
+        land as the answerer's ``ts=`` keyword. Unlike tenants, a
+        missing t cannot degrade gracefully (an owner cannot pick a
+        query time for you), so the multi-process path REJECTS it
+        loudly instead of dropping it.
         """
         rec = EXCHANGE_SPANS
         t_span0 = _EXCHANGE_CLOCK() if rec is not None else 0.0
@@ -586,6 +622,12 @@ class TpuComm:
             req_mine[0, j, : ids.shape[0]] = ids
         answerers = getattr(self, "_serve_answerers", None) or {}
         if jax.process_count() > 1:
+            if host2ts is not None:
+                raise NotImplementedError(
+                    "multi-process exchange_serve does not ship query "
+                    "times yet — temporal fleets run single-controller "
+                    "(or exchange='host')"
+                )
             # the multi-process path predates owner-side tenant
             # scheduling: DROP the tenant payload rather than failing
             # every flush — quotas then hold at router admission only
@@ -636,18 +678,21 @@ class TpuComm:
                         continue
                     tens = np.asarray(tens, np.int32)
                     treq[self.host, j, : tens.shape[0]] = tens
-                out = exchange_serve_all(
-                    self.mesh, self.axis, req,
-                    lambda host, recv_ids, recv_tenants: answerers[host](
-                        recv_ids, recv_tenants
-                    ),
-                    out_dim, tenant_requests=treq,
-                )
-            else:
-                out = exchange_serve_all(
-                    self.mesh, self.axis, req,
-                    lambda host, recv_ids: answerers[host](recv_ids), out_dim,
-                )
+            tsreq = None
+            if host2ts is not None:
+                tsreq = np.zeros((h, h, budget), np.float32)
+                for j, tvals in enumerate(host2ts):
+                    if tvals is None:
+                        continue
+                    tvals = np.asarray(tvals, np.float32)
+                    tsreq[self.host, j, : tvals.shape[0]] = tvals
+            out = exchange_serve_all(
+                self.mesh, self.axis, req,
+                lambda host, recv_ids, *rest, **kw: answerers[host](
+                    recv_ids, *rest, **kw
+                ),
+                out_dim, tenant_requests=treq, ts_requests=tsreq,
+            )
             mine = out[self.host]
         res: List[Optional[np.ndarray]] = []
         for j, ids in enumerate(host2ids):

@@ -260,6 +260,41 @@ def make_serve_step(model, sampler):
     return serve_step, graph, id_dtype
 
 
+def make_temporal_serve_step(model, sampler):
+    """The TEMPORAL analog of :func:`make_serve_step` (round 19,
+    `quiver_tpu.workloads`): ``serve_step(params, key, seeds, table,
+    index_map, graph, t)`` runs the masked temporal sample
+    (`workloads.temporal.temporal_sample_dense`) + gather + forward as ONE
+    program. ``t`` is the padded per-seed query-time vector — a jit
+    ARGUMENT exactly like the graph arrays (the NEXT.md rule: a
+    closure-constant t would recompile per query time; an argument serves
+    every t through one sealed executable). The sampler must be
+    temporal-bound (`GraphSageSampler.bind_temporal`); its recency/fanout
+    config is baked statically, its graph arrays stay swappable via
+    `BucketPrograms.rebind` (streaming commits)."""
+    from .workloads.temporal import temporal_sample_dense
+
+    if getattr(sampler, "temporal", None) is None:
+        raise TypeError("make_temporal_serve_step needs a temporal-bound sampler")
+    _, recency = sampler.temporal
+    graph = sampler.temporal_graph_arrays()
+    sizes, max_deg = sampler.sizes, sampler.max_deg
+    id_dtype = graph[1].dtype
+
+    def serve_step(params, key, seeds, table, index_map, graph, t):
+        ds = temporal_sample_dense(
+            graph, key, seeds, t, sizes, recency=recency, max_deg=max_deg
+        )
+        n = index_map.shape[0] if index_map is not None else table.shape[0]
+        ids = jnp.clip(ds.n_id, 0, n - 1)
+        if index_map is not None:
+            ids = jnp.clip(jnp.take(index_map, ids), 0, table.shape[0] - 1)
+        x = jnp.take(table, ids, axis=0)
+        return model.apply(params, x, ds.adjs)
+
+    return serve_step, graph, id_dtype
+
+
 # Process-wide cache of compiled serve executables, keyed by everything the
 # lowering depends on (model value, sampler config, graph/table/params
 # AVALS, bucket). Two engines over same-shaped state share one executable —
@@ -304,7 +339,20 @@ class BucketPrograms:
     live request."""
 
     def __init__(self, model, sampler, feature):
-        self._fn, self._graph, self._id_dtype = make_serve_step(model, sampler)
+        # temporal samplers (round 19, quiver_tpu.workloads) compile the
+        # temporal serve step, which takes ONE extra per-flush argument:
+        # the padded per-seed query-time vector
+        temporal = getattr(sampler, "temporal", None)
+        if temporal is not None:
+            self._fn, self._graph, self._id_dtype = make_temporal_serve_step(
+                model, sampler
+            )
+            self._n_extra = 1
+        else:
+            self._fn, self._graph, self._id_dtype = make_serve_step(
+                model, sampler
+            )
+            self._n_extra = 0
         self._sampler = sampler
         self._caps = sampler.caps  # snapshot the program was built for
         self._table, self._map = feature_gather_spec(feature)
@@ -316,6 +364,10 @@ class BucketPrograms:
                 model, sampler.sizes, sampler.caps, sampler.dedup,
                 getattr(sampler, "layout", None),
                 getattr(sampler, "weighted", False),
+                self._n_extra,
+                None if temporal is None else (
+                    float(temporal[1]), int(getattr(sampler, "max_deg", 0))
+                ),
                 np.dtype(self._id_dtype).str,
                 _aval_spec(self._graph),
                 _aval_spec(self._table),
@@ -381,6 +433,9 @@ class BucketPrograms:
                 return
         key = jax.random.fold_in(jax.random.key(0), 0)
         seeds = jnp.zeros((bucket,), self._id_dtype)
+        extras = (
+            (jnp.zeros((bucket,), jnp.float32),) if self._n_extra else ()
+        )
         import warnings
 
         with warnings.catch_warnings():
@@ -391,7 +446,8 @@ class BucketPrograms:
                 "ignore", message="Some donated buffers were not usable"
             )
             exe = self._jit.lower(
-                params, key, seeds, self._table, self._map, self._graph
+                params, key, seeds, self._table, self._map, self._graph,
+                *extras,
             ).compile()
         if cache_key is not None:
             with _SERVE_EXE_LOCK:
@@ -401,10 +457,18 @@ class BucketPrograms:
                     _SERVE_EXE_CACHE.popitem(last=False)
         self._exes[bucket] = exe
 
-    def __call__(self, bucket: int, params, key, seeds) -> jax.Array:
+    def __call__(self, bucket: int, params, key, seeds, *extra) -> jax.Array:
         """ONE execute call: the whole sample+gather+forward for a padded
         seed batch at ``bucket``. Misses compile lazily before `seal()`,
-        raise RuntimeError after."""
+        raise RuntimeError after. Temporal programs take one ``extra``
+        argument — the padded per-seed query-time vector, float32
+        ``[bucket]`` (the engine pads it exactly like the seeds)."""
+        if len(extra) != self._n_extra:
+            raise TypeError(
+                f"this serve program takes {self._n_extra} extra "
+                f"argument(s) (got {len(extra)}) — temporal engines pass "
+                "the padded query-time vector, plain engines none"
+            )
         if self._sampler.caps != self._caps:
             # the fused program bakes the caps' static shapes in; sampling
             # with mutated caps would silently diverge from the split path
@@ -426,7 +490,12 @@ class BucketPrograms:
             self.compile_bucket(int(bucket), params)
             exe = self._exes[int(bucket)]
         seeds = jnp.asarray(np.asarray(seeds), self._id_dtype)
-        return exe(params, key, seeds, self._table, self._map, self._graph)
+        extra = tuple(
+            jnp.asarray(np.asarray(e, np.float32)) for e in extra
+        )
+        return exe(
+            params, key, seeds, self._table, self._map, self._graph, *extra
+        )
 
 
 def time_eval_split(

@@ -263,17 +263,25 @@ def tiled_weighted_sample_layer(
     """
     base, deg = _tiled_bd_lookup(bd, seeds, seed_valid)
     deg = jnp.minimum(deg, max_deg)
+    w_rows = _tiled_payload_window(base, wtiles, max_deg)
+    pos, valid = gumbel_topk_positions(key, deg, k, w_rows)
+    return _tiled_resolve(tiles, base, pos, k), valid
+
+
+def _tiled_payload_window(base, ptiles, max_deg: int):
+    """Each row's first ``ceil(max_deg/128)`` PAYLOAD tiles as one
+    ``[B, T*128]`` window: T per-row tile fetches, k-split style — a
+    [B, T] 3-D gather compiles pathologically, see `_tiled_resolve`.
+    The ONE payload-window fetch (weights and timestamps both ride it;
+    the temporal-vs-weighted bit-parity pin depends on the two never
+    diverging)."""
     T = -(-max_deg // LANE)
-    m_rows = tiles.shape[0]
-    # weight window: T per-row tile fetches (k-split style — a [B, T]
-    # 3-D gather compiles pathologically, see _tiled_resolve)
+    m_rows = ptiles.shape[0]
     parts = []
     for t in range(T):
         tr = jnp.clip(base + t, 0, m_rows - 1)
-        parts.append(jnp.take(wtiles, tr, axis=0))
-    w_rows = jnp.concatenate(parts, axis=1)  # [B, T*128] >= max_deg
-    pos, valid = gumbel_topk_positions(key, deg, k, w_rows)
-    return _tiled_resolve(tiles, base, pos, k), valid
+        parts.append(jnp.take(ptiles, tr, axis=0))
+    return jnp.concatenate(parts, axis=1)  # [B, T*128] >= max_deg
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -412,6 +420,88 @@ def tiled_rowmap_host(indptr):
     start = indptr[:-1][owner] + t * LANE
     width = np.minimum(indptr[1:][owner] - start, LANE).astype(np.int32)
     return start, width
+
+
+def temporal_edge_weights(ts: jax.Array, recency: float) -> jax.Array:
+    """Recency weight per edge from its timestamp: ``exp(recency * ts)``
+    — the Plackett-Luce weight the temporal sampler hands the SAME
+    Gumbel top-k the weighted sampler rides (a draw then prefers recent
+    edges with half-life ``ln(2)/recency`` in timestamp units;
+    ``recency=0`` is uniform over the valid set, exactly 1.0 per edge).
+    The query time ``t`` never enters the weight — ``exp(recency*(ts-t))``
+    differs from this by a per-row constant factor, which top-k ignores —
+    so at ``t=inf`` a temporal draw IS a weighted draw over these
+    weights, bit for bit (the frozen==temporal-at-t=inf parity pin in
+    tests/test_temporal.py). One definition shared by the device layer,
+    the host-masked oracle, and `recency weight-tile` builds, so the
+    float32 exp is always the same elementwise op on the same inputs.
+    Timestamps must keep ``recency * ts`` within float32 exp range
+    (|x| < ~87); scale epochs accordingly."""
+    if recency == 0.0:
+        return jnp.ones_like(ts, jnp.float32)
+    return jnp.exp(jnp.float32(recency) * ts.astype(jnp.float32))
+
+
+def temporal_weight_rows(
+    ts_rows: jax.Array, t: jax.Array, recency: float
+) -> jax.Array:
+    """The masked weight window of a temporal draw: recency weights where
+    ``ts <= t`` (per-row query times ``t`` [B] broadcast over lanes),
+    0 elsewhere — zero weight is exactly how `gumbel_topk_positions`
+    already excludes a candidate, so "sample edges with ts <= t" costs
+    ONE where. Shared by `tiled_temporal_sample_layer` and the host-
+    masked oracle (`workloads.temporal.host_masked_oracle`): both build
+    their ``[B, W]`` timestamp windows differently (tile fetch vs host
+    CSR slices) but weight them through this one function, which is what
+    makes the oracle a bit-parity pin on the tile path."""
+    w = temporal_edge_weights(ts_rows, recency)
+    return jnp.where(ts_rows.astype(jnp.float32) <= t[:, None], w, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "max_deg", "recency"))
+def tiled_temporal_sample_layer(
+    bd: jax.Array,
+    tiles: jax.Array,
+    ttiles: jax.Array,
+    seeds: jax.Array,
+    seed_valid: jax.Array,
+    k: int,
+    key: jax.Array,
+    t: jax.Array,
+    max_deg: int = 512,
+    recency: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """TEMPORAL one-hop sample over the tile layout (ROADMAP item 4):
+    draw k neighbors per seed among edges with ``ts <= t``, recency-
+    biased via the existing Gumbel machinery.
+
+    ``ttiles`` is the per-edge timestamp payload laid out with the SAME
+    tile map as ``tiles`` (`build_tiled_host(indptr, edge_ts,
+    np.float32)`) — timestamps ride the payload lanes exactly like the
+    round-5 edge weights, so the fetch is the weighted layer's fetch
+    verbatim and positions resolve through the same `_tiled_resolve`.
+    ``t`` is a ``[B]`` float32 of per-SEED query times — a traced jit
+    ARGUMENT, never a static constant (the NEXT.md rule: one compiled
+    program serves every query time), so multi-hop pipelines thread each
+    request's own t down its frontier lineage
+    (`workloads.temporal.temporal_sample_dense`).
+
+    Draw semantics: among a row's first ``min(deg, max_deg)`` edges,
+    every edge with ``ts <= t[row]`` scores ``log w + Gumbel`` with
+    ``w = temporal_edge_weights(ts, recency)``; edges beyond t (or
+    recency-underflowed to weight 0) are excluded exactly like
+    zero-weight edges in the weighted sampler. At ``t = +inf`` the mask
+    passes everything and the draw is BIT-EQUAL to
+    `tiled_weighted_sample_layer` over weight tiles
+    ``temporal_edge_weights(ttiles, recency)`` on the same key — the
+    frozen-graph parity pin. Rows whose valid-edge count is below k
+    return all their valid edges (copy-all, like every sampler here)."""
+    base, deg = _tiled_bd_lookup(bd, seeds, seed_valid)
+    deg = jnp.minimum(deg, max_deg)
+    ts_rows = _tiled_payload_window(base, ttiles, max_deg)
+    w_rows = temporal_weight_rows(ts_rows, t.astype(jnp.float32), recency)
+    pos, valid = gumbel_topk_positions(key, deg, k, w_rows)
+    return _tiled_resolve(tiles, base, pos, k), valid
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

@@ -1160,6 +1160,108 @@ def format_delta_markdown(rows: Sequence[DeltaPrediction]) -> str:
     return "\n".join(lines)
 
 
+class LPPrediction(NamedTuple):
+    bucket: int
+    hit_rate: float            # endpoint embedding-cache hit rate
+    unique_frac: float         # endpoint seeds surviving coalescing
+    dispatch_s: float          # one bucket-B endpoint dispatch
+    node_qps: float            # node-classification requests/s ceiling
+    pairs_per_dispatch: float  # pairs retired per endpoint dispatch
+    head_s: float              # pair-head cost per retired dispatch
+    pair_qps: float            # LP pairs/s ceiling
+    qps_ratio: float           # pair_qps / node_qps
+
+
+def lp_table(
+    t_node_step_s: float,
+    ref_batch: int,
+    head_s_per_pair: float = 0.0,
+    buckets: Sequence[int] = (8, 32, 64),
+    hit_rates: Sequence[float] = (0.0, 0.5, 0.9),
+    unique_frac: float = 0.8,
+) -> List[LPPrediction]:
+    """Price PAIR-QPS against node-QPS from measured step costs (round
+    19): a link-prediction request is TWO endpoint computations through
+    the same serve path plus a head.
+
+    ``t_node_step_s`` is the measured fused serve-step cost at
+    ``ref_batch`` (bench.py ``serve_fused_step_s``, or the temporal leg's
+    ``temporal_step_s``), scaled linearly per seed like `serve_table`;
+    ``head_s_per_pair`` the measured scoring-head cost per pair (bench
+    ``lp_head_s`` — one jitted dispatch per scored batch, so per pair
+    it is tiny and amortized). Request algebra: of P pairs/s, each
+    submits 2 endpoint requests; ``(1-hit)*unique_frac`` of those reach
+    the device (endpoints of a hot candidate set hit the embedding cache
+    and coalesce EXACTLY like node requests — the sharing is the whole
+    design, see workloads/linkpred.py), so one bucket-B dispatch retires
+    ``B / (2*(1-hit)*unique_frac)`` pairs. Temporal serving shrinks the
+    effective hit rate (cache keys gain the t_bucket dimension: only
+    same-window repeats hit) — feed the MEASURED temporal hit rate in,
+    the table stays honest.
+
+    The ratio column is the planning number: pair traffic costs ~2x node
+    traffic at equal cache behavior, less when candidate endpoints are
+    hotter than classification seeds (their hit rate is what you buy
+    with a bigger cache)."""
+    if t_node_step_s < 0 or head_s_per_pair < 0:
+        raise ValueError("step/head costs must be >= 0")
+    rows: List[LPPrediction] = []
+    per_seed = t_node_step_s / max(ref_batch, 1)
+    for b in buckets:
+        t_dispatch = per_seed * b
+        for h in hit_rates:
+            miss = (1.0 - h) * unique_frac
+            node_rpd = b / miss if miss > 0 else math.inf
+            node_qps = node_rpd / t_dispatch if t_dispatch > 0 else math.inf
+            pairs_pd = node_rpd / 2.0
+            head_s = (
+                0.0 if math.isinf(pairs_pd) else pairs_pd * head_s_per_pair
+            )
+            t_pair = t_dispatch + head_s
+            pair_qps = pairs_pd / t_pair if t_pair > 0 else math.inf
+            ratio = (
+                0.5 if math.isinf(node_qps) and math.isinf(pair_qps)
+                else pair_qps / node_qps
+            )
+            rows.append(
+                LPPrediction(
+                    bucket=b, hit_rate=h, unique_frac=unique_frac,
+                    dispatch_s=t_dispatch, node_qps=node_qps,
+                    pairs_per_dispatch=pairs_pd, head_s=head_s,
+                    pair_qps=pair_qps, qps_ratio=ratio,
+                )
+            )
+    return rows
+
+
+def format_lp_markdown(rows: Sequence[LPPrediction]) -> str:
+    lines = [
+        "| bucket | cache hit | dispatch ms | node QPS | pairs/dispatch "
+        "| head ms | pair QPS | pair/node |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        nq = "inf" if math.isinf(r.node_qps) else f"{r.node_qps:.0f}"
+        pq = "inf" if math.isinf(r.pair_qps) else f"{r.pair_qps:.0f}"
+        ppd = ("inf" if math.isinf(r.pairs_per_dispatch)
+               else f"{r.pairs_per_dispatch:.0f}")
+        lines.append(
+            f"| {r.bucket} | {r.hit_rate:.0%} | {r.dispatch_s*1e3:.2f} "
+            f"| {nq} | {ppd} | {r.head_s*1e3:.3f} | {pq} "
+            f"| {r.qps_ratio:.2f}x |"
+        )
+    lines.append("")
+    lines.append(
+        "Link-prediction pricing from measured step costs (round 19): a "
+        "pair = 2 endpoint lookups through the shared serve path + a "
+        "batched scoring head. The pair/node ratio sits near 0.5x at "
+        "equal cache behavior; hotter candidate endpoints (higher hit "
+        "rate) close the gap. Measured counterpart: bench.py workloads "
+        "leg + scripts/serve_probe.py --temporal."
+    )
+    return "\n".join(lines)
+
+
 def format_skew_markdown(rows: Sequence[SkewPrediction]) -> str:
     lines = [
         "| replicated top-k | coverage | replica KB/host | exchange seeds | exchange bytes | exchange ms | routed flush ms | QPS uplift |",

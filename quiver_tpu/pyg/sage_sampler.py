@@ -559,6 +559,10 @@ class GraphSageSampler:
         # instead of the frozen CSRTopo cache — fenced graph deltas become
         # visible to the next draw without touching the key stream
         self._stream = None
+        # round-19 temporal binding (`bind_temporal`): (source, recency)
+        # — the source carries per-edge timestamps in the tile payload
+        # lanes and every draw takes a per-seed query time t
+        self._temporal = None
         # per-sampler probe-scan cache: under the default layout='tiled'
         # (and for weighted samplers) _engine() hands probe_hop_counts a
         # fresh sample_fn closure per call, so without this the jitted
@@ -614,6 +618,89 @@ class GraphSageSampler:
         # version in calibrate_caps)
         self._probe_scan_cache.clear()
         return self
+
+    # -- temporal binding (round 19; quiver_tpu.workloads) ----------------
+    @property
+    def temporal(self):
+        """``(source, recency)`` when this sampler draws temporally
+        (`bind_temporal`), else None. The serve engines read this to pick
+        the temporal serve-step shape (an extra per-seed query-time
+        argument on every dispatch)."""
+        return self._temporal
+
+    def bind_temporal(self, source, recency: float = 0.0) -> "GraphSageSampler":
+        """Attach a temporal graph: every draw then samples only edges
+        with ``ts <= t`` (per-seed query times, a jit ARGUMENT of every
+        dispatch — never a closure constant), recency-biased via the
+        weighted sampler's Gumbel machinery
+        (`ops.sample.tiled_temporal_sample_layer`;
+        ``recency`` is the exponent of `ops.sample.temporal_edge_weights`,
+        0 = uniform over the valid set).
+
+        ``source`` is a `workloads.temporal.TemporalTiledGraph` (frozen
+        graph + timestamps) or a `stream.StreamingTiledGraph` built with
+        ``edge_ts=`` — the streaming case ALSO binds the stream
+        (`bind_stream` semantics), so fenced ``update_graph`` commits
+        make an arriving edge visible to the next ``t >= ts`` query and
+        invisible below it. TPU-mode tiled uniform samplers with
+        ``dedup=False`` only: the temporal pipeline threads each seed's
+        own t down its frontier lineage, which needs the structural
+        no-dedup layout (a dedup reindex would merge frontiers across
+        requests with different query times)."""
+        if self.mode != "TPU":
+            raise TypeError("bind_temporal needs mode='TPU' (device graph)")
+        if self.layout != "tiled":
+            raise TypeError(
+                "bind_temporal needs layout='tiled' — timestamps ride the "
+                "tile payload lanes"
+            )
+        if self.weighted:
+            raise TypeError(
+                "temporal recency bias replaces static edge weights; "
+                "bind_temporal needs weighted=False"
+            )
+        if self.dedup:
+            raise TypeError(
+                "temporal sampling threads per-seed query times down the "
+                "frontier lineage — construct with dedup=False (the "
+                "structural no-dedup pipeline)"
+            )
+        if not getattr(source, "temporal", False):
+            raise TypeError(
+                "bind_temporal wants a TemporalTiledGraph or a "
+                "StreamingTiledGraph built with edge_ts= (got "
+                f"{type(source).__name__})"
+            )
+        from ..stream import StreamingTiledGraph
+
+        if isinstance(source, StreamingTiledGraph):
+            # streaming temporal: the stream binding rides along so the
+            # serve engines' update_graph/stage_edges find it
+            self._stream = source
+            self._dev_tiled = None
+        self._temporal = (source, float(recency))
+        self._probe_scan_cache.clear()
+        return self
+
+    def temporal_graph_arrays(self):
+        """The CURRENT device ``(bd, tiles, ttiles)`` triple a temporal
+        draw reads — re-read per call so fenced stream commits become
+        visible to the next draw."""
+        if self._temporal is None:
+            raise TypeError("sampler has no temporal binding")
+        return self._temporal[0].temporal_graph()
+
+    def fused_graph_arrays(self):
+        """The CURRENT device-graph pytree the fused serve programs take
+        as their ``graph`` argument — temporal triple, streamed pair, or
+        the frozen binding (`lazy_init_quiver`), in that precedence. The
+        serve engines rebind sealed executables to this after a fenced
+        graph commit."""
+        if self._temporal is not None:
+            return self.temporal_graph_arrays()
+        if self._stream is not None:
+            return self._stream.graph()
+        return self.lazy_init_quiver()
 
     # -- device-graph binding (reference lazy_init_quiver, sage_sampler.py:98-113)
     def lazy_init_quiver(self):
@@ -798,9 +885,41 @@ class GraphSageSampler:
         return indptr, indices, None, indices.dtype
 
     # -- dense static-shape surface --------------------------------------
-    def sample_dense(self, seeds) -> DenseSample:
+    def sample_dense(self, seeds, t=None) -> DenseSample:
         """Sample a padded, jittable mini-batch. TPU mode runs fully on
-        device; HOST/CPU modes run the native host engine and pad."""
+        device; HOST/CPU modes run the native host engine and pad.
+
+        ``t`` (temporal samplers only — `bind_temporal`): per-seed query
+        times, scalar or ``[B]``; every hop of a seed's expansion then
+        draws only edges with ``ts <= t[seed]``. Consumes one key of the
+        same deterministic stream as every other sample call."""
+        if self._temporal is not None:
+            if t is None:
+                raise TypeError(
+                    "temporal sampler needs a query time: "
+                    "sample_dense(seeds, t=...)"
+                )
+            from ..workloads.temporal import temporal_sample_dense
+
+            source, recency = self._temporal
+            graph = self.temporal_graph_arrays()
+            seeds = jnp.asarray(np.asarray(seeds), graph[1].dtype)
+            tv = np.asarray(t, np.float32).reshape(-1)
+            if tv.shape[0] == 1 and seeds.shape[0] != 1:
+                tv = np.broadcast_to(tv, (seeds.shape[0],)).copy()
+            if tv.shape[0] != seeds.shape[0]:
+                raise ValueError(
+                    f"t has {tv.shape[0]} entries for {seeds.shape[0]} seeds"
+                )
+            return temporal_sample_dense(
+                graph, self._next_key(), seeds, jnp.asarray(tv),
+                self.sizes, recency=recency, max_deg=self.max_deg,
+            )
+        if t is not None:
+            raise TypeError(
+                "t= is only meaningful on a temporal sampler "
+                "(bind_temporal first)"
+            )
         if self.mode == "TPU":
             indptr, indices, sample_fn, id_dtype = self._engine()
             seeds = jnp.asarray(np.asarray(seeds), id_dtype)

@@ -87,8 +87,12 @@ from .engine import (
 from .faults import FaultInjector, FaultSpec, OwnerFault, OwnerKilled
 from .trace_gen import (
     DeltaTrace,
+    LPTrace,
+    TemporalTrace,
     delta_interleaved_trace,
+    lp_trace,
     poisson_arrivals,
+    temporal_trace,
     trace_skew_stats,
     zipfian_trace,
 )
@@ -97,7 +101,11 @@ __all__ = [
     "ClosureFeature",
     "DEFAULT_TENANT",
     "DeltaTrace",
+    "LPTrace",
+    "TemporalTrace",
     "delta_interleaved_trace",
+    "lp_trace",
+    "temporal_trace",
     "DistServeConfig",
     "DistServeEngine",
     "DistServeStats",

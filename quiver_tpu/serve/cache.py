@@ -51,6 +51,11 @@ class EmbeddingCache:
         self.workload = None
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
+        # True once any composite (tuple) key was inserted — the flag
+        # that lets `invalidate_nodes` skip its full-cache scan on
+        # plain int-keyed engines (guarded by _lock; never reset — a
+        # temporal engine stays temporal)
+        self._tuple_keys = False
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -85,6 +90,8 @@ class EmbeddingCache:
         if self.capacity == 0:
             return
         with self._lock:
+            if isinstance(node_id, tuple):
+                self._tuple_keys = True
             if node_id in self._entries:
                 del self._entries[node_id]
             self._entries[node_id] = (version, value)
@@ -116,6 +123,38 @@ class EmbeddingCache:
             self._entries.clear()
             self.invalidations += 1
             return n
+
+    def invalidate_nodes(self, node_ids) -> int:
+        """Drop every entry belonging to the given NODES, whatever its
+        full key shape (round 19): plain int keys match directly;
+        composite keys — the temporal workload's ``(node, t_bucket)``
+        tuples — match on their node element. This is the graph-delta
+        invalidation surface: a changed row staleness-taints a seed's
+        cached result at EVERY query time (any cached t could have
+        sampled the changed row's past), so all its t-entries drop
+        together. Cost: O(keys) exact deletes on a plain int-keyed cache
+        (identical to `invalidate_keys` — a round-17 streaming
+        deployment pays nothing new); the O(resident) scan runs only
+        when a composite key was ever inserted (temporal engines), which
+        is commit-grain work there. Exact-key paths (placement moves,
+        replica refreshes) keep `invalidate_keys`. Returns entries
+        dropped."""
+        nodes = {int(x) for x in node_ids}
+        if not nodes:
+            return 0
+        n = 0
+        with self._lock:
+            for node in nodes:
+                if self._entries.pop(node, None) is not None:
+                    n += 1
+            if self._tuple_keys:
+                for k in list(self._entries):
+                    if isinstance(k, tuple) and k[0] in nodes:
+                        del self._entries[k]
+                        n += 1
+            if n:
+                self.invalidations += 1
+        return n
 
     def invalidate_keys(self, node_ids) -> int:
         """Drop the entries for specific nodes (round 14: a placement

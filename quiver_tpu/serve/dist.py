@@ -958,7 +958,7 @@ class _RoutedFlush:
     other slot resolves normally, and `flush()` does not re-raise."""
 
     __slots__ = ("keys", "slots", "split", "bucket", "error", "slot_errors",
-                 "fid", "tenants")
+                 "fid", "tenants", "extra")
 
     def __init__(self, keys, slots, split):
         self.keys = keys
@@ -971,6 +971,10 @@ class _RoutedFlush:
         # per-key submitting tenant (filled at seal, aligned with keys):
         # owner legs forward these so owner-side quotas hold end-to-end
         self.tenants: List[str] = []
+        # extra per-key dispatch payload aligned with keys (round 19:
+        # the temporal router's query-time vector); None on the plain
+        # router
+        self.extra = None
 
 
 class _HotReplica:
@@ -1464,6 +1468,15 @@ class DistServeEngine:
             raise ValueError(
                 f"node id {key} outside [0, {self.global2host.shape[0]})"
             )
+        return self._submit_keyed(key, key, tenant)
+
+    def _submit_keyed(self, key, node: int,
+                      tenant: Optional[str]) -> ServeResult:
+        """The router's shared submit body (`ServeEngine._submit_keyed`'s
+        dist twin): ``key`` is the coalescing/cache identity — the plain
+        node id here, ``(node, t_bucket)`` on the round-19 temporal
+        router — and ``node`` what telemetry/journal/shed entries
+        carry."""
         tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         now = self._clock()
         need_flush = False
@@ -1472,18 +1485,18 @@ class DistServeEngine:
         with self._lock:
             self.stats.requests += 1
             if wl is not None:
-                wl.observe_seed(key)  # observe-only frequency tap
+                wl.observe_seed(node)  # observe-only frequency tap
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
                 ms = (self._clock() - now) * 1e3
                 self.stats.latency.record_ms(ms)
                 self.stats.tenant_hist(tenant).record_ms(ms)
-                jr.emit("cache_hit", -1, -1, key)
+                jr.emit("cache_hit", -1, -1, node)
                 return ServeResult(value=cached)
             slot = self._pending.get(key) or self._inflight.get(key)
             if slot is not None and slot.version == self.params_version:
                 self.stats.coalesced += 1
-                jr.emit("coalesce", slot.rid, -1, key)
+                jr.emit("coalesce", slot.rid, -1, node)
             else:
                 if shed_decision(
                     len(self._pending), self._pending_tenant.get(tenant, 0),
@@ -1491,8 +1504,8 @@ class DistServeEngine:
                     self.config.tenant_weights,
                 ):
                     self.stats.shed += 1
-                    self.shed_log.append((self.stats.requests, tenant, key))
-                    jr.emit("shed", -1, -1, key)
+                    self.shed_log.append((self.stats.requests, tenant, node))
+                    jr.emit("shed", -1, -1, node)
                     return ServeResult(error=ShedError(
                         f"router queue depth {len(self._pending)} >= "
                         f"{self.config.max_queue_depth} and tenant "
@@ -1512,13 +1525,13 @@ class DistServeEngine:
                     fl.slots.append(slot)
                     self._inflight[key] = slot
                     self.stats.late_admitted += 1
-                    jr.emit("late_admit", rid, fl.fid, key)
+                    jr.emit("late_admit", rid, fl.fid, node)
                 else:
                     self._pending[key] = slot
                     self._pending_tenant[tenant] = (
                         self._pending_tenant.get(tenant, 0) + 1
                     )
-                    jr.emit("submit", rid, -1, key)
+                    jr.emit("submit", rid, -1, node)
             slot.waiters.append((now, tenant))
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
@@ -1593,7 +1606,10 @@ class DistServeEngine:
             jr = self.journal
             if jr.enabled:
                 for k, slot in zip(keys, slots):
-                    jr.emit("assemble", slot.rid, fl.fid, k)
+                    # a = the NODE id per the EVENT_KINDS contract (a
+                    # temporal key is a (node, t_bucket) tuple)
+                    jr.emit("assemble", slot.rid, fl.fid,
+                            k[0] if isinstance(k, tuple) else k)
                 jr.emit("flush", -1, fl.fid, len(keys), fl.bucket)
             if self.config.late_admission and len(keys) < fl.bucket:
                 self._open = fl
@@ -2330,7 +2346,11 @@ class DistServeEngine:
                         int(x) for x in stale_replica_ids
                     )
                     self.stats.replica_delta_invalidations += 1
-                invalidated = self.cache.invalidate_keys(
+                # node-keyed drop (not exact keys): temporal router-cache
+                # entries are (node, t)-keyed; identical behavior for the
+                # plain int keys of this engine (see
+                # EmbeddingCache.invalidate_nodes)
+                invalidated = self.cache.invalidate_nodes(
                     int(x) for x in affected
                 )
                 self.stats.graph_deltas += 1
@@ -3035,6 +3055,17 @@ class DistServeEngine:
                      lambda: (len(self.pending_delta)
                               if self.pending_delta is not None else 0),
                      "edge arrivals staged and not yet committed", labels)
+        # round-19 satellite: every owner stream's reserve runway as
+        # gauges (host label), same family names as the single-host
+        # engine's so one alert rule covers both
+        from .engine import register_stream_reserve
+
+        for h in sorted(self._owner_streams):
+            register_stream_reserve(
+                reg, prefix,
+                (lambda h=h: self._owner_streams.get(h)),
+                dict(labels or {}, host=str(h)),
+            )
         reg.gauge_fn(f"{prefix}_hosts",
                      lambda: self.hosts,
                      "current serving fleet host count", labels)

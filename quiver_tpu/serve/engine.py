@@ -228,6 +228,46 @@ def register_tenant_latency(reg, prefix: str, help_text: str, get_stats,
         )
 
 
+def register_stream_reserve(reg, prefix: str, get_stream,
+                            labels: Optional[Dict[str, str]] = None) -> None:
+    """Expose a bound `stream.StreamingTiledGraph`'s `reserve_report()`
+    as Prometheus gauges (round-19 satellite — the r18 leftover: reserve
+    runway was only visible as a `StreamCapacityError` hard failure;
+    these gauges make it an alertable curve). ``get_stream`` is a
+    zero-arg resolver (None = not stream-bound, gauges are skipped), so
+    the family follows rebinds. Shared by `ServeEngine.register_metrics`
+    and the router's per-owner registration — one naming scheme
+    fleet-wide. ``projected_commits_to_exhaustion`` exports -1 while no
+    consumption has been observed (None in the report: nothing honest to
+    project from)."""
+    if get_stream() is None:
+        return
+
+    def field(name):
+        def read(name=name):
+            stream = get_stream()
+            if stream is None:
+                return 0
+            v = stream.reserve_report()[name]
+            return -1 if v is None else v
+
+        return read
+
+    reg.gauge_fn(f"{prefix}_stream_reserve_tiles", field("reserve_tiles"),
+                 "spare tile rows planned for streaming appends", labels)
+    reg.gauge_fn(f"{prefix}_stream_reserve_used", field("reserve_used"),
+                 "reserve tile rows consumed by spills/installs", labels)
+    reg.gauge_fn(f"{prefix}_stream_reserve_free", field("reserve_free"),
+                 "reserve tile rows remaining", labels)
+    reg.gauge_fn(f"{prefix}_stream_reserve_rows_per_commit",
+                 field("rows_per_commit"),
+                 "mean reserve rows consumed per delta commit", labels)
+    reg.gauge_fn(f"{prefix}_stream_reserve_projected_commits",
+                 field("projected_commits_to_exhaustion"),
+                 "commits of runway left at the observed consumption "
+                 "rate (-1 = no consumption observed yet)", labels)
+
+
 def abandon_undrained(engine, drained: bool = True) -> None:
     """Resolve whatever a bounded ``stop`` left behind with
     `DrainTimeout` and count it in ``stats.undrained`` — shared by
@@ -729,7 +769,7 @@ class _Flush:
     dispatch; the split path carries the pre-run sample ``ds``."""
 
     __slots__ = ("keys", "slots", "params", "seeds", "bucket", "ds", "key",
-                 "padded", "error", "fid")
+                 "padded", "extra", "error", "fid")
 
     def __init__(self, keys, slots, params):
         self.keys = keys
@@ -740,6 +780,9 @@ class _Flush:
         self.ds = None
         self.key = None
         self.padded = None
+        # extra padded per-seed dispatch arguments (round 19: the temporal
+        # workload's query-time vector); None on the plain engine
+        self.extra = None
         self.error: Optional[BaseException] = None
         # journal flush id == the dispatch index `_seal_assembled` will
         # draw (assemble and seal happen under one _seq hold, so nothing
@@ -764,9 +807,21 @@ class ServeEngine:
         logits = h.result()
     """
 
+    # subclasses that understand the temporal dispatch shape (the extra
+    # query-time argument, composite (node, t) keys) set this — see
+    # quiver_tpu.workloads.serving.TemporalServeEngine
+    _temporal_capable = False
+
     def __init__(self, model, params, sampler, feature,
                  config: Optional[ServeConfig] = None):
         self.config = config or ServeConfig()
+        if (getattr(sampler, "temporal", None) is not None
+                and not self._temporal_capable):
+            raise TypeError(
+                "temporal-bound samplers need the temporal engine — use "
+                "quiver_tpu.workloads.TemporalServeEngine (this engine "
+                "would dispatch without a query time)"
+            )
         if self.config.max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1")
         if self.config.dispatch_mode not in ("auto", "fused", "split"):
@@ -922,6 +977,17 @@ class ServeEngine:
         contract rides this exact cache-check/coalesce/admit/flush-at-fill
         sequence."""
         key = int(node_id)
+        return self._submit_keyed(key, key, tenant)
+
+    def _submit_keyed(self, key, node: int,
+                      tenant: Optional[str]) -> ServeResult:
+        """The one cache-check/coalesce/shed/admit/flush-at-fill sequence
+        behind every submit spelling: ``key`` is the coalescing/cache
+        identity (the plain node id here; ``(node, t_bucket)`` on the
+        round-19 temporal engine, which overrides only `submit` to build
+        it) and ``node`` the seed id telemetry/journal/shed entries
+        carry. One body, so a future change to shedding or admission can
+        never silently skip a workload."""
         tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         now = self._clock()
         need_flush = False
@@ -930,23 +996,23 @@ class ServeEngine:
         with self._lock:
             self.stats.requests += 1
             if wl is not None:
-                wl.observe_seed(key)  # observe-only frequency tap
+                wl.observe_seed(node)  # observe-only frequency tap
             cached = self.cache.get(key, self.params_version)
             if cached is not None:
                 ms = (self._clock() - now) * 1e3
                 self.stats.latency.record_ms(ms)
                 self.stats.tenant_hist(tenant).record_ms(ms)
-                jr.emit("cache_hit", -1, -1, key)
+                jr.emit("cache_hit", -1, -1, node)
                 return ServeResult(value=cached)
             slot = self._pending.get(key) or self._inflight.get(key)
             if slot is not None and slot.version == self.params_version:
                 self.stats.coalesced += 1
-                jr.emit("coalesce", slot.rid, -1, key)
+                jr.emit("coalesce", slot.rid, -1, node)
             else:
                 if self._shed_locked(tenant):
                     self.stats.shed += 1
-                    self.shed_log.append((self.stats.requests, tenant, key))
-                    jr.emit("shed", -1, -1, key)
+                    self.shed_log.append((self.stats.requests, tenant, node))
+                    jr.emit("shed", -1, -1, node)
                     return ServeResult(error=ShedError(
                         f"queue depth {len(self._pending)} >= "
                         f"{self.config.max_queue_depth} and tenant "
@@ -967,13 +1033,13 @@ class ServeEngine:
                     fl.slots.append(slot)
                     self._inflight[key] = slot
                     self.stats.late_admitted += 1
-                    jr.emit("late_admit", rid, fl.fid, key)
+                    jr.emit("late_admit", rid, fl.fid, node)
                 else:
                     self._pending[key] = slot
                     self._pending_tenant[tenant] = (
                         self._pending_tenant.get(tenant, 0) + 1
                     )
-                    jr.emit("submit", rid, -1, key)
+                    jr.emit("submit", rid, -1, node)
             slot.waiters.append((now, tenant))
             if len(self._pending) >= self.config.max_batch:
                 need_flush = True
@@ -1094,7 +1160,10 @@ class ServeEngine:
                 # draw is exactly the next one
                 fl.fid = self._dispatch_index + 1
                 for k, slot in zip(keys, slots):
-                    jr.emit("assemble", slot.rid, fl.fid, k)
+                    # a = the NODE id per the EVENT_KINDS contract (a
+                    # temporal key is a (node, t_bucket) tuple)
+                    jr.emit("assemble", slot.rid, fl.fid,
+                            k[0] if isinstance(k, tuple) else k)
                 jr.emit("flush", -1, fl.fid, len(keys), fl.bucket)
             if self.config.late_admission and len(keys) < fl.bucket:
                 self._open = fl
@@ -1116,8 +1185,8 @@ class ServeEngine:
             self.workload.tick()
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
-            fl.seeds = np.asarray(fl.keys, dtype=np.int64)
-            if self.config.max_in_flight == 1:
+            fl.seeds, extras = self._flush_arrays(fl)
+            if self.config.max_in_flight == 1 and not extras:
                 # serial mode: reuse one pad buffer per bucket (round-8
                 # behavior); with in-flight > 1 each flush owns its buffer
                 buf = self._seed_bufs.get((fl.bucket, fl.seeds.dtype.str))
@@ -1125,17 +1194,36 @@ class ServeEngine:
                 self._seed_bufs[(fl.bucket, fl.seeds.dtype.str)] = padded
             else:
                 padded = pad_seed_batch(fl.seeds, fl.bucket)
+            if extras:
+                fl.extra = tuple(
+                    pad_seed_batch(e, fl.bucket) for e in extras
+                )
             if self.config.record_dispatches:
-                self.dispatch_log.append((padded.copy(), len(fl.keys)))
+                self.dispatch_log.append(self._dispatch_log_entry(fl, padded))
             if self._programs is not None:
                 # fused path: draw the key in dispatch order, defer the
                 # sample into the one-program dispatch stage
                 fl.key = draw_sample_key(self._sampler)
                 fl.padded = padded
             else:
-                fl.ds = sample_batch(self._sampler, padded)
+                fl.ds = self._split_sample(fl, padded)
         except BaseException as exc:  # resolved (with the error) by stage 3
             fl.error = exc
+
+    # hooks the round-19 workloads subsystem overrides (base behavior is
+    # byte-identical to round 18): how flush keys become dispatch arrays,
+    # what a dispatch-log entry records, and how the split path samples
+    def _flush_arrays(self, fl: _Flush):
+        """``(seeds int64 [n], extra per-seed arrays)`` from ``fl.keys``.
+        The temporal engine's keys are ``(node, t)`` pairs and its extra
+        is the query-time vector; here keys ARE the seeds."""
+        return np.asarray(fl.keys, dtype=np.int64), ()
+
+    def _dispatch_log_entry(self, fl: _Flush, padded: np.ndarray):
+        return (padded.copy(), len(fl.keys))
+
+    def _split_sample(self, fl: _Flush, padded: np.ndarray):
+        return sample_batch(self._sampler, padded)
 
     def _dispatch(self, fl: _Flush) -> Optional[np.ndarray]:
         """Stage 2 (no engine lock held): the device work + blocking D2H —
@@ -1147,7 +1235,8 @@ class ServeEngine:
         self.journal.emit("dispatch", -1, fl.fid, fl.bucket)
         if fl.ds is None and self._programs is not None:
             logits = np.asarray(
-                self._programs(fl.bucket, fl.params, fl.key, fl.padded)
+                self._programs(fl.bucket, fl.params, fl.key, fl.padded,
+                               *(fl.extra or ()))
             )
             n_exec = 1
         else:
@@ -1448,6 +1537,10 @@ class ServeEngine:
                      lambda: (len(self.pending_delta)
                               if self.pending_delta is not None else 0),
                      "edge arrivals staged and not yet committed", labels)
+        register_stream_reserve(
+            reg, prefix, lambda: getattr(self._sampler, "stream", None),
+            labels,
+        )
         reg.gauge_fn(f"{prefix}_placement_version",
                      lambda: self.placement_version,
                      "fenced tier-placement batches applied", labels)
@@ -1597,7 +1690,7 @@ class ServeEngine:
 
     # -- streaming graph deltas (round 17; quiver_tpu.stream) --------------
 
-    def stage_edges(self, src, dst) -> int:
+    def stage_edges(self, src, dst, ts=None) -> int:
         """Accumulate edge arrivals host-side into ``pending_delta``
         (observe-only until a commit: no device state, no fence, no
         served bit moves). Edge ids are validated HERE, against the
@@ -1619,10 +1712,29 @@ class ServeEngine:
             topo = getattr(self._sampler, "csr_topo", None)
             n = topo.node_count if topo is not None else None
         src, dst = validate_edge_ids(src, dst, n, "staged")
+        if stream is not None:
+            # the temporal-arity contract holds AT THE STAGING CALL SITE
+            # in BOTH directions: a ts-less arrival on a temporal stream
+            # — or a timestamped one on a plain stream — must raise here,
+            # because a delta that can never commit would re-stage on
+            # every update_graph failure and wedge the pending buffer
+            # forever (and poison later correct stagings via GraphDelta's
+            # homogeneity check)
+            if getattr(stream, "temporal", False):
+                if (ts is None
+                        or np.asarray(ts).reshape(-1).shape != src.shape):
+                    raise ValueError(
+                        "temporal stream needs one ts per staged edge"
+                    )
+            elif ts is not None:
+                raise ValueError(
+                    "edge timestamps staged into a non-temporal stream — "
+                    "build StreamingTiledGraph(edge_ts=...) to carry them"
+                )
         with self._lock:
             if self.pending_delta is None:
                 self.pending_delta = GraphDelta()
-            self.pending_delta.add_edges(src, dst)
+            self.pending_delta.add_edges(src, dst, ts=ts)
             n = len(self.pending_delta)
         self.journal.emit("graph_delta", -1, -1, n)
         return n
@@ -1691,8 +1803,10 @@ class ServeEngine:
                             from ..inference import feature_gather_spec
 
                             table, imap = feature_gather_spec(self._feature)
-                        self._programs.rebind(graph=stream.graph(),
-                                              table=table, index_map=imap)
+                        self._programs.rebind(
+                            graph=self._sampler.fused_graph_arrays(),
+                            table=table, index_map=imap,
+                        )
                     if invalidate is not None:
                         affected = np.asarray(list(invalidate), np.int64)
                     elif n_edges:
@@ -1703,7 +1817,12 @@ class ServeEngine:
                                                          hops)
                     else:
                         affected = np.array([], np.int64)
-                    invalidated = self.cache.invalidate_keys(
+                    # invalidate by NODE, not exact key: temporal cache
+                    # entries are (node, t)-keyed, and a changed row
+                    # staleness-taints every cached t of an affected seed
+                    # (for plain int keys this is behavior-identical to
+                    # the round-17 invalidate_keys)
+                    invalidated = self.cache.invalidate_nodes(
                         int(x) for x in affected
                     )
                     self.stats.graph_deltas += 1

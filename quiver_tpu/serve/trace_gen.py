@@ -113,6 +113,146 @@ def delta_interleaved_trace(
                       edge_dst=dst)
 
 
+class TemporalTrace(NamedTuple):
+    """Arrival-stamped temporal queries interleaved with timestamped edge
+    appends (round 19): request ``i`` asks for ``requests[i]`` AS OF
+    ``t_query[i]`` (its own arrival time on a seeded Poisson clock — the
+    feed-ranking shape: you rank against the graph as it exists when you
+    ask); arrival event ``j`` commits edges ``(edge_src[j], edge_dst[j])``
+    with per-edge timestamps ``edge_ts[j]`` immediately BEFORE request
+    index ``edge_pos[j]``, and every committed timestamp precedes the
+    next query's t — so the "edge arrives, next ``ts <= t`` query sees
+    it" contract is exercised by construction. Byte-deterministic under
+    a fixed seed."""
+
+    requests: np.ndarray   # [n_requests] int64 node ids
+    t_query: np.ndarray    # [n_requests] float64 query times (monotone)
+    edge_pos: np.ndarray   # [n_events] int64 request index per event
+    edge_src: np.ndarray   # [n_events, edges_per_event] int64
+    edge_dst: np.ndarray   # [n_events, edges_per_event] int64
+    edge_ts: np.ndarray    # [n_events, edges_per_event] float64
+
+    @property
+    def n_events(self) -> int:
+        return int(self.edge_pos.shape[0])
+
+    def events(self):
+        """Yields ``("edges", src_row, dst_row, ts_row)`` then
+        ``("request", index, node, t)`` in commit order."""
+        e = 0
+        for i, node in enumerate(self.requests):
+            while e < self.n_events and int(self.edge_pos[e]) == i:
+                yield ("edges", self.edge_src[e], self.edge_dst[e],
+                       self.edge_ts[e])
+                e += 1
+            yield ("request", i, int(node), float(self.t_query[i]))
+
+
+def temporal_trace(
+    n_nodes: int,
+    n_requests: int,
+    alpha: float = 0.99,
+    seed: int = 0,
+    qps: float = 1000.0,
+    t0: float = 0.0,
+    edge_every: int = 32,
+    edges_per_event: int = 4,
+) -> TemporalTrace:
+    """Seeded temporal drive traffic: a `zipfian_trace` node stream with
+    `poisson_arrivals` query times starting at ``t0`` (so base-graph
+    timestamps below ``t0`` are all in the past), and one edge-append
+    event every ``edge_every`` requests. Event sources are drawn from the
+    served PREFIX (arrivals correlate with live traffic, like
+    `delta_interleaved_trace`); each appended edge's timestamp lands
+    strictly between the previous and next query times, so it is
+    invisible to every earlier query and visible to every later one at
+    that source — the per-commit visibility assert the probe rides.
+    Everything derives from ``seed``; two calls are byte-identical."""
+    if edge_every <= 0 or edges_per_event <= 0:
+        raise ValueError("edge_every and edges_per_event must be > 0")
+    requests = zipfian_trace(n_nodes, n_requests, alpha=alpha, seed=seed)
+    t_query = t0 + poisson_arrivals(n_requests, qps, seed=seed)
+    rng = np.random.default_rng([int(seed), 0x7E4D])
+    pos = np.arange(edge_every, n_requests, edge_every, dtype=np.int64)
+    k = pos.shape[0]
+    src = np.zeros((k, edges_per_event), np.int64)
+    dst = np.zeros((k, edges_per_event), np.int64)
+    ets = np.zeros((k, edges_per_event), np.float64)
+    for i, p in enumerate(pos):
+        picks = rng.integers(0, int(p), edges_per_event)
+        src[i] = requests[picks]
+        dst[i] = rng.integers(0, n_nodes, edges_per_event)
+        # strictly between the neighboring query times: u in (0, 1) open
+        lo, hi = float(t_query[p - 1]), float(t_query[p])
+        u = rng.uniform(0.05, 0.95, edges_per_event)
+        ets[i] = lo + u * (hi - lo)
+    loops = src == dst
+    dst[loops] = (dst[loops] + 1) % n_nodes
+    return TemporalTrace(requests=requests, t_query=t_query, edge_pos=pos,
+                         edge_src=src, edge_dst=dst, edge_ts=ets)
+
+
+class LPTrace(NamedTuple):
+    """A link-prediction request stream (round 19): candidate pairs
+    ``(u[i], v[i])`` with ``label[i]`` 1 for a true edge of the graph and
+    0 for a sampled negative, queried at ``t_query[i]``. Negatives pair
+    a source from the SERVED PREFIX (a node retrieval has already
+    touched — the production shape: you re-rank candidates for active
+    users) with a uniform non-self destination. Byte-deterministic under
+    a fixed seed."""
+
+    u: np.ndarray        # [n_pairs] int64
+    v: np.ndarray        # [n_pairs] int64
+    label: np.ndarray    # [n_pairs] int8 (1 = true edge, 0 = negative)
+    t_query: np.ndarray  # [n_pairs] float64
+
+
+def lp_trace(
+    csr_topo,
+    n_pairs: int,
+    alpha: float = 0.99,
+    seed: int = 0,
+    pos_frac: float = 0.5,
+    qps: float = 1000.0,
+    t0: float = 0.0,
+) -> LPTrace:
+    """Seeded LP traffic over a graph: ``pos_frac`` of pairs are true
+    edges (source drawn Zipf-hot, destination a uniformly drawn neighbor
+    of it); the rest are negatives sampled from the served prefix —
+    source drawn from the pairs already emitted (the prefix; the first
+    request falls back to the Zipf draw), destination uniform with
+    self-loops nudged off. Degree-0 sources fall back to negatives, so
+    every row is well-defined on any graph."""
+    if n_pairs < 0 or not 0.0 <= pos_frac <= 1.0:
+        raise ValueError("need n_pairs >= 0 and 0 <= pos_frac <= 1")
+    indptr = np.asarray(csr_topo.indptr, np.int64)
+    indices = np.asarray(csr_topo.indices, np.int64)
+    n_nodes = indptr.shape[0] - 1
+    hot = zipfian_trace(n_nodes, n_pairs, alpha=alpha, seed=seed)
+    t_query = t0 + poisson_arrivals(n_pairs, qps, seed=seed)
+    rng = np.random.default_rng([int(seed), 0x1B9A])
+    u = np.zeros(n_pairs, np.int64)
+    v = np.zeros(n_pairs, np.int64)
+    label = np.zeros(n_pairs, np.int8)
+    for i in range(n_pairs):
+        want_pos = rng.uniform() < pos_frac
+        src = int(hot[i])
+        deg = int(indptr[src + 1] - indptr[src])
+        if want_pos and deg > 0:
+            u[i] = src
+            v[i] = int(indices[indptr[src] + rng.integers(0, deg)])
+            label[i] = 1
+        else:
+            served = u[:i]
+            u[i] = int(served[rng.integers(0, i)]) if i else src
+            d = int(rng.integers(0, n_nodes))
+            if d == u[i]:
+                d = (d + 1) % n_nodes
+            v[i] = d
+            label[i] = 0
+    return LPTrace(u=u, v=v, label=label, t_query=t_query)
+
+
 def trace_skew_stats(trace: np.ndarray, top_frac: float = 0.01) -> dict:
     """Observed skew of a trace: unique fraction and the request share of
     the hottest ``top_frac`` of distinct nodes (the number a cache planner

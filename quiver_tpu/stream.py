@@ -126,35 +126,58 @@ class GraphDelta:
     commits the whole batch. Deterministic: two buffers fed the same
     arrivals apply identically."""
 
-    __slots__ = ("_src", "_dst", "_n")
+    __slots__ = ("_src", "_dst", "_ts", "_n")
 
-    def __init__(self, src=None, dst=None):
+    def __init__(self, src=None, dst=None, ts=None):
         self._src: List[np.ndarray] = []
         self._dst: List[np.ndarray] = []
+        # per-edge timestamp chunks (round 19, temporal workloads): either
+        # EVERY staged chunk carries timestamps or none does — a mixed
+        # buffer could not commit into a temporal tile map deterministically
+        self._ts: List[np.ndarray] = []
         self._n = 0
         if src is not None or dst is not None:
             if (src is None) != (dst is None):
                 raise ValueError("src/dst lengths differ")
-            self.add_edges(src, dst)
+            self.add_edges(src, dst, ts=ts)
 
-    def add_edge(self, src: int, dst: int) -> None:
-        self._src.append(np.asarray([src], np.int64))
-        self._dst.append(np.asarray([dst], np.int64))
-        self._n += 1
+    def add_edge(self, src: int, dst: int, ts: Optional[float] = None) -> None:
+        self.add_edges(
+            np.asarray([src], np.int64), np.asarray([dst], np.int64),
+            ts=None if ts is None else np.asarray([ts], np.float32),
+        )
 
-    def add_edges(self, src, dst) -> None:
+    def add_edges(self, src, dst, ts=None) -> None:
         src, dst = validate_edge_ids(src, dst)
         if src.size:
+            if ts is not None:
+                ts = np.asarray(ts, np.float32).reshape(-1)
+                if ts.shape != src.shape:
+                    raise ValueError(
+                        f"ts {ts.shape} does not match edges {src.shape}"
+                    )
+            if self._n and (bool(self._ts) != (ts is not None)):
+                raise ValueError(
+                    "mixed timestamped and untimestamped edges in one "
+                    "GraphDelta — a temporal stream needs a ts per edge"
+                )
             # copies: the caller may reuse its arrival buffers after
             # staging, and staged chunks are never mutated in place (so
             # `extend` may share them across buffers)
             self._src.append(src.copy())
             self._dst.append(dst.copy())
+            if ts is not None:
+                self._ts.append(ts.copy())
             self._n += int(src.size)
 
     def extend(self, other: "GraphDelta") -> None:
+        if self._n and other._n and bool(self._ts) != bool(other._ts):
+            raise ValueError(
+                "cannot merge timestamped and untimestamped GraphDeltas"
+            )
         self._src.extend(other._src)
         self._dst.extend(other._dst)
+        self._ts.extend(other._ts)
         self._n += other._n
 
     def __len__(self) -> int:
@@ -165,6 +188,13 @@ class GraphDelta:
         if not self._src:
             return np.empty(0, np.int64), np.empty(0, np.int64)
         return np.concatenate(self._src), np.concatenate(self._dst)
+
+    def edges_ts(self) -> Optional[np.ndarray]:
+        """Per-edge float32 timestamps in arrival order, or None when
+        this buffer was staged without them (the pre-round-19 shape)."""
+        if not self._ts:
+            return None
+        return np.concatenate(self._ts)
 
     def sources(self) -> np.ndarray:
         """Sorted unique source ids — the rows whose degree (and hence
@@ -193,11 +223,27 @@ class StreamingAdjacency:
     per-node dicts, so a bounded delta batch costs O(batch), never
     O(E)."""
 
-    def __init__(self, csr_topo):
+    def __init__(self, csr_topo, edge_ts=None):
         self.indptr = np.asarray(csr_topo.indptr, np.int64)
         self.indices = np.asarray(csr_topo.indices, np.int64)
         self.n = self.indptr.shape[0] - 1
+        # round-19 temporal workloads: optional per-edge timestamps
+        # aligned with the base CSR, plus per-node appended-ts lists kept
+        # in lockstep with _extra (same lane order — draw parity and the
+        # temporal replay oracle both ride it)
+        self.edge_ts = (
+            None if edge_ts is None
+            else np.asarray(edge_ts, np.float32).reshape(-1)
+        )
+        if self.edge_ts is not None and (
+            self.edge_ts.shape[0] != self.indices.shape[0]
+        ):
+            raise ValueError(
+                f"edge_ts has {self.edge_ts.shape[0]} entries for "
+                f"{self.indices.shape[0]} edges"
+            )
         self._extra: Dict[int, List[int]] = {}
+        self._extra_ts: Dict[int, List[float]] = {}
         self._rev_extra: Dict[int, List[int]] = {}
         self._n_extra = 0
         # reverse base CSR (counting sort, same construction as CSRTopo)
@@ -215,10 +261,21 @@ class StreamingAdjacency:
     def extra_edges(self) -> int:
         return self._n_extra
 
-    def add_edges(self, src, dst) -> None:
+    def add_edges(self, src, dst, ts=None) -> None:
         src, dst = validate_edge_ids(src, dst, self.n)
-        for u, v in zip(src, dst):
+        if self.edge_ts is not None:
+            if ts is None:
+                raise ValueError(
+                    "temporal adjacency (edge_ts set) needs a timestamp "
+                    "per appended edge"
+                )
+            ts = np.asarray(ts, np.float32).reshape(-1)
+            if ts.shape != src.shape:
+                raise ValueError(f"ts {ts.shape} != edges {src.shape}")
+        for i, (u, v) in enumerate(zip(src, dst)):
             self._extra.setdefault(int(u), []).append(int(v))
+            if self.edge_ts is not None:
+                self._extra_ts.setdefault(int(u), []).append(float(ts[i]))
             self._rev_extra.setdefault(int(v), []).append(int(u))
         self._n_extra += src.shape[0]
 
@@ -233,6 +290,8 @@ class StreamingAdjacency:
         dst = np.asarray(dst, np.int64).reshape(-1)
         for u, v in zip(src[::-1], dst[::-1]):
             self._extra[int(u)].pop()
+            if self.edge_ts is not None:
+                self._extra_ts[int(u)].pop()
             self._rev_extra[int(v)].pop()
         self._n_extra -= src.shape[0]
 
@@ -247,6 +306,19 @@ class StreamingAdjacency:
         if not extra:
             return base.copy()
         return np.concatenate([base, np.asarray(extra, np.int64)])
+
+    def neighbors_ts(self, node: int) -> np.ndarray:
+        """Per-edge timestamps of `neighbors(node)`, same lane order
+        (base CSR ts first, appended arrival ts after). Temporal
+        adjacencies only."""
+        if self.edge_ts is None:
+            raise ValueError("adjacency was built without edge_ts")
+        node = int(node)
+        base = self.edge_ts[self.indptr[node]:self.indptr[node + 1]]
+        extra = self._extra_ts.get(node)
+        if not extra:
+            return base.copy()
+        return np.concatenate([base, np.asarray(extra, np.float32)])
 
     def degree(self, node: int) -> int:
         node = int(node)
@@ -355,6 +427,32 @@ class StreamingAdjacency:
             new_indices[lo:lo + len(vs)] = vs
         return CSRTopo(indptr=new_indptr, indices=new_indices)
 
+    def to_temporal(self):
+        """Materialize the UPDATED graph as ``(CSRTopo, edge_ts)`` with
+        the timestamps in exactly `to_csr_topo`'s edge order (base edges
+        first per row, arrivals after — the tile-lane order) — the
+        temporal replay-oracle / rebuild surface. Temporal adjacencies
+        only."""
+        if self.edge_ts is None:
+            raise ValueError("adjacency was built without edge_ts")
+        topo = self.to_csr_topo()
+        if not self._extra:
+            return topo, self.edge_ts.copy()
+        new_indptr = np.asarray(topo.indptr, np.int64)
+        base_deg = self.indptr[1:] - self.indptr[:-1]
+        new_ts = np.zeros(int(new_indptr[-1]), np.float32)
+        src_per_edge = np.repeat(np.arange(self.n, dtype=np.int64), base_deg)
+        pos_in_row = np.arange(self.indices.shape[0], dtype=np.int64) - (
+            np.repeat(self.indptr[:-1], base_deg)
+        )
+        new_ts[new_indptr[src_per_edge] + pos_in_row] = self.edge_ts
+        for u, vs in self._extra.items():
+            lo = int(new_indptr[u] + base_deg[u])
+            new_ts[lo:lo + len(vs)] = np.asarray(
+                self._extra_ts.get(u, []), np.float32
+            )
+        return topo, new_ts
+
 
 def _bucketed(idx: np.ndarray, rows: np.ndarray, sentinel: int,
               floor: int = 64) -> Tuple[np.ndarray, np.ndarray]:
@@ -403,11 +501,11 @@ class StreamingTiledGraph:
 
     def __init__(self, csr_topo, reserve_tiles: Optional[int] = None,
                  reserve_frac: float = 0.5, grow_tiles: int = 1,
-                 device_arrays: bool = True, id_dtype=None):
+                 device_arrays: bool = True, id_dtype=None, edge_ts=None):
         from .utils import _best_id_dtype
 
         self.csr_topo = csr_topo
-        self.adj = StreamingAdjacency(csr_topo)
+        self.adj = StreamingAdjacency(csr_topo, edge_ts=edge_ts)
         self.n = self.adj.n
         if id_dtype is None:
             id_dtype = _best_id_dtype(self.n + 1)
@@ -423,6 +521,18 @@ class StreamingTiledGraph:
         self.bd = np.ascontiguousarray(bd)  # [N, 2] int32 (base, deg)
         self.tiles = np.zeros((self.m_cap, LANE), tiles.dtype)
         self.tiles[:m] = tiles
+        # round-19 temporal payload: per-edge timestamps in a SECOND tile
+        # table sharing the tile map byte for byte (the round-5 weights
+        # trick) — appends/spills/installs mutate both under one lock and
+        # one batched device swap per commit, so a committed edge and its
+        # timestamp become drawable in the same `temporal_graph()` read
+        self.ttiles: Optional[np.ndarray] = None
+        if edge_ts is not None:
+            _, tt = build_tiled_host(
+                self.adj.indptr, self.adj.edge_ts, np.float32
+            )
+            self.ttiles = np.zeros((self.m_cap, LANE), np.float32)
+            self.ttiles[:m] = tt
         deg = self.bd[:, 1].astype(np.int64)
         self.alloc_rows = (-(-deg // LANE)).astype(np.int32)  # rows held
         self._free_row = m
@@ -437,11 +547,14 @@ class StreamingTiledGraph:
         self._lock = threading.Lock()
         self._bd_dev = None
         self._tiles_dev = None
+        self._tt_dev = None
         if device_arrays:
             import jax.numpy as jnp
 
             self._bd_dev = jnp.asarray(self.bd)
             self._tiles_dev = jnp.asarray(self.tiles)
+            if self.ttiles is not None:
+                self._tt_dev = jnp.asarray(self.ttiles)
 
     # ------------------------------------------------------------ reads
     @property
@@ -495,6 +608,13 @@ class StreamingTiledGraph:
             "reserve_tiles (shapes are frozen — see StreamingTiledGraph)"
         )
 
+    @property
+    def temporal(self) -> bool:
+        """True when this stream carries per-edge timestamps (built with
+        ``edge_ts=``) — `temporal_graph()` is then the sampling surface
+        and every committed edge must arrive with a timestamp."""
+        return self.ttiles is not None
+
     def graph(self):
         """The CURRENT device ``(bd, tiles)`` pair — what a stream-bound
         `GraphSageSampler` samples from (`bind_stream`). Array objects
@@ -505,6 +625,22 @@ class StreamingTiledGraph:
                 "bookkeeping only)"
             )
         return self._bd_dev, self._tiles_dev
+
+    def temporal_graph(self):
+        """The CURRENT device ``(bd, tiles, ttiles)`` triple — what a
+        temporal-bound sampler (`GraphSageSampler.bind_temporal`) draws
+        from. Same commit semantics as `graph()`: array objects change
+        per fenced commit, shapes never."""
+        if not self.temporal:
+            raise ValueError(
+                "stream was built without edge_ts (no timestamp payload)"
+            )
+        if self._tiles_dev is None:
+            raise ValueError(
+                "stream was built with device_arrays=False (host "
+                "bookkeeping only)"
+            )
+        return self._bd_dev, self._tiles_dev, self._tt_dev
 
     def neighbors(self, node: int) -> np.ndarray:
         return self.adj.neighbors(node)
@@ -539,19 +675,61 @@ class StreamingTiledGraph:
         src, dst = delta.edges() if delta is not None else (
             np.array([], np.int64), np.array([], np.int64)
         )
-        installs = list(installs or ())
+        ts = delta.edges_ts() if delta is not None else None
+        installs = self._normalize_installs(installs)
         with self._lock:
-            return self._preflight_locked(src, dst, installs)
+            return self._preflight_locked(src, dst, installs, ts)
 
-    def _preflight_locked(self, src, dst, installs) -> int:
+    def _normalize_installs(self, installs):
+        """Normalize install entries to ``(node, nbrs, ts_row|None)`` —
+        temporal streams accept (and require) a per-neighbor timestamp
+        row per install; non-temporal streams reject one."""
+        out = []
+        for entry in installs or ():
+            if len(entry) == 2:
+                node, nbrs = entry
+                ts_row = None
+            else:
+                node, nbrs, ts_row = entry
+            nbrs = np.asarray(nbrs, np.int64)
+            if ts_row is not None:
+                ts_row = np.asarray(ts_row, np.float32).reshape(-1)
+            out.append((int(node), nbrs, ts_row))
+        return out
+
+    def _check_ts(self, src, ts, installs) -> None:
+        """The temporal-arity contract, one place: a temporal stream
+        takes exactly one timestamp per edge (appends AND installs); a
+        non-temporal stream takes none."""
+        if self.temporal:
+            if src.size and (ts is None or ts.shape != src.shape):
+                raise ValueError(
+                    "temporal stream (edge_ts set) needs one timestamp "
+                    "per appended edge — stage with "
+                    "GraphDelta.add_edges(src, dst, ts=...)"
+                )
+            for node, nbrs, ts_row in installs:
+                if nbrs.size and (ts_row is None
+                                  or ts_row.shape[0] != nbrs.shape[0]):
+                    raise ValueError(
+                        f"temporal install for node {node} needs one "
+                        f"timestamp per neighbor"
+                    )
+        else:
+            if ts is not None or any(t is not None for _, _, t in installs):
+                raise ValueError(
+                    "edge timestamps staged into a non-temporal stream — "
+                    "build StreamingTiledGraph(edge_ts=...) to carry them"
+                )
+
+    def _preflight_locked(self, src, dst, installs, ts=None) -> int:
         if src.size:
             validate_edge_ids(src, dst, self.n)
+        self._check_ts(src, ts, installs)
         need = 0
         sim_alloc: Dict[int, int] = {}
         sim_deg: Dict[int, int] = {}
-        for node, nbrs in installs:
-            node = int(node)
-            nbrs = np.asarray(nbrs, np.int64)
+        for node, nbrs, _ts_row in installs:
             if not 0 <= node < self.n:
                 raise ValueError(
                     f"install node {node} outside [0, {self.n})"
@@ -611,27 +789,30 @@ class StreamingTiledGraph:
         src, dst = delta.edges() if delta is not None else (
             np.array([], np.int64), np.array([], np.int64)
         )
-        installs = list(installs or ())
+        ts = delta.edges_ts() if delta is not None else None
+        installs = self._normalize_installs(installs)
         if src.size == 0 and not installs:
             return {"edges": 0, "pad_writes": 0, "tile_spills": 0,
                     "installs": 0, "tile_rows_swapped": 0,
                     "bd_rows_swapped": 0, "free_rows": self.free_rows,
                     "version": self.version}
         with self._lock:
-            self._preflight_locked(src, dst, installs)
+            self._preflight_locked(src, dst, installs, ts)
             touched_tiles: set = set()
             touched_bd: set = set()
             pad_writes = spills = 0
-            for node, nbrs in installs:
-                self._install_locked(int(node), np.asarray(nbrs, np.int64),
-                                     touched_tiles, touched_bd)
+            for node, nbrs, ts_row in installs:
+                self._install_locked(node, nbrs, touched_tiles, touched_bd,
+                                     ts_row=ts_row)
             if src.size:
                 # adjacency bookkeeping feeds closures (ids validated by
                 # the preflight above)
-                self.adj.add_edges(src, dst)
-                for u, v in zip(src, dst):
-                    p, s = self._append_locked(int(u), int(v),
-                                               touched_tiles, touched_bd)
+                self.adj.add_edges(src, dst, ts=ts)
+                for i, (u, v) in enumerate(zip(src, dst)):
+                    p, s = self._append_locked(
+                        int(u), int(v), touched_tiles, touched_bd,
+                        ts=None if ts is None else float(ts[i]),
+                    )
                     pad_writes += p
                     spills += s
             self.version += 1
@@ -660,7 +841,8 @@ class StreamingTiledGraph:
         return self.apply(None, installs=rows)
 
     # ------------------------------------------------------- internals
-    def _append_locked(self, u: int, v: int, touched_tiles, touched_bd):
+    def _append_locked(self, u: int, v: int, touched_tiles, touched_bd,
+                       ts: Optional[float] = None):
         base = int(self.bd[u, 0])
         deg = int(self.bd[u, 1])
         cap = int(self.alloc_rows[u]) * LANE
@@ -670,6 +852,10 @@ class StreamingTiledGraph:
             spilled = 1
         row = base + deg // LANE
         self.tiles[row, deg % LANE] = v
+        if self.ttiles is not None:
+            # the timestamp lands in the SAME (row, lane) as the edge —
+            # one commit makes both drawable (arity checked by preflight)
+            self.ttiles[row, deg % LANE] = ts
         self.bd[u, 1] = deg + 1
         touched_tiles.add(row)
         touched_bd.add(u)
@@ -695,13 +881,18 @@ class StreamingTiledGraph:
             self.tiles[new_base:new_base + old_rows] = (
                 self.tiles[old_base:old_base + old_rows]
             )
+            if self.ttiles is not None:
+                self.ttiles[new_base:new_base + old_rows] = (
+                    self.ttiles[old_base:old_base + old_rows]
+                )
         touched_tiles.update(range(new_base, new_base + old_rows + 1))
         self.bd[u, 0] = new_base
         self.alloc_rows[u] = need
         return new_base
 
     def _install_locked(self, node: int, nbrs: np.ndarray, touched_tiles,
-                        touched_bd) -> None:
+                        touched_bd, ts_row: Optional[np.ndarray] = None,
+                        ) -> None:
         if not 0 <= node < self.n:
             raise ValueError(f"install node {node} outside [0, {self.n})")
         if int(self.bd[node, 1]) != 0:
@@ -723,6 +914,10 @@ class StreamingTiledGraph:
         flat = self.tiles[base:base + need].reshape(-1)
         flat[: nbrs.size] = nbrs.astype(self.tiles.dtype)
         flat[nbrs.size:] = 0
+        if self.ttiles is not None:
+            tflat = self.ttiles[base:base + need].reshape(-1)
+            tflat[: nbrs.size] = ts_row
+            tflat[nbrs.size:] = 0
         self.bd[node, 0] = base
         self.bd[node, 1] = nbrs.size
         self.alloc_rows[node] = need
@@ -731,6 +926,8 @@ class StreamingTiledGraph:
         # bookkeeping: an installed row's neighbors enter the adjacency
         # view as "extras" over its empty base row (same lane order)
         self.adj._extra[node] = [int(x) for x in nbrs]
+        if self.ttiles is not None:
+            self.adj._extra_ts[node] = [float(x) for x in ts_row]
         for v in nbrs:
             self.adj._rev_extra.setdefault(int(v), []).append(node)
         self.adj._n_extra += int(nbrs.size)
@@ -748,6 +945,13 @@ class StreamingTiledGraph:
             self._tiles_dev = _scatter_rows(
                 self._tiles_dev, jnp.asarray(pos), jnp.asarray(rows)
             )
+            if self._tt_dev is not None:
+                # the timestamp payload swaps the SAME touched rows in the
+                # same commit — a draw can never see an edge without its ts
+                tpos, trows = _bucketed(idx, self.ttiles[idx], self.m_cap)
+                self._tt_dev = _scatter_rows(
+                    self._tt_dev, jnp.asarray(tpos), jnp.asarray(trows)
+                )
         if n_bd:
             idx = np.fromiter(touched_bd, np.int64, n_bd)
             idx.sort()

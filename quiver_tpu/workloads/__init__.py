@@ -1,0 +1,58 @@
+"""quiver_tpu.workloads — temporal & link-prediction serving (round 19).
+
+The workloads subsystem opens the two workloads production graph systems
+actually run on top of the tiled sampler and the rounds-8-18 serving
+stack, reusing every layer:
+
+- **Temporal neighbor sampling** (feed ranking): per-edge timestamps ride
+  the tile-map payload lanes exactly like the round-5 edge weights;
+  `temporal_sample_layer` is a masked tiled draw ("sample edges with
+  ``ts <= t``", recency-biased through the weighted sampler's Gumbel
+  machinery), bit-replayable and pinned against a host-masked oracle —
+  and at ``t = inf`` it IS the frozen weighted sampler, bit for bit.
+  `TemporalServeEngine` serves it one-dispatch (the query time is a jit
+  argument of the sealed bucket executables), with ``(node, t_bucket,
+  params_version)`` cache keys; bound to a
+  `stream.StreamingTiledGraph(edge_ts=...)`, an edge that arrives is
+  visible to the next ``t >= ts`` query and invisible below it.
+- **Link-prediction serving** (retrieval): ``submit_pair(u, v, t=)`` on
+  both engines — two seed lookups through the shared coalescer/cache
+  (split-owner pairs become two sub-batches through
+  `comm.exchange_serve`, query times bitcast beside the ids) scored by a
+  seeded `PairHead` (dot or MLP), one jitted head dispatch per batch.
+
+See docs/api.md "Temporal & link-prediction serving" for the contract,
+`serve.trace_gen.temporal_trace`/`lp_trace` for seeded drive traffic, and
+``scripts/serve_probe.py --temporal`` (WORKLOAD_r01.json) for the proof
+bar.
+"""
+
+from .linkpred import LinkPredictor, PairHead, PairResult
+from .serving import (
+    TemporalDistServeEngine,
+    TemporalServeEngine,
+    quantize_t,
+    replay_temporal_fleet_oracle,
+    replay_temporal_log,
+)
+from .temporal import (
+    TemporalTiledGraph,
+    host_masked_oracle,
+    temporal_sample_dense,
+    temporal_sample_layer,
+)
+
+__all__ = [
+    "LinkPredictor",
+    "PairHead",
+    "PairResult",
+    "TemporalDistServeEngine",
+    "TemporalServeEngine",
+    "TemporalTiledGraph",
+    "host_masked_oracle",
+    "quantize_t",
+    "replay_temporal_fleet_oracle",
+    "replay_temporal_log",
+    "temporal_sample_dense",
+    "temporal_sample_layer",
+]

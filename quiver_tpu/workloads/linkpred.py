@@ -1,0 +1,201 @@
+"""Link-prediction serving — the retrieval workload (ROADMAP item 4,
+round 19).
+
+Scoring a candidate edge ``(u, v)`` is two node computations plus a tiny
+head: the endpoint embeddings ride the EXISTING serve path — submitted
+through the same coalescer, cache, micro-batcher, and (on the routed
+engine) the same owner exchange as any node-classification request — and
+the head combines the two logits rows deterministically. That sharing is
+the design point, not an economy: a pair whose endpoints are hot costs
+ZERO device work (two cache hits + one head), a pair sharing an endpoint
+with an in-flight request coalesces onto it, and a pair whose endpoints
+live on different owners becomes two sub-batches through
+`comm.exchange_serve` — the split-owner shape the exchange had never
+carried before this round. Fusing the head INTO the bucket programs was
+considered and rejected: it would bind each pair's two endpoints into one
+flush (killing cross-pair coalescing) and bypass the embedding cache for
+half the workload; instead `predict_pairs` scores every completed pair of
+a batch in ONE jitted head dispatch.
+
+Bit discipline: endpoint rows are served rows like any other — the replay
+oracles vouch for them — and `PairHead` is a pure seeded function of the
+two rows, so a pair score is replayable from the dispatch logs alone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..comm import round_up_pow2
+
+__all__ = ["LinkPredictor", "PairHead", "PairResult"]
+
+
+class PairHead:
+    """The pair scoring head: ``score(h_u, h_v) -> [P]`` probabilities.
+
+    ``mode="dot"``: ``sigmoid(<h_u, h_v>)`` — parameter-free, the
+    retrieval default. ``mode="mlp"``: a seeded 2-layer scorer over
+    ``[h_u, h_v, h_u*h_v]`` (params drawn once from ``seed`` at
+    construction; ``dim`` = the serve engine's out_dim). Batched scoring
+    runs as ONE jitted program per pow2-padded batch shape, so a scored
+    batch costs one dispatch regardless of pair count and the compile
+    count stays logarithmic in batch size. Deterministic: same rows +
+    same (mode, dim, hidden, seed) -> bit-identical scores."""
+
+    def __init__(self, mode: str = "dot", dim: Optional[int] = None,
+                 hidden: int = 32, seed: int = 0):
+        if mode not in ("dot", "mlp"):
+            raise ValueError(f"unknown PairHead mode {mode!r}")
+        self.mode = mode
+        self.dim = None if dim is None else int(dim)
+        self.hidden = int(hidden)
+        self.seed = int(seed)
+        self.params = None
+        if mode == "mlp":
+            if dim is None:
+                raise ValueError("PairHead('mlp') needs dim= (engine out_dim)")
+            k1, k2 = jax.random.split(jax.random.key(self.seed))
+            d_in = 3 * self.dim
+            # He-ish init, fully determined by the seed
+            self.params = {
+                "w1": jax.random.normal(k1, (d_in, self.hidden), jnp.float32)
+                / np.float32(np.sqrt(d_in)),
+                "b1": jnp.zeros((self.hidden,), jnp.float32),
+                "w2": jax.random.normal(k2, (self.hidden, 1), jnp.float32)
+                / np.float32(np.sqrt(self.hidden)),
+                "b2": jnp.zeros((1,), jnp.float32),
+            }
+        if mode == "dot":
+            def fn(params, hu, hv):
+                return jax.nn.sigmoid(jnp.sum(hu * hv, axis=-1))
+        else:
+            def fn(params, hu, hv):
+                x = jnp.concatenate([hu, hv, hu * hv], axis=-1)
+                h = jax.nn.relu(x @ params["w1"] + params["b1"])
+                return jax.nn.sigmoid((h @ params["w2"] + params["b2"]))[:, 0]
+
+        self._apply = jax.jit(fn)
+
+    def score(self, h_u, h_v) -> np.ndarray:
+        """``[P]`` float32 scores for stacked endpoint rows ``[P, C]`` —
+        one jitted dispatch at the pow2-padded batch shape (pad rows are
+        zeros; their scores are computed and discarded)."""
+        h_u = np.asarray(h_u, np.float32)
+        h_v = np.asarray(h_v, np.float32)
+        if h_u.shape != h_v.shape or h_u.ndim != 2:
+            raise ValueError(
+                f"PairHead.score wants matched [P, C] rows; got "
+                f"{h_u.shape} / {h_v.shape}"
+            )
+        p = h_u.shape[0]
+        if p == 0:
+            return np.zeros((0,), np.float32)
+        cap = round_up_pow2(p, floor=1)
+        if cap != p:
+            pad = np.zeros((cap - p, h_u.shape[1]), np.float32)
+            h_u = np.concatenate([h_u, pad])
+            h_v = np.concatenate([h_v, pad])
+        return np.asarray(self._apply(self.params, h_u, h_v))[:p]
+
+
+class PairResult:
+    """Handle for one submitted ``(u, v)`` pair: wraps the two endpoint
+    `ServeResult`s and scores them through the head on demand. The
+    endpoint rows stay inspectable (`rows()`) — that is what the parity
+    legs compare against the replay oracles; the score is a pure function
+    of them."""
+
+    __slots__ = ("_u", "_v", "_head")
+
+    def __init__(self, u_result, v_result, head: PairHead):
+        self._u = u_result
+        self._v = v_result
+        self._head = head
+
+    def done(self) -> bool:
+        return self._u.done() and self._v.done()
+
+    def error(self) -> Optional[BaseException]:
+        """The first endpoint error, if any (a pair fails iff one of its
+        endpoint computations failed — per-request isolation carries
+        through)."""
+        return self._u.error() or self._v.error()
+
+    def rows(self, timeout: Optional[float] = None
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """The two endpoint logits rows (blocks; raises an endpoint's
+        error). Read-only — shared with the cache and co-waiters."""
+        return self._u.result(timeout), self._v.result(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> float:
+        """The pair score."""
+        hu, hv = self.rows(timeout)
+        return float(self._head.score(hu[None], hv[None])[0])
+
+
+class LinkPredictor:
+    """Pair-serving facade over ANY serve engine (plain `ServeEngine` /
+    `DistServeEngine`, or their temporal variants): ``submit_pair`` routes
+    both endpoints through the engine's normal submit path (shared
+    coalescer/cache/exchange), ``predict_pairs`` scores a whole batch
+    with one jitted head dispatch. Temporal engines take a per-pair
+    ``t`` (both endpoints are looked up as of the same query time);
+    plain engines reject one."""
+
+    def __init__(self, engine, head: Optional[PairHead] = None):
+        self.engine = engine
+        self.head = head or PairHead("dot")
+        self._temporal = hasattr(engine, "t_quantum")
+
+    def submit_pair(self, u: int, v: int, t: Optional[float] = None,
+                    tenant: Optional[str] = None) -> PairResult:
+        if self._temporal:
+            hu = self.engine.submit(int(u), t=t, tenant=tenant)
+            hv = self.engine.submit(int(v), t=t, tenant=tenant)
+        else:
+            if t is not None:
+                raise TypeError(
+                    "t= needs a temporal engine (workloads."
+                    "TemporalServeEngine / TemporalDistServeEngine)"
+                )
+            hu = self.engine.submit(int(u), tenant=tenant)
+            hv = self.engine.submit(int(v), tenant=tenant)
+        return PairResult(hu, hv, self.head)
+
+    def predict_pairs(self, pairs, t=None, timeout: Optional[float] = None,
+                      tenants=None) -> np.ndarray:
+        """Scores for ``[P, 2]`` pairs, request order. ``t`` scalar or
+        ``[P]`` (temporal engines). Blocking; drives inline flushes when
+        no background pollers run (the `predict` convention)."""
+        pairs = np.asarray(pairs, np.int64).reshape(-1, 2)
+        p = pairs.shape[0]
+        tv = None
+        if t is not None:
+            tv = np.asarray(t, np.float64).reshape(-1)
+            if tv.shape[0] == 1 and p != 1:
+                tv = np.broadcast_to(tv, (p,))
+            if tv.shape[0] != p:
+                raise ValueError(f"t has {tv.shape[0]} entries for {p} pairs")
+        handles = [
+            self.submit_pair(
+                u, v,
+                t=None if tv is None else float(tv[i]),
+                tenant=None if tenants is None else tenants[i],
+            )
+            for i, (u, v) in enumerate(pairs)
+        ]
+        if not handles:
+            return np.zeros((0,), np.float32)
+        eng = self.engine
+        if not getattr(eng, "_running", False):
+            while any(not h.done() for h in handles) and eng._drainable():
+                eng.flush()
+        hu = np.stack([h._u.result(timeout) for h in handles])
+        hv = np.stack([h._v.result(timeout) for h in handles])
+        return self.head.score(hu, hv)

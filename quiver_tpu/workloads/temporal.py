@@ -1,0 +1,235 @@
+"""Temporal neighbor sampling — the feed-ranking workload over the tiled
+sampler (ROADMAP item 4, round 19).
+
+The tile map has carried per-edge payloads since round 5 (weights ride in a
+second tile table sharing the tile map, 32-74x faster than the flat lane
+window) — timestamps are the SAME trick: `TemporalTiledGraph` lays the
+edge-arrival times out with `ops.sample.build_tiled_host` over the same
+``(base, deg)`` map, and a temporal draw (`temporal_sample_layer` =
+`ops.sample.tiled_temporal_sample_layer`) is a masked tiled draw: fetch the
+timestamp window exactly like the weighted sampler fetches its weight
+window, zero the weight of every edge with ``ts > t``, and hand the rest to
+the SAME Gumbel top-k (`gumbel_topk_positions`) — recency-biased by
+``exp(recency * ts)`` (`ops.sample.temporal_edge_weights`), uniform at
+``recency=0``.
+
+Parity discipline (pinned in tests/test_temporal.py and asserted in-run by
+``serve_probe --temporal``):
+
+- **host-masked oracle** — `host_masked_oracle` builds the per-seed
+  neighbor/timestamp windows straight from the host CSR (no tiles), weights
+  them through the byte-for-byte same `temporal_weight_rows`, and draws with
+  the same Gumbel machinery on the same key: a temporal tile draw is
+  bit-equal to it, which pins the whole tile fetch/resolve path.
+- **frozen == temporal-at-t=inf** — at ``t = +inf`` the mask passes every
+  edge, so a temporal draw IS `tiled_weighted_sample_layer` over the weight
+  tiles `TemporalTiledGraph.recency_wtiles` builds (same device exp on the
+  same payload), bit for bit: the temporal sampler degenerates to the
+  existing frozen weighted sampler exactly, the way a streamed sampler
+  degenerates to a frozen one on an empty delta.
+- **bit-replayable** — `temporal_sample_dense` splits its key per hop
+  exactly like `sample_dense_fused`; a dispatch-log replay through a twin
+  sampler at the logged ``(seeds, t)`` reproduces every served bit.
+
+Multi-hop temporal sampling threads each SEED's own query time down its
+frontier lineage: the structural no-dedup layout (`sample_dense_fused`)
+keeps per-seed lineage explicit (neighbor (i, j) of frontier slot i sits at
+``W + j*W + i``), so the hop-l frontier's query-time vector is
+``concat([t, tile(t, k)])`` — per-request t with ZERO extra gathers. A
+dedup reindex would merge frontiers across requests with different query
+times, which is why `GraphSageSampler.bind_temporal` requires
+``dedup=False``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.sample import (
+    LANE,
+    build_tiled_host,
+    gumbel_topk_positions,
+    temporal_edge_weights,
+    temporal_weight_rows,
+    tiled_temporal_sample_layer,
+)
+from ..pyg.sage_sampler import DenseAdj, DenseSample
+
+# the public op name the ISSUE/ROADMAP use; the implementation lives with
+# its siblings in ops/sample.py
+temporal_sample_layer = tiled_temporal_sample_layer
+
+__all__ = [
+    "TemporalTiledGraph",
+    "host_masked_oracle",
+    "temporal_sample_dense",
+    "temporal_sample_layer",
+]
+
+
+class TemporalTiledGraph:
+    """A FROZEN graph with per-edge timestamps in the tile payload lanes:
+    ``(bd, tiles, ttiles)`` device arrays sharing one tile map —
+    `GraphSageSampler.bind_temporal`'s frozen source (the streaming source
+    is a `stream.StreamingTiledGraph` built with ``edge_ts=``; both answer
+    the same `temporal_graph()` surface).
+
+    ``edge_ts`` aligns with ``csr_topo.indices`` (one float32 arrival time
+    per edge). Keep ``recency * ts`` inside float32 exp range — see
+    `ops.sample.temporal_edge_weights`."""
+
+    temporal = True  # the bind_temporal duck-type marker
+
+    def __init__(self, csr_topo, edge_ts, id_dtype=None, device=None):
+        from ..utils import _best_id_dtype
+
+        self.csr_topo = csr_topo
+        indptr = np.asarray(csr_topo.indptr, np.int64)
+        indices = np.asarray(csr_topo.indices, np.int64)
+        self.n = indptr.shape[0] - 1
+        self.edge_ts = np.asarray(edge_ts, np.float32).reshape(-1)
+        if self.edge_ts.shape[0] != indices.shape[0]:
+            raise ValueError(
+                f"edge_ts has {self.edge_ts.shape[0]} entries for "
+                f"{indices.shape[0]} edges"
+            )
+        if id_dtype is None:
+            id_dtype = _best_id_dtype(self.n + 1)
+        bd, tiles = build_tiled_host(indptr, indices, id_dtype)
+        _, ttiles = build_tiled_host(indptr, self.edge_ts, np.float32)
+        self._bd = jax.device_put(bd, device)
+        self._tiles = jax.device_put(tiles, device)
+        self._ttiles = jax.device_put(ttiles, device)
+
+    def temporal_graph(self):
+        """The device ``(bd, tiles, ttiles)`` triple a temporal draw
+        reads (frozen: the same arrays forever)."""
+        return self._bd, self._tiles, self._ttiles
+
+    def recency_wtiles(self, recency: float) -> jax.Array:
+        """The weight tiles a temporal draw degenerates to at ``t=inf``:
+        `temporal_edge_weights` applied to the timestamp tiles ON DEVICE
+        (the same elementwise exp the masked draw computes post-fetch, so
+        `tiled_weighted_sample_layer` over these is BIT-EQUAL to
+        `temporal_sample_layer` at infinite t — the frozen-graph parity
+        pin)."""
+        return _recency_wtiles_jit(self._ttiles, float(recency))
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("recency",))
+def _recency_wtiles_jit(ttiles, recency):
+    return temporal_edge_weights(ttiles, recency)
+
+
+def temporal_sample_dense(
+    graph: Tuple[jax.Array, jax.Array, jax.Array],
+    key: jax.Array,
+    seeds: jax.Array,
+    t_seed: jax.Array,
+    sizes: Tuple[int, ...],
+    recency: float = 0.0,
+    max_deg: int = 512,
+) -> DenseSample:
+    """Fused multi-hop TEMPORAL sample — `sample_dense_fused` with each
+    seed's query time threaded down its frontier lineage.
+
+    ``t_seed`` is ``[B]`` float32 per-seed query times (a traced value:
+    one compiled program serves every t). Hop l's frontier inherits its
+    originating seed's t through the structural layout (neighbor (i, j)
+    lands at ``W + j*W + i``, so the frontier t-vector is
+    ``concat([t, tile(t, k)])``), and every hop draws only edges with
+    ``ts <= t`` of the EXPANDING node's request — the temporal-correctness
+    contract: a feed query at time t never sees an edge from its future,
+    at any depth. Key splits match `sample_dense_fused` hop for hop, so
+    the draw is bit-replayable from ``(key, seeds, t_seed)``."""
+    bd, tiles, ttiles = graph
+    B = seeds.shape[0]
+    cur = seeds
+    cur_valid = jnp.ones((B,), bool)
+    cur_t = t_seed.astype(jnp.float32)
+    adjs: List[DenseAdj] = []
+    prev_count = jnp.asarray(B, jnp.int32)
+    for k in sizes:
+        key, sub = jax.random.split(key)
+        w = cur.shape[0]
+        nbrs, valid = tiled_temporal_sample_layer(
+            bd, tiles, ttiles, cur, cur_valid, k, sub, cur_t,
+            max_deg=max_deg, recency=recency,
+        )
+        # transposed flatten (the structural layout, see
+        # sample_dense_fused): neighbor (i, j) -> position w + j*w + i,
+        # so its query time is cur_t[i] -> tile(cur_t, k)
+        n_id = jnp.concatenate([cur, nbrs.T.reshape(-1)])
+        n_valid = jnp.concatenate([cur_valid, valid.T.reshape(-1)])
+        n_t = jnp.concatenate([cur_t, jnp.tile(cur_t, k)])
+        count = n_valid.sum().astype(jnp.int32)
+        adjs.append(
+            DenseAdj(cols=None, mask=valid, n_src=count, n_dst=prev_count)
+        )
+        cur, cur_valid, cur_t, prev_count = n_id, n_valid, n_t, count
+    return DenseSample(
+        n_id=cur, count=prev_count, batch_size=B, adjs=tuple(adjs[::-1])
+    )
+
+
+def host_masked_oracle(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    edge_ts: np.ndarray,
+    seeds: np.ndarray,
+    seed_valid: np.ndarray,
+    k: int,
+    key: jax.Array,
+    t: np.ndarray,
+    max_deg: int = 512,
+    recency: float = 0.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The HOST-MASKED parity oracle for one temporal hop: build each
+    seed's neighbor/timestamp windows directly from the host CSR slices
+    (no tile map anywhere), mask/weight them through the byte-for-byte
+    same `temporal_weight_rows`, and draw with the same
+    `gumbel_topk_positions` on the same key. `tiled_temporal_sample_layer`
+    must return bit-identical ``(nbrs, valid)`` — that equality pins the
+    whole tile path (payload-lane layout, k-split window fetch, affine
+    resolve) against first-principles masking, which is the acceptance
+    pin ``serve_probe --temporal`` asserts in-run.
+
+    Window width is the tiled layer's ``ceil(max_deg/128)*128`` (the
+    Gumbel draw's uniform-sample shape must match for bit equality);
+    lanes beyond a row's clamped degree carry garbage on both sides and
+    are masked to ``-inf`` before the top-k, so they never influence a
+    drawn bit."""
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices, np.int64)
+    edge_ts = np.asarray(edge_ts, np.float32)
+    seeds = np.asarray(seeds, np.int64)
+    seed_valid = np.asarray(seed_valid, bool)
+    n = indptr.shape[0] - 1
+    B = seeds.shape[0]
+    W = -(-max_deg // LANE) * LANE
+    nbr_win = np.zeros((B, W), np.int64)
+    ts_win = np.zeros((B, W), np.float32)
+    deg = np.zeros((B,), np.int32)
+    for b in range(B):
+        node = int(np.clip(seeds[b], 0, n - 1))
+        d = int(indptr[node + 1] - indptr[node]) if seed_valid[b] else 0
+        d = min(d, max_deg)
+        lo = indptr[node]
+        nbr_win[b, :d] = indices[lo:lo + d]
+        ts_win[b, :d] = edge_ts[lo:lo + d]
+        deg[b] = d
+    w_rows = temporal_weight_rows(
+        jnp.asarray(ts_win), jnp.asarray(np.asarray(t, np.float32)), recency
+    )
+    pos, valid = gumbel_topk_positions(key, jnp.asarray(deg), k, w_rows)
+    pos_np = np.asarray(pos)
+    nbrs = np.take_along_axis(nbr_win, np.clip(pos_np, 0, W - 1), axis=1)
+    return nbrs, np.asarray(valid)

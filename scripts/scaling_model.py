@@ -74,6 +74,16 @@ def main():
                          "bench stream_swap_s)")
     ap.add_argument("--stream-commit-s", type=float, default=1.0,
                     help="commit period for the ingest table")
+    # round-19 link-prediction pricing (lp_table): measured fused
+    # temporal step + per-pair head costs from bench.py's workloads leg
+    # (context temporal_step_s / lp_head_s, picked up via --bench)
+    ap.add_argument("--lp-step-ms", type=float, default=None,
+                    help="fused temporal serve-step cost at --lp-ref-batch "
+                         "(ms; bench temporal_step_s)")
+    ap.add_argument("--lp-ref-batch", type=int, default=64)
+    ap.add_argument("--lp-head-us", type=float, default=None,
+                    help="pair scoring-head cost per pair (us; bench "
+                         "lp_head_s)")
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
 
@@ -107,6 +117,10 @@ def main():
         if (args.stream_swap_ms is None
                 and ctx.get("stream_swap_s") is not None):
             args.stream_swap_ms = ctx["stream_swap_s"] * 1e3
+        if args.lp_step_ms is None and ctx.get("temporal_step_s") is not None:
+            args.lp_step_ms = ctx["temporal_step_s"] * 1e3
+        if args.lp_head_us is None and ctx.get("lp_head_s") is not None:
+            args.lp_head_us = ctx["lp_head_s"] * 1e6
     if not step_s:
         step_s = 0.0415  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
         source = "PERF_NOTES.md round-4 default 41.5 ms"
@@ -116,11 +130,13 @@ def main():
         delta_table,
         format_delta_markdown,
         format_fetch_markdown,
+        format_lp_markdown,
         format_markdown,
         format_quant_markdown,
         format_serve_markdown,
         format_skew_markdown,
         format_tier_markdown,
+        lp_table,
         products_scaling_table,
         quant_fetch_table,
         serve_table,
@@ -416,6 +432,32 @@ def main():
         "invalidation counts).\n\n"
         + format_delta_markdown(delta_rows)
     )
+    # -- round-19: link-prediction pricing (lp_table) --------------------
+    lp_step_s = (2e-3 if args.lp_step_ms is None else args.lp_step_ms / 1e3)
+    lp_head_s = (1e-6 if args.lp_head_us is None else args.lp_head_us / 1e6)
+    if args.lp_step_ms is not None and args.lp_head_us is not None:
+        lp_source = "measured bench temporal_step_s/lp_head_s"
+    elif args.lp_step_ms is None and args.lp_head_us is None:
+        lp_source = (
+            "analytic placeholder costs (pass --bench or "
+            "--lp-step-ms/--lp-head-us)"
+        )
+    else:
+        lp_source = (
+            "partially measured — pass both --lp-step-ms and "
+            "--lp-head-us (or --bench) for a fully measured table"
+        )
+    lp_rows = lp_table(
+        lp_step_s, args.lp_ref_batch, head_s_per_pair=lp_head_s,
+    )
+    lp_md = (
+        "## Link-prediction serving: pair-QPS vs node-QPS (round 19)\n\n"
+        f"Cost source: {lp_source} (ref batch {args.lp_ref_batch}).\n"
+        "Measured counterpart: scripts/serve_probe.py --temporal -> "
+        "WORKLOAD_r01.json\n(split-owner pairs through the exchange, "
+        "temporal oracle parity in-run).\n\n"
+        + format_lp_markdown(lp_rows)
+    )
     print(md, file=sys.stderr)
     print("\n" + fetch_md, file=sys.stderr)
     print("\n" + quant_md, file=sys.stderr)
@@ -424,6 +466,7 @@ def main():
     print("\n" + skew_md, file=sys.stderr)
     print("\n" + tier_md, file=sys.stderr)
     print("\n" + delta_md, file=sys.stderr)
+    print("\n" + lp_md, file=sys.stderr)
     if args.out:
         header = (
             "# Predicted multi-chip scaling (static model)\n\n"
@@ -439,7 +482,7 @@ def main():
                 header + md + "\n\n" + fetch_md + "\n\n" + quant_md
                 + "\n\n" + serve_md + "\n\n" + serve_dist_md
                 + "\n\n" + skew_md + "\n\n" + tier_md + "\n\n"
-                + delta_md + "\n"
+                + delta_md + "\n\n" + lp_md + "\n"
             )
     print(json.dumps({
         "step_s_1chip": step_s,
@@ -461,6 +504,8 @@ def main():
         "skew_replication": [r._asdict() for r in skew_rows],
         "delta_source": delta_source,
         "delta_table": [r._asdict() for r in delta_rows],
+        "lp_source": lp_source,
+        "lp_table": [r._asdict() for r in lp_rows],
     }))
 
 

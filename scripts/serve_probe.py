@@ -238,6 +238,21 @@ def main():
                     help="hedge_deadline_ms sweep for the stall leg "
                          "(0 = no deadline)")
     ap.add_argument("--replicate-k", type=int, default=16)
+    ap.add_argument("--temporal", action="store_true",
+                    help="round-19 workloads leg -> WORKLOAD_r01.json: "
+                         "temporal draws vs the host-masked oracle, "
+                         "t=inf == frozen weighted engine, streamed-edge "
+                         "per-commit visibility, hosts=2 LP pairs "
+                         "through the exchange with temporal fleet "
+                         "oracle parity, observe-only journal/workload "
+                         "parity")
+    ap.add_argument("--temporal-requests", type=int, default=320)
+    ap.add_argument("--temporal-pairs", type=int, default=120)
+    ap.add_argument("--temporal-recency", type=float, default=0.02)
+    ap.add_argument("--temporal-quantum", type=float, default=0.05,
+                    help="t_quantum in query-time units (the Poisson "
+                         "clock runs at --temporal-qps)")
+    ap.add_argument("--temporal-qps", type=float, default=2000.0)
     ap.add_argument("--skew", action="store_true",
                     help="run the round-13 workload-skew leg instead of "
                          "the fused/split sweep (-> SERVE_r06.json)")
@@ -371,6 +386,371 @@ def main():
                     )
                     parity_rows += 1
         return dist, trace, wall, parity_rows
+
+    # -- round-19 workloads leg (--temporal -> WORKLOAD_r01.json) ------------
+    if args.temporal:
+        from quiver_tpu.ops.sample import (
+            tiled_temporal_sample_layer,
+            tiled_weighted_sample_layer,
+        )
+        from quiver_tpu.serve import lp_trace, temporal_trace
+        from quiver_tpu.stream import StreamingTiledGraph
+        from quiver_tpu.workloads import (
+            TemporalDistServeEngine,
+            TemporalServeEngine,
+            TemporalTiledGraph,
+            host_masked_oracle,
+            quantize_t,
+            replay_temporal_fleet_oracle,
+            replay_temporal_log,
+        )
+
+        REC, QUANT = args.temporal_recency, args.temporal_quantum
+        rng_t = np.random.default_rng(77)
+        E = topo.indices.shape[0]
+        base_ts = rng_t.uniform(0.0, 50.0, E).astype(np.float32)
+        T0 = 50.0  # queries start after every base edge
+        tg = TemporalTiledGraph(topo, base_ts)
+        MAXD = 512
+
+        # (a) LAYER PINS, asserted in-run over many draws: host-masked
+        # oracle bit-parity + the frozen degeneration (t=inf draws ==
+        # the existing weighted sampler over the recency weight tiles)
+        bd_d, tiles_d, tt_d = tg.temporal_graph()
+        oracle_rows = inf_rows = 0
+        for rep in range(4):
+            seeds = rng_t.integers(0, n, 64)
+            tvals = rng_t.uniform(0.0, 60.0, 64).astype(np.float32)
+            key = jax.random.fold_in(jax.random.key(13), rep)
+            nb, vl = tiled_temporal_sample_layer(
+                bd_d, tiles_d, tt_d, jnp.asarray(seeds),
+                jnp.ones((64,), bool), 8, key, jnp.asarray(tvals),
+                max_deg=MAXD, recency=REC,
+            )
+            onb, ovl = host_masked_oracle(
+                topo.indptr, topo.indices, base_ts, seeds,
+                np.ones(64, bool), 8, key, tvals, max_deg=MAXD,
+                recency=REC,
+            )
+            assert np.array_equal(np.asarray(vl), ovl), "ORACLE VALID MISMATCH"
+            assert np.array_equal(
+                np.asarray(nb)[np.asarray(vl)], onb[ovl]
+            ), "ORACLE DRAW MISMATCH"
+            oracle_rows += int(np.asarray(vl).sum())
+            wnb, wvl = tiled_weighted_sample_layer(
+                bd_d, tiles_d, tg.recency_wtiles(REC), jnp.asarray(seeds),
+                jnp.ones((64,), bool), 8, key, max_deg=MAXD,
+            )
+            inb, ivl = tiled_temporal_sample_layer(
+                bd_d, tiles_d, tt_d, jnp.asarray(seeds),
+                jnp.ones((64,), bool), 8, key,
+                jnp.full((64,), np.inf, jnp.float32), max_deg=MAXD,
+                recency=REC,
+            )
+            assert np.array_equal(np.asarray(ivl), np.asarray(wvl))
+            assert np.array_equal(
+                np.asarray(inb)[np.asarray(ivl)],
+                np.asarray(wnb)[np.asarray(wvl)],
+            ), "T=INF != WEIGHTED DRAW"
+            inf_rows += int(np.asarray(ivl).sum())
+
+        # (b) ENGINE t=inf pin: a temporal engine (recency 0) queried at
+        # t=inf serves BIT-IDENTICAL logits + dispatch composition to
+        # the existing FROZEN weighted engine over unit weights — the
+        # frozen-graph run IS temporal-at-t=inf, at the serving grain
+        topo_w = CSRTopo(edge_index=edge_index,
+                         edge_weights=np.ones(edge_index.shape[1],
+                                              np.float32))
+        sw = GraphSageSampler(topo_w, sizes=SIZES, mode="TPU", seed=SEED,
+                              dedup=False, weighted=True, max_deg=MAXD)
+        eng_w = ServeEngine(
+            model, params, sw, feat,
+            ServeConfig(max_batch=args.max_batch,
+                        buckets=(8, args.max_batch), max_delay_ms=1e9,
+                        record_dispatches=True),
+        )
+        eng_w.warmup()
+        st0 = GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED,
+                               dedup=False, max_deg=MAXD)
+        st0.bind_temporal(TemporalTiledGraph(topo, base_ts), recency=0.0)
+        eng_t0 = TemporalServeEngine(
+            model, params, st0, feat,
+            ServeConfig(max_batch=args.max_batch,
+                        buckets=(8, args.max_batch), max_delay_ms=1e9,
+                        record_dispatches=True),
+            t_quantum=0.0,
+        )
+        eng_t0.warmup()
+        tr_inf = zipfian_trace(n, 160, alpha=1.1, seed=21)
+        rows_w = eng_w.predict(tr_inf, timeout=120)
+        rows_t = eng_t0.predict(tr_inf, t=np.inf, timeout=120)
+        assert np.array_equal(rows_w, rows_t), "T=INF ENGINE PARITY VIOLATION"
+        assert len(eng_w.dispatch_log) == len(eng_t0.dispatch_log)
+        for (pw, nw), (pt, nt, _tv) in zip(eng_w.dispatch_log,
+                                           eng_t0.dispatch_log):
+            assert nw == nt and np.array_equal(pw, pt)
+        inf_engine_rows = len(tr_inf)
+
+        # (c) OBSERVE-ONLY pin: journal + workload telemetry on changes
+        # no served bit (same trace, instrumented twin)
+        tt_trace = temporal_trace(
+            n, args.temporal_requests, alpha=1.1, seed=33,
+            qps=args.temporal_qps, t0=T0, edge_every=40,
+            edges_per_event=4,
+        )
+
+        def run_frozen(journal_events=0, workload=None):
+            s = GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED,
+                                 dedup=False, max_deg=MAXD)
+            s.bind_temporal(TemporalTiledGraph(topo, base_ts), recency=REC)
+            e = TemporalServeEngine(
+                model, params, s, feat,
+                ServeConfig(max_batch=args.max_batch,
+                            buckets=(8, args.max_batch), max_delay_ms=1e9,
+                            record_dispatches=True,
+                            journal_events=journal_events,
+                            workload=workload),
+                t_quantum=QUANT,
+            )
+            e.warmup()
+            rows = [
+                e.predict([ev[2]], t=ev[3])[0]
+                for ev in tt_trace.events() if ev[0] == "request"
+            ]
+            return e, rows
+
+        eng_plain, rows_plain = run_frozen()
+        eng_obs, rows_obs = run_frozen(
+            journal_events=args.journal_events,
+            workload=WorkloadConfig(topk=64),
+        )
+        assert all(np.array_equal(a, b)
+                   for a, b in zip(rows_plain, rows_obs)), \
+            "OBSERVE-ONLY VIOLATION (journal/workload changed bits)"
+        assert len(eng_plain.dispatch_log) == len(eng_obs.dispatch_log)
+        for (pa, na, ta), (pb, nb_, tb) in zip(eng_plain.dispatch_log,
+                                               eng_obs.dispatch_log):
+            assert na == nb_ and np.array_equal(pa, pb) \
+                and np.array_equal(ta, tb)
+
+        # single-host temporal replay parity against the twin oracle
+        def mk_temporal_full():
+            s = GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED,
+                                 dedup=False, max_deg=MAXD)
+            return s.bind_temporal(TemporalTiledGraph(topo, base_ts),
+                                   recency=REC)
+
+        oracle_f = replay_temporal_log(
+            eng_plain.dispatch_log, model, params, mk_temporal_full(), feat
+        )
+        req_list = [ev for ev in tt_trace.events() if ev[0] == "request"]
+        replay_rows = 0
+        for (_, _, node, tq), row in zip(req_list, rows_plain):
+            k = (int(node), float(np.float32(quantize_t(tq, QUANT))))
+            assert any(np.array_equal(row, c)
+                       for c in oracle_f.get(k, [])), \
+                f"TEMPORAL REPLAY VIOLATION at {k}"
+            replay_rows += 1
+
+        # (d) STREAMING leg: frozen == empty-delta commits, then LIVE
+        # timestamped appends with per-commit visibility at ts +/- eps
+        def make_stream_engine(reserve=0.5):
+            stream = StreamingTiledGraph(topo, reserve_frac=reserve,
+                                         edge_ts=base_ts)
+            s = GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED,
+                                 dedup=False, max_deg=MAXD)
+            s.bind_temporal(stream, recency=REC)
+            e = TemporalServeEngine(
+                model, params, s, feat,
+                ServeConfig(max_batch=args.max_batch,
+                            buckets=(8, args.max_batch), max_delay_ms=1e9,
+                            record_dispatches=True),
+                t_quantum=QUANT,
+            )
+            e.warmup()
+            return e, stream
+
+        from quiver_tpu.stream import GraphDelta
+
+        eng_es, _ = make_stream_engine()
+        rows_es = []
+        for ev in tt_trace.events():
+            if ev[0] == "edges":
+                s = eng_es.update_graph(GraphDelta())
+                assert s["edges"] == 0 and eng_es.graph_version == 0
+            else:
+                rows_es.append(eng_es.predict([ev[2]], t=ev[3])[0])
+        assert all(np.array_equal(a, b)
+                   for a, b in zip(rows_plain, rows_es)), \
+            "EMPTY-DELTA TEMPORAL PARITY VIOLATION"
+        empty_delta_rows = len(rows_es)
+
+        eng_live, stream_live = make_stream_engine()
+        commits = []
+        visibility_checked = dropped = 0
+        t_wall0 = time.perf_counter()
+        for ev in tt_trace.events():
+            if ev[0] == "edges":
+                eng_live.stage_edges(ev[1], ev[2], ts=ev[3])
+                s = eng_live.update_graph()
+                commits.append({
+                    "edges": s["edges"],
+                    "pad_writes": s["pad_writes"],
+                    "tile_spills": s["tile_spills"],
+                    "cache_invalidated": s["cache_invalidated"],
+                })
+                # the acceptance pin: the appended edge is INVISIBLE to
+                # a query at ts - eps and VISIBLE at ts + eps (copy-all
+                # draw at fanout >= current degree must include it)
+                u, v = int(ev[1][0]), int(ev[2][0])
+                ets = float(ev[3][0])
+                deg_u = stream_live.degree(u)
+                g = stream_live.temporal_graph()
+                for tq, want in ((ets - 1e-3, False), (ets + 1e-3, True)):
+                    nb, vl = tiled_temporal_sample_layer(
+                        g[0], g[1], g[2], jnp.asarray([u]),
+                        jnp.ones((1,), bool), deg_u,
+                        jax.random.key(9), jnp.asarray([tq], jnp.float32),
+                        max_deg=MAXD, recency=REC,
+                    )
+                    drawn = set(
+                        np.asarray(nb)[0][np.asarray(vl)[0]].tolist()
+                    )
+                    # v may pre-exist as an OLDER edge of u; only assert
+                    # the new arrival's effect when it is the only (u,v)
+                    if want:
+                        assert v in drawn, "VISIBILITY: edge not drawable"
+                    elif v in drawn:
+                        older = [
+                            w for w, et in zip(
+                                stream_live.neighbors(u),
+                                stream_live.adj.neighbors_ts(u),
+                            ) if w == v and et <= tq
+                        ]
+                        assert older, "VISIBILITY: future edge drawn"
+                visibility_checked += 2
+            else:
+                try:
+                    eng_live.predict([ev[2]], t=ev[3])
+                except Exception:
+                    dropped += 1
+        wall_live = time.perf_counter() - t_wall0
+        assert dropped == 0, f"{dropped} dropped temporal requests"
+        assert sum(c["cache_invalidated"] for c in commits) > 0
+
+        # (e) hosts=2 LP leg: split-owner pairs THROUGH the exchange
+        # (collective mode ships ids + bitcast query times), every
+        # completed endpoint row bit-matching the temporal fleet oracle,
+        # and the pair scores a pure function of those rows
+        dist = TemporalDistServeEngine.build(
+            model, params, topo, base_ts, feat, SIZES, hosts=2,
+            config=DistServeConfig(
+                hosts=2, max_batch=args.max_batch, max_delay_ms=1e9,
+                exchange="collective", record_dispatches=True,
+                shard_config=ServeConfig(
+                    max_batch=args.max_batch,
+                    buckets=(8, args.max_batch), max_delay_ms=1e9,
+                    record_dispatches=True,
+                ),
+            ),
+            sampler_seed=SEED, recency=REC, max_deg=MAXD,
+            t_quantum=QUANT,
+        )
+        dist.warmup()
+        lp = lp_trace(topo, args.temporal_pairs, alpha=1.1, seed=55,
+                      qps=args.temporal_qps, t0=T0)
+        owners = dist.global2host
+        split_owner_pairs = int(
+            (owners[lp.u] != owners[lp.v]).sum()
+        )
+        assert split_owner_pairs > 0, "trace has no split-owner pairs"
+        handles = [
+            dist.submit_pair(int(lp.u[i]), int(lp.v[i]),
+                             t=float(lp.t_query[i]))
+            for i in range(len(lp.u))
+        ]
+        while any(not h.done() for h in handles) and dist._drainable():
+            dist.flush()
+        scores = np.asarray([h.result(120) for h in handles], np.float32)
+        oracle_d = replay_temporal_fleet_oracle(
+            dist, model, params, mk_temporal_full, feat
+        )
+        lp_parity_rows = 0
+        for i, h in enumerate(handles):
+            hu, hv = h.rows()
+            for node, row in ((int(lp.u[i]), hu), (int(lp.v[i]), hv)):
+                k = (node, float(np.float32(
+                    quantize_t(float(lp.t_query[i]), QUANT)
+                )))
+                assert any(np.array_equal(row, c)
+                           for c in oracle_d.get(k, [])), \
+                    f"LP FLEET PARITY VIOLATION at {k}"
+                lp_parity_rows += 1
+            re_score = dist.pair_head.score(hu[None], hv[None])[0]
+            assert np.float32(re_score) == scores[i]
+        pos_scores = scores[lp.label == 1]
+        neg_scores = scores[lp.label == 0]
+
+        out = {
+            "metric": "serve_probe_temporal",
+            "git_revision": git_revision(),
+            "backend": jax.devices()[0].platform,
+            "config": {
+                "requests": args.temporal_requests,
+                "pairs": args.temporal_pairs, "alpha": 1.1,
+                "recency": REC, "t_quantum": QUANT,
+                "qps_clock": args.temporal_qps, "max_batch": args.max_batch,
+                "sizes": SIZES, "nodes": n, "max_deg": MAXD,
+            },
+            "note": (
+                "sequential deterministic drive (walls are 1-core "
+                "loopback, read the structure); every parity claim is "
+                "asserted in-run — a written artifact means they held: "
+                "host-masked oracle bit-parity, t=inf == frozen weighted "
+                "engine (draws AND served logits), observe-only "
+                "journal/workload, frozen == empty-delta commits, "
+                "per-commit ts+/-eps visibility, hosts=2 LP endpoint "
+                "rows == temporal fleet oracle"
+            ),
+            "layer_oracle_parity_draws": oracle_rows,
+            "layer_t_inf_weighted_parity_draws": inf_rows,
+            "engine_t_inf_parity_rows": inf_engine_rows,
+            "observe_only_parity_rows": len(rows_plain),
+            "single_host_replay_parity_rows": replay_rows,
+            "empty_delta_parity_rows": empty_delta_rows,
+            "streaming_live": {
+                "dropped_requests": dropped,
+                "commits": len(commits),
+                "delta_edges": eng_live.stats.delta_edges,
+                "tile_writes": eng_live.stats.delta_tile_writes,
+                "tile_spills": eng_live.stats.delta_tile_spills,
+                "cache_invalidated": (
+                    eng_live.stats.delta_cache_invalidated
+                ),
+                "visibility_checks": visibility_checked,
+                "reserve_report": stream_live.reserve_report(),
+                "qps": round(args.temporal_requests / wall_live, 1),
+            },
+            "lp_hosts2": {
+                "pairs": int(len(lp.u)),
+                "split_owner_pairs": split_owner_pairs,
+                "endpoint_parity_rows": lp_parity_rows,
+                "exchange_id_bytes": dist.stats.exchange_id_bytes,
+                "exchange_logit_bytes": dist.stats.exchange_logit_bytes,
+                "coalesced": dist.stats.coalesced,
+                "router_cache_hits": dist.stats.router_cache.hits,
+                "mean_pos_score": float(pos_scores.mean())
+                if pos_scores.size else None,
+                "mean_neg_score": float(neg_scores.mean())
+                if neg_scores.size else None,
+            },
+        }
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return
 
     # -- round-17 streaming-graph leg (--stream -> STREAM_r01.json) ----------
     if args.stream:
