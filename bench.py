@@ -1161,6 +1161,49 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
         context["serve_fused_step_error"] = repr(exc)
         log(f"serve fused step timing failed: {exc}")
 
+    # host submit path (round 20): scalar-loop vs batch `submit_many`
+    # admission cost, flushes deferred past the timed window and the
+    # cache off — the bench.py counterpart of scripts/bench_frontend.py
+    # (FRONTEND_r01.json), so a bench artifact alone carries the inputs
+    # that price `scaling.serve_table(host_submit_us=)`
+    try:
+        from quiver_tpu.serve.engine import abandon_undrained
+
+        htrace = zipfian_trace(n_nodes, 4096, alpha=0.99, seed=23)
+        hwalls = {}
+        for batched in (False, True):
+            heng = ServeEngine(
+                model, params, make_sampler(), table,
+                ServeConfig(max_batch=1 << 13, max_delay_ms=1e9,
+                            cache_entries=0),
+            )
+            t0 = time.time()
+            if batched:
+                heng.submit_many(htrace)
+            else:
+                for nid in htrace:
+                    heng.submit(int(nid))
+            hwalls[batched] = time.time() - t0
+            abandon_undrained(heng, drained=False)
+        context["host_submit_scalar_us"] = round(
+            hwalls[False] / htrace.shape[0] * 1e6, 3
+        )
+        context["host_submit_batch_us"] = round(
+            hwalls[True] / htrace.shape[0] * 1e6, 3
+        )
+        # the canonical key scaling_model.py --frontend reads from a
+        # FRONTEND artifact; same name here for a uniform pickup
+        context["host_submit_us"] = context["host_submit_batch_us"]
+        log(
+            f"host submit path @4096: scalar "
+            f"{context['host_submit_scalar_us']:.1f} us/req, batch "
+            f"{context['host_submit_batch_us']:.1f} us/req "
+            f"({hwalls[False] / max(hwalls[True], 1e-12):.1f}x)"
+        )
+    except Exception as exc:
+        context["host_submit_error"] = repr(exc)
+        log(f"host submit timing failed: {exc}")
+
     for alpha in (0.0, 0.99):
         for mif in (1, 2):
             eng = ServeEngine(

@@ -503,6 +503,10 @@ class ServePrediction(NamedTuple):
     # value-identical: zero overhead makes the call count irrelevant) --
     dispatches_per_flush: int = 1  # 1 = fused serve_step, 2 = split path
     overhead_s: float = 0.0        # fixed per-execute overhead paid each call
+    # -- host-path fields (round 20; default 0 = no host term, rows
+    # byte-identical to the round-11 model) --
+    host_submit_us: float = 0.0    # measured submit->seal host cost/request
+    host_qps_cap: float = math.inf # serial admission ceiling, 1e6/host_us
 
 
 def serve_table(
@@ -519,6 +523,7 @@ def serve_table(
     bandwidths: Optional[Dict[str, float]] = None,
     dispatches_per_flush: int = 1,
     dispatch_overhead_s: float = 0.0,
+    host_submit_us: float = 0.0,
 ) -> List[ServePrediction]:
     """Analytic QPS model for the online serving engine
     (`quiver_tpu.serve.ServeEngine`) from MEASURED per-batch costs.
@@ -574,6 +579,20 @@ def serve_table(
     price what the 2→1 cut buys at each bucket — the smaller the bucket,
     the more of its flush time was overhead, so the win concentrates
     exactly where latency-bound serving lives.
+
+    ``host_submit_us`` is the HOST-side submit→seal cost per request
+    (round 20): admission — cache/coalesce probe, shed decision, queue
+    insert, journal append — runs serially on the submit path, so it
+    caps sustainable throughput at ``1e6 / host_submit_us`` requests/s
+    no matter how fast the device retires dispatches. Feed the measured
+    batch-path number from ``scripts/bench_frontend.py``
+    (FRONTEND_r01.json ``host_submit_us``, or via ``scripts/
+    scaling_model.py --frontend``); the default 0 keeps every row
+    byte-identical to the round-11 model. Rows where the cap binds
+    (``qps == host_qps_cap`` below the device-bound ceiling) are
+    exactly the regimes the vectorized `submit_many` path exists for —
+    the scalar-path cost typically binds at high cache-hit rates, where
+    one dispatch retires many requests.
     """
     bw = dict(DEFAULT_BANDWIDTHS)
     if bandwidths:
@@ -599,10 +618,13 @@ def serve_table(
             xbytes = 0.0
             x_s = 0.0
         t_routed = t_dispatch + x_s
+        host_cap = (
+            1e6 / host_submit_us if host_submit_us > 0 else math.inf
+        )
         for h in hit_rates:
             miss = (1.0 - h) * unique_frac
             rpd = b / miss if miss > 0 else math.inf
-            qps = rpd / t_routed
+            qps = min(rpd / t_routed, host_cap)
             rows.append(
                 ServePrediction(
                     bucket=b,
@@ -621,6 +643,8 @@ def serve_table(
                     exchange_s=x_s,
                     dispatches_per_flush=dispatches_per_flush,
                     overhead_s=dispatch_overhead_s,
+                    host_submit_us=host_submit_us,
+                    host_qps_cap=host_cap,
                 )
             )
     return rows
@@ -670,6 +694,15 @@ def format_serve_markdown(rows: Sequence[ServePrediction]) -> str:
             "dispatch. Costs scale linearly from the measured reference batch "
             "(row-count-bound regime, PERF_NOTES.md); the serving engine's "
             "measured counterpart is scripts/serve_probe.py / bench.py serve."
+        )
+    hosted = [r for r in rows if getattr(r, "host_submit_us", 0.0) > 0]
+    if hosted:
+        hs = hosted[0].host_submit_us
+        lines.append(
+            f"Host submit path (round 20): {hs:.2f} us/request "
+            f"(submit→seal, scripts/bench_frontend.py) caps QPS at "
+            f"{1e6 / hs:.0f}/s per admission path; rows at that value "
+            "are host-bound, not device-bound."
         )
     return "\n".join(lines)
 

@@ -86,6 +86,50 @@ class EmbeddingCache:
                 wl.observe_cache(node_id, True)
             return value
 
+    def get_many(self, node_ids, version: int) -> list:
+        """Batch :meth:`get` (round 20): one lock hold for the whole
+        block, per-key outcomes/LRU touches identical to N scalar gets
+        in the same order, counters moved in bulk. When the cache is
+        EMPTY and untapped (the ``cache_entries=0`` serving config, or
+        any cache before its first resolve) the block short-circuits to
+        a single miss count — the vectorized probe the batch submit
+        fast path rides."""
+        out = [None] * len(node_ids)
+        wl = self.workload
+        hits = misses = evictions = 0
+        with self._lock:
+            d = self._entries
+            if not d and wl is None:
+                self.counters.miss(len(node_ids))
+                return out
+            for ix, node_id in enumerate(node_ids):
+                ent = d.get(node_id)
+                if ent is None:
+                    misses += 1
+                    if wl is not None:
+                        wl.observe_cache(node_id, False)
+                    continue
+                ver, value = ent
+                if ver != version:
+                    del d[node_id]
+                    evictions += 1
+                    misses += 1
+                    if wl is not None:
+                        wl.observe_cache(node_id, False)
+                    continue
+                d.move_to_end(node_id)
+                hits += 1
+                if wl is not None:
+                    wl.observe_cache(node_id, True)
+                out[ix] = value
+        if hits:
+            self.counters.hit(hits)
+        if misses:
+            self.counters.miss(misses)
+        if evictions:
+            self.counters.evict(evictions)
+        return out
+
     def put(self, node_id: Hashable, version: int, value: np.ndarray) -> None:
         if self.capacity == 0:
             return

@@ -111,9 +111,12 @@ from .engine import (
     ServeResult,
     ServeStats,
     ShedError,
+    _PendingStripes,
     _Slot,
+    _admit_chunk_fast,
     abandon_undrained,
     register_tenant_latency,
+    resolve_tenants,
     shed_decision,
     weighted_drain_keys,
 )
@@ -958,12 +961,19 @@ class _RoutedFlush:
     other slot resolves normally, and `flush()` does not re-raise."""
 
     __slots__ = ("keys", "slots", "split", "bucket", "error", "slot_errors",
-                 "fid", "tenants", "extra")
+                 "fid", "tenants", "extra", "ids", "rids", "tenant_ix")
 
     def __init__(self, keys, slots, split):
         self.keys = keys
         self.slots = slots
         self.split = split  # [(host, ids ndarray, positions ndarray)]
+        # array-native slot views (round 20, sealed — see _Flush): seed
+        # ids (int64), journal rids (int64, -1 = journal off) and wire
+        # tenant indices (int32, the collective's registry; -1 =
+        # unregistered tenant), aligned with ``slots``
+        self.ids = None
+        self.rids = None
+        self.tenant_ix = None
         self.bucket = 0
         self.error: Optional[BaseException] = None
         self.slot_errors: Dict[int, BaseException] = {}
@@ -1074,16 +1084,29 @@ class DistServeEngine:
             self.cache.workload = self.workload
         self.params_version = 0
         self.dispatch_log: List[Tuple[np.ndarray, List[Tuple[int, np.ndarray]]]] = []
-        self._pending: Dict[int, _Slot] = {}
+        # per-OWNER pending queues (round 20): the stripe hint is the
+        # BUILD-TIME ownership snapshot, deliberately NOT the live
+        # global2host — scale()/rebalance() mutate placement in place, and
+        # a key whose stripe moved mid-flight would dodge its own coalesce
+        # probe / pop. Routing always reads the live array at seal; the
+        # stripe is only a lock-contention partition, so staleness is free.
+        g2h_build = self.global2host.copy()
+        n_ids = g2h_build.shape[0]
+
+        def _stripe_hint(k, _g2h=g2h_build, _n=n_ids):
+            # temporal routers key by (node, t_bucket): stripe by the node
+            node = k[0] if type(k) is tuple else k
+            return int(_g2h[node]) if 0 <= node < _n else hash(k)
+
+        self._pending = _PendingStripes(self.hosts, stripe_key=_stripe_hint)
         self._inflight: Dict[int, _Slot] = {}
         import collections
 
         # round-15 fleet-policy state -------------------------------------
-        # per-tenant admission (guarded by _lock; mirrors ServeEngine).
-        # Policy logs are BOUNDED rings (newest win) — sustained overload
-        # or a long-dead owner is exactly when they fill, and an unbounded
-        # list there would leak until OOM
-        self._pending_tenant: Dict[str, int] = {}
+        # per-tenant admission rides the striped store's per-stripe counts
+        # (mirrors ServeEngine). Policy logs are BOUNDED rings (newest
+        # win) — sustained overload or a long-dead owner is exactly when
+        # they fill, and an unbounded list there would leak until OOM
         self.shed_log = collections.deque(maxlen=POLICY_LOG_CAP)
         # hot-set replica (swapped only under the update_params fence) +
         # the full-graph failover engine (built by `build` on request)
@@ -1459,60 +1482,137 @@ class DistServeEngine:
         apply to the rest. ``tenant`` drives the round-15 per-tenant
         admission exactly as on the single-host engine (weighted flush
         quotas, deterministic queue-depth shedding, per-tenant latency).
+        Round 20: `submit_many` of ONE, like `ServeEngine.submit`.
         KEEP IN LOCKSTEP with `ServeEngine.submit` — the hosts=1
         bit-parity contract depends on the two front ends making
         identical cache/coalesce decisions per request, and
         `test_shards1_bit_equal_single_host_engine` pins it."""
-        key = int(node_id)
-        if not 0 <= key < self.global2host.shape[0]:
-            raise ValueError(
-                f"node id {key} outside [0, {self.global2host.shape[0]})"
+        return self.submit_many((node_id,), tenant=tenant)[0]
+
+    def submit_many(self, node_ids, t=None,
+                    tenant=None) -> List[ServeResult]:
+        """Vectorized batch submit at the router (round 20, the
+        `ServeEngine.submit_many` twin): id-range validation is VECTORIZED
+        up front (the whole batch is rejected before any admission — the
+        one documented batch/scalar difference), then admission runs per
+        request in request order under one striped-lock hold per chunk,
+        with one batched journal append and inline flush at every fill —
+        so the router's dispatch log is bit-identical to N scalar
+        ``submit`` calls."""
+        if t is not None:
+            raise TypeError(
+                "t= is a temporal-serving argument (TemporalDistServeEngine);"
+                " this router serves untimed nodes"
             )
-        return self._submit_keyed(key, key, tenant)
+        ids = np.asarray(node_ids, dtype=np.int64).reshape(-1)
+        n_ids = self.global2host.shape[0]
+        bad = (ids < 0) | (ids >= n_ids)
+        if bad.any():
+            raise ValueError(
+                f"node id {int(ids[bad][0])} outside [0, {n_ids})"
+            )
+        keys = ids.tolist()
+        return self._submit_keyed_many(keys, keys, tenant)
+
+    def _submit_keyed_many(self, keys: List, nodes: List[int],
+                           tenant) -> List[ServeResult]:
+        """KEEP IN LOCKSTEP with `ServeEngine._submit_keyed_many` (the
+        router has no submit-time prefetch leg; its per-owner prefetch
+        runs at seal off the routed split)."""
+        n = len(keys)
+        tenants = resolve_tenants(tenant, n)
+        results: List[Optional[ServeResult]] = [None] * n
+        max_batch = self.config.max_batch
+        jr = self.journal
+        i = 0
+        while i < n:
+            events: List[Tuple] = []
+            need_flush = False
+            now = self._clock()
+            with self._pending.all_locks():
+                if (self.workload is None
+                        and self.config.max_queue_depth == 0):
+                    # round-20 vectorized chunk admission, shared with
+                    # the single-host engine (`_admit_chunk_fast`):
+                    # the router's per-owner stripes and late-admission
+                    # window behave identically under it
+                    i, need_flush = _admit_chunk_fast(
+                        self, keys, nodes, tenants, i, now, events,
+                        results,
+                    )
+                while i < n and not need_flush:
+                    res = self._admit_one_locked(
+                        keys[i], nodes[i], tenants[i], now, events
+                    )
+                    results[i] = res
+                    i += 1
+                    if (res._slot is not None
+                            and len(self._pending) >= max_batch):
+                        need_flush = True
+            jr.record_many(events)
+            if need_flush:
+                self.flush()
+        return results
 
     def _submit_keyed(self, key, node: int,
                       tenant: Optional[str]) -> ServeResult:
-        """The router's shared submit body (`ServeEngine._submit_keyed`'s
-        dist twin): ``key`` is the coalescing/cache identity — the plain
-        node id here, ``(node, t_bucket)`` on the round-19 temporal
-        router — and ``node`` what telemetry/journal/shed entries
-        carry."""
+        """The router's single-key submit body (`ServeEngine._submit_keyed`'s
+        dist twin, one stripe lock = one owner's queue): ``key`` is the
+        coalescing/cache identity — the plain node id here, ``(node,
+        t_bucket)`` on the round-19 temporal router — and ``node`` what
+        telemetry/journal/shed entries carry."""
         tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         now = self._clock()
-        need_flush = False
-        jr = self.journal
+        events: List[Tuple] = []
+        with self._pending.lock_for(key):
+            res = self._admit_one_locked(key, node, tenant, now, events)
+            need_flush = (res._slot is not None
+                          and len(self._pending) >= self.config.max_batch)
+        self.journal.record_many(events)
+        if need_flush:
+            self.flush()
+        return res
+
+    def _admit_one_locked(self, key, node: int, tenant: str, now: float,
+                          events: List[Tuple]) -> ServeResult:
+        """KEEP IN LOCKSTEP with `ServeEngine._admit_one_locked` — same
+        cache/coalesce/shed/late-admit decision sequence, router-flavored
+        shed message. Caller holds ``key``'s stripe lock (or all of
+        them); ``_lock`` is taken only for the rid/late-admission
+        window."""
+        self.stats.requests += 1
         wl = self.workload
-        with self._lock:
-            self.stats.requests += 1
-            if wl is not None:
-                wl.observe_seed(node)  # observe-only frequency tap
-            cached = self.cache.get(key, self.params_version)
-            if cached is not None:
-                ms = (self._clock() - now) * 1e3
-                self.stats.latency.record_ms(ms)
-                self.stats.tenant_hist(tenant).record_ms(ms)
-                jr.emit("cache_hit", -1, -1, node)
-                return ServeResult(value=cached)
-            slot = self._pending.get(key) or self._inflight.get(key)
-            if slot is not None and slot.version == self.params_version:
-                self.stats.coalesced += 1
-                jr.emit("coalesce", slot.rid, -1, node)
-            else:
-                if shed_decision(
-                    len(self._pending), self._pending_tenant.get(tenant, 0),
-                    tenant, self.config.max_queue_depth,
-                    self.config.tenant_weights,
-                ):
-                    self.stats.shed += 1
-                    self.shed_log.append((self.stats.requests, tenant, node))
-                    jr.emit("shed", -1, -1, node)
-                    return ServeResult(error=ShedError(
-                        f"router queue depth {len(self._pending)} >= "
-                        f"{self.config.max_queue_depth} and tenant "
-                        f"{tenant!r} is at its weighted quota"
-                    ))
+        if wl is not None:
+            wl.observe_seed(node)  # observe-only frequency tap
+        cached = self.cache.get(key, self.params_version)
+        if cached is not None:
+            ms = (self._clock() - now) * 1e3
+            self.stats.latency.record_ms(ms)
+            self.stats.tenant_hist(tenant).record_ms(ms)
+            events.append(("cache_hit", -1, -1, node, 0))
+            return ServeResult(value=cached)
+        slot = self._pending.get(key) or self._inflight.get(key)
+        if slot is not None and slot.version == self.params_version:
+            self.stats.coalesced += 1
+            events.append(("coalesce", slot.rid, -1, node, 0))
+        else:
+            if shed_decision(
+                len(self._pending), self._pending.tenant_count(tenant),
+                tenant, self.config.max_queue_depth,
+                self.config.tenant_weights,
+            ):
+                self.stats.shed += 1
+                self.shed_log.append((self.stats.requests, tenant, node))
+                events.append(("shed", -1, -1, node, 0))
+                return ServeResult(error=ShedError(
+                    f"router queue depth {len(self._pending)} >= "
+                    f"{self.config.max_queue_depth} and tenant "
+                    f"{tenant!r} is at its weighted quota"
+                ))
+            admitted_late = False
+            with self._lock:
                 rid = -1
-                if jr.enabled:
+                if self.journal.enabled:
                     rid = self._next_rid
                     self._next_rid += 1
                 slot = _Slot(key, self.params_version, now, rid=rid,
@@ -1525,18 +1625,12 @@ class DistServeEngine:
                     fl.slots.append(slot)
                     self._inflight[key] = slot
                     self.stats.late_admitted += 1
-                    jr.emit("late_admit", rid, fl.fid, node)
-                else:
-                    self._pending[key] = slot
-                    self._pending_tenant[tenant] = (
-                        self._pending_tenant.get(tenant, 0) + 1
-                    )
-                    jr.emit("submit", rid, -1, node)
-            slot.waiters.append((now, tenant))
-            if len(self._pending) >= self.config.max_batch:
-                need_flush = True
-        if need_flush:
-            self.flush()
+                    events.append(("late_admit", rid, fl.fid, node, 0))
+                    admitted_late = True
+            if not admitted_late:
+                self._pending.insert_unlocked(key, slot, tenant)
+                events.append(("submit", rid, -1, node, 0))
+        slot.waiters.append((now, tenant))
         return ServeResult(slot=slot)
 
     def predict(self, node_ids, timeout: Optional[float] = None,
@@ -1546,10 +1640,7 @@ class DistServeEngine:
             raise ValueError(
                 f"tenants has {len(tenants)} entries for {ids.shape[0]} ids"
             )
-        handles = [
-            self.submit(i, tenant=None if tenants is None else tenants[j])
-            for j, i in enumerate(ids)
-        ]
+        handles = self.submit_many(ids, tenant=tenants)
         if not handles:
             return np.zeros((0, self.out_dim), np.float32)
         if not self._running:
@@ -1560,13 +1651,15 @@ class DistServeEngine:
     # -- flush policy ------------------------------------------------------
 
     def should_flush(self) -> bool:
-        with self._lock:
-            if not self._pending:
-                return False
-            if len(self._pending) >= self.config.max_batch:
-                return True
-            oldest = next(iter(self._pending.values())).enqueue_t
-            return (self._clock() - oldest) * 1e3 >= self.config.max_delay_ms
+        # lock-free probe, mirroring ServeEngine.should_flush (round 20)
+        if not self._pending:
+            return False
+        if len(self._pending) >= self.config.max_batch:
+            return True
+        oldest = self._pending.oldest_enqueue_t()
+        if oldest is None:
+            return False
+        return (self._clock() - oldest) * 1e3 >= self.config.max_delay_ms
 
     def pump(self) -> int:
         return self.flush() if self.should_flush() else 0
@@ -1576,21 +1669,16 @@ class DistServeEngine:
     def _assemble(self) -> Optional[_RoutedFlush]:
         """Drain + publish (mirrors `ServeEngine._assemble`): the owner
         split waits for `_seal_assembled` so late-admitted seeds route with
-        their flush."""
-        with self._lock:
+        their flush. Lock order (round 20): every stripe lock, THEN
+        ``_lock`` — same hierarchy as `ServeEngine._assemble`."""
+        with self._pending.all_locks(), self._lock:
             if not self._pending:
                 return None
             keys = weighted_drain_keys(
-                self._pending, self.config.max_batch,
-                self.config.tenant_weights,
+                self._pending.ordered_dict_unlocked(),
+                self.config.max_batch, self.config.tenant_weights,
             )
-            slots = [self._pending.pop(k) for k in keys]
-            for s in slots:
-                n = self._pending_tenant.get(s.tenant, 1) - 1
-                if n > 0:
-                    self._pending_tenant[s.tenant] = n
-                else:
-                    self._pending_tenant.pop(s.tenant, None)
+            slots = [self._pending.pop_unlocked(k) for k in keys]
             self._inflight.update(zip(keys, slots))
             fl = _RoutedFlush(keys, slots, [])
             fl.bucket = self.config.max_batch
@@ -1605,11 +1693,14 @@ class DistServeEngine:
             fl.fid = self._flush_index + 1
             jr = self.journal
             if jr.enabled:
-                for k, slot in zip(keys, slots):
-                    # a = the NODE id per the EVENT_KINDS contract (a
-                    # temporal key is a (node, t_bucket) tuple)
-                    jr.emit("assemble", slot.rid, fl.fid,
-                            k[0] if isinstance(k, tuple) else k)
+                # a = the NODE id per the EVENT_KINDS contract (a
+                # temporal key is a (node, t_bucket) tuple); one batched
+                # ring append for the whole drain (round 20)
+                jr.record_many([
+                    ("assemble", slot.rid, fl.fid,
+                     k[0] if isinstance(k, tuple) else k, 0)
+                    for k, slot in zip(keys, slots)
+                ])
                 jr.emit("flush", -1, fl.fid, len(keys), fl.bucket)
             if self.config.late_admission and len(keys) < fl.bucket:
                 self._open = fl
@@ -1627,6 +1718,14 @@ class DistServeEngine:
         try:
             arr = np.asarray(fl.keys, np.int64)
             fl.tenants = [s.tenant for s in fl.slots]
+            fl.ids = arr
+            fl.rids = np.fromiter(
+                (s.rid for s in fl.slots), np.int64, len(fl.slots)
+            )
+            tix = self._tenant_index
+            fl.tenant_ix = np.fromiter(
+                (tix.get(t, -1) for t in fl.tenants), np.int32, len(fl.tenants)
+            )
             owners = self.global2host[arr].astype(np.int64)
             rep = self.replica  # swapped only under the fence: stable here
             if rep is not None and rep.ids.size:
@@ -1635,13 +1734,19 @@ class DistServeEngine:
                 # exchange (the whole point of the replica)
                 owners = np.where(np.isin(arr, rep.ids), REPLICA_HOST,
                                   owners)
-                pos = np.nonzero(owners == REPLICA_HOST)[0]
-                if pos.size:
-                    fl.split.append((REPLICA_HOST, arr[pos], pos))
-            for h in range(self.hosts):
-                pos = np.nonzero(owners == h)[0]
-                if pos.size:
-                    fl.split.append((h, arr[pos], pos))
+            # ONE owner partition via stable argsort (round 20), replacing
+            # the per-host nonzero scan: ascending owner groups put the
+            # REPLICA_HOST (-2) leg first and hosts in ascending order,
+            # positions ascending within each group — exactly the split
+            # the old loop built, at O(n log n) instead of O(n·hosts)
+            if arr.size:
+                order = np.argsort(owners, kind="stable")
+                so = owners[order]
+                cuts = np.nonzero(np.diff(so))[0] + 1
+                for pos in np.split(order, cuts):
+                    h = int(owners[pos[0]])
+                    if h == REPLICA_HOST or 0 <= h < self.hosts:
+                        fl.split.append((h, arr[pos], pos))
             if self.config.record_dispatches:
                 self.dispatch_log.append(
                     (arr.copy(), [(h, ids.copy()) for h, ids, _ in fl.split])
@@ -2002,7 +2107,7 @@ class DistServeEngine:
             now = t_res0 = self._clock()
             for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
                 self._inflight.pop(k, None)
-                if slot.event.is_set():
+                if slot.resolved:
                     # abandoned by a bounded stop() drain (resolve-once
                     # rule — see ServeEngine._resolve)
                     continue
@@ -2091,8 +2196,7 @@ class DistServeEngine:
                 self._window.release()
 
     def _drainable(self) -> bool:
-        with self._lock:
-            return bool(self._pending)
+        return bool(self._pending)
 
     # -- weight updates / warmup / lifecycle -------------------------------
 
@@ -2100,24 +2204,30 @@ class DistServeEngine:
         """Fence the ROUTER (no routed flush in the air), then fence every
         shard engine through its own `update_params` — so no served logit
         anywhere crosses the weight update, and every shard's embedding
-        cache is invalidated together."""
+        cache is invalidated together. Lock order (round 20): stripes
+        before ``_lock``, same hierarchy as `ServeEngine.update_params` —
+        the fence wait releases only ``_lock`` while the stripe locks
+        stay held, so submits park at stripe acquire and resolves (which
+        need only ``_lock``) drain freely."""
         with self._seq:
-            with self._fence:
-                while self._inflight_flushes:
-                    self._fence.wait()
-                for eng in self.engines.values():
-                    eng.update_params(params)
-                # the hot-set replica and the full-graph fallback serve
-                # under the same weights as the owners — same fence
-                if self.replica is not None:
-                    self.replica.engine.update_params(params)
-                if self.fallback is not None:
-                    self.fallback.update_params(params)
-                self._params = params
-                self.params_version += 1
-                self.cache.invalidate()
-                for slot in self._pending.values():
-                    slot.version = self.params_version
+            with self._pending.all_locks():
+                with self._fence:
+                    while self._inflight_flushes:
+                        self._fence.wait()
+                    for eng in self.engines.values():
+                        eng.update_params(params)
+                    # the hot-set replica and the full-graph fallback
+                    # serve under the same weights as the owners — same
+                    # fence
+                    if self.replica is not None:
+                        self.replica.engine.update_params(params)
+                    if self.fallback is not None:
+                        self.fallback.update_params(params)
+                    self._params = params
+                    self.params_version += 1
+                    self.cache.invalidate()
+                    for slot in self._pending.values_unlocked():
+                        slot.version = self.params_version
 
     # -- round-17 streaming graphs (ROADMAP item 1) -------------------------
 

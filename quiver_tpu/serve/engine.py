@@ -88,10 +88,12 @@ compile.
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -198,6 +200,153 @@ def shed_decision(pending_len: int, tenant_pending: int, tenant: str,
     return tenant_pending >= quota
 
 
+def resolve_tenants(tenant, n: int) -> List[str]:
+    """Per-request tenant names from a None / scalar / aligned-sequence
+    spelling — the one normalization behind every ``submit_many``
+    (single-host, router, temporal), so batch tenant semantics can never
+    drift between front ends."""
+    if tenant is None:
+        return [DEFAULT_TENANT] * n
+    if isinstance(tenant, str):
+        return [tenant] * n
+    tenants = [DEFAULT_TENANT if x is None else str(x) for x in tenant]
+    if len(tenants) != n:
+        raise ValueError(f"tenants has {len(tenants)} entries for {n} ids")
+    return tenants
+
+
+class _PendingStripes:
+    """Striped pending-queue state shared by both serve front ends
+    (round 20): ``n`` insertion-ordered dicts (key -> `_Slot`), each under
+    its own lock, so concurrent submit threads for keys in different
+    stripes never serialize on one engine-wide lock. A global GIL-atomic
+    arrival counter stamps every inserted slot (``_Slot.seq``), and every
+    ordered view merges the stripes by it — so the merged queue IS the
+    single-dict FIFO of rounds 8–19 bit for bit: `weighted_drain_keys`
+    over the merged view, and therefore batch composition and the
+    dispatch log, cannot tell the stripes exist.
+
+    ``stripe_key`` maps a request key to a stable stripe hint — `hash`
+    on the single-host engine, the BUILD-TIME owner partition on the
+    router (per-owner pending queues; the hint must never move with live
+    placement, or a coalesce probe could miss its own pending slot).
+
+    LOCK HIERARCHY: stripe locks are taken BEFORE the engine's ``_lock``,
+    never after. Admission holds ONE stripe lock (or `all_locks` on the
+    batch path) and takes ``_lock`` only for the brief rid/late-admission
+    window inside it; drain and fence paths (`_assemble`,
+    ``update_params``, `abandon_undrained`) enter `all_locks` (ascending
+    index) first and only then ``_lock``. ``*_unlocked`` accessors are
+    for callers already inside `all_locks`. Per-tenant pending counts
+    live per stripe and SUM on read — exact whenever the caller holds
+    the relevant locks or a single thread submits (the determinism
+    contract's cases); unlocked reads (`__len__`, metrics gauges) are
+    GIL-consistent snapshots."""
+
+    __slots__ = ("n", "locks", "maps", "tenants", "stripe_key", "_arrival")
+
+    def __init__(self, n: int, stripe_key: Optional[Callable] = None):
+        self.n = max(1, int(n))
+        self.locks = tuple(threading.Lock() for _ in range(self.n))
+        self.maps: Tuple[Dict, ...] = tuple({} for _ in range(self.n))
+        self.tenants: Tuple[Dict[str, int], ...] = tuple(
+            {} for _ in range(self.n)
+        )
+        self.stripe_key = stripe_key if stripe_key is not None else hash
+        self._arrival = itertools.count()  # next() is GIL-atomic
+
+    def stripe_of(self, key) -> int:
+        return self.stripe_key(key) % self.n
+
+    def lock_for(self, key) -> threading.Lock:
+        return self.locks[self.stripe_of(key)]
+
+    @contextlib.contextmanager
+    def all_locks(self):
+        for lk in self.locks:
+            lk.acquire()
+        try:
+            yield
+        finally:
+            for lk in reversed(self.locks):
+                lk.release()
+
+    # -- unlocked views (GIL-consistent; exact under the locks) -----------
+
+    def __len__(self) -> int:
+        return sum(len(m) for m in self.maps)
+
+    def __bool__(self) -> bool:
+        return any(self.maps)
+
+    def get(self, key):
+        return self.maps[self.stripe_of(key)].get(key)
+
+    def tenant_count(self, tenant: str) -> int:
+        return sum(t.get(tenant, 0) for t in self.tenants)
+
+    # -- mutations (caller holds the key's stripe lock / all_locks) -------
+
+    def insert_unlocked(self, key, slot, tenant: str) -> None:
+        s = self.stripe_of(key)
+        slot.seq = next(self._arrival)
+        self.maps[s][key] = slot
+        t = self.tenants[s]
+        t[tenant] = t.get(tenant, 0) + 1
+
+    def pop_unlocked(self, key):
+        s = self.stripe_of(key)
+        slot = self.maps[s].pop(key)
+        t = self.tenants[s]
+        n = t.get(slot.tenant, 1) - 1
+        if n > 0:
+            t[slot.tenant] = n
+        else:
+            t.pop(slot.tenant, None)
+        return slot
+
+    def clear_unlocked(self) -> None:
+        for m in self.maps:
+            m.clear()
+        for t in self.tenants:
+            t.clear()
+
+    def values_unlocked(self):
+        for m in self.maps:
+            yield from m.values()
+
+    def ordered_items_unlocked(self) -> List[Tuple[object, "_Slot"]]:
+        """(key, slot) pairs in global arrival order — the exact
+        single-dict insertion order striping replaced."""
+        items = [kv for m in self.maps for kv in m.items()]
+        items.sort(key=lambda kv: kv[1].seq)
+        return items
+
+    def ordered_dict_unlocked(self) -> Dict:
+        return dict(self.ordered_items_unlocked())
+
+    # -- self-locking views (caller must NOT hold engine._lock) -----------
+
+    def ordered_keys(self) -> List:
+        with self.all_locks():
+            return [k for k, _ in self.ordered_items_unlocked()]
+
+    def oldest_enqueue_t(self) -> Optional[float]:
+        """Enqueue time of the globally oldest pending slot (None when
+        empty) — the flush-age policy input. Per stripe, the head of the
+        insertion-ordered dict is that stripe's oldest; the global oldest
+        is the min-seq head across stripes."""
+        best = None
+        best_seq = None
+        for lk, m in zip(self.locks, self.maps):
+            with lk:
+                it = iter(m.values())
+                head = next(it, None)
+            if head is not None and (best_seq is None or head.seq < best_seq):
+                best, best_seq = head.enqueue_t, head.seq
+        return best
+
+
 def tenant_latency_hist(tenant_latency: Dict[str, LatencyHistogram],
                         tenant: str) -> LatencyHistogram:
     """Get-or-create a tenant's latency histogram — the one creation
@@ -275,7 +424,7 @@ def abandon_undrained(engine, drained: bool = True) -> None:
     stats fields this reads). ``drained`` distinguishes the message: a
     deliberate ``stop(drain=False)`` with queued work is not a deadline
     failure and must not read like one."""
-    with engine._lock:
+    with engine._pending.all_locks(), engine._lock:
         leftover = len(engine._pending) + len(engine._inflight)
         if not leftover and not engine._inflight_flushes:
             return
@@ -291,17 +440,16 @@ def abandon_undrained(engine, drained: bool = True) -> None:
                 f"unserved (no drain was requested)"
             )
         err = DrainTimeout(msg)
-        for slot in list(engine._pending.values()):
+        for slot in list(engine._pending.values_unlocked()):
             slot.resolve(None, error=err)
         for slot in list(engine._inflight.values()):
-            if not slot.event.is_set():
+            if not slot.resolved:
                 slot.resolve(None, error=err)
         # clear BOTH maps: a later submit must never coalesce onto an
         # abandoned (errored) slot, and the wedged flush's eventual
         # _resolve skips already-set slots (resolve-once rule)
-        engine._pending.clear()
+        engine._pending.clear_unlocked()
         engine._inflight.clear()
-        engine._pending_tenant.clear()
         engine.stats.undrained += leftover
         engine.stats.request_errors += leftover
 
@@ -515,6 +663,12 @@ class ServeConfig:
     tier_prefetch_hops: Optional[int] = None
     tier_prefetch_max_rows: int = 4096
     tier_prefetch_at: str = "submit"
+    # round-20 vectorized host path: stripe count of the pending queue
+    # (`_PendingStripes`) — concurrent submit threads for keys in
+    # different stripes never share a lock. 1 reproduces the single-dict
+    # engine's locking exactly; batch composition and dispatch logs are
+    # stripe-count-invariant either way (arrival-order merge).
+    submit_stripes: int = 8
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -530,20 +684,38 @@ class ServeConfig:
         return bs
 
 
+# guards lazy per-slot Event creation (contended only when two waiters
+# race to be a slot's FIRST blocking waiter — never on the submit path)
+_SLOT_EVENT_LOCK = threading.Lock()
+
+
 class _Slot:
     """One unique (node_id, params_version) computation; every coalesced
-    request for it holds a reference and blocks on ``event``. ``rid`` is
-    the slot's journal request id (engine-monotonic; -1 when the engine
-    isn't journaling) — the key the lifecycle events thread through."""
+    request for it holds a reference and blocks via :meth:`wait`. ``rid``
+    is the slot's journal request id (engine-monotonic; -1 when the
+    engine isn't journaling) — the key the lifecycle events thread
+    through. ``seq`` is the global arrival stamp `_PendingStripes` orders
+    the striped queue by.
 
-    __slots__ = ("node_id", "version", "event", "value", "error", "enqueue_t",
-                 "waiters", "rid", "tenant")
+    The completion `threading.Event` is LAZY (round 20): submit-path
+    throughput is bounded by per-slot construction cost, and most slots
+    under `predict`/`submit_many` are polled (``done()``) then read after
+    their flush resolves — they never block, so they never pay the Event
+    (three allocations + a lock). ``resolved`` is the plain-bool fast
+    path (GIL-ordered against `resolve`); the first waiter that actually
+    needs to BLOCK installs the event under `_SLOT_EVENT_LOCK` and
+    re-checks ``resolved`` after installing, which closes the
+    install/resolve race in either interleaving."""
+
+    __slots__ = ("node_id", "version", "_event", "resolved", "value",
+                 "error", "enqueue_t", "waiters", "rid", "tenant", "seq")
 
     def __init__(self, node_id: int, version: int, enqueue_t: float,
                  rid: int = -1, tenant: str = DEFAULT_TENANT):
         self.node_id = node_id
         self.version = version
-        self.event = threading.Event()
+        self._event: Optional[threading.Event] = None
+        self.resolved = False
         self.value: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.enqueue_t = enqueue_t
@@ -552,11 +724,30 @@ class _Slot:
         self.waiters: List[Tuple[float, str]] = []
         self.rid = rid
         self.tenant = tenant  # admitting tenant (quota accounting)
+        self.seq = -1  # arrival order within the striped pending queue
 
     def resolve(self, value: Optional[np.ndarray], error=None) -> None:
         self.value = value
         self.error = error
-        self.event.set()
+        self.resolved = True
+        ev = self._event
+        if ev is not None:
+            ev.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self.resolved:
+            return True
+        ev = self._event
+        if ev is None:
+            with _SLOT_EVENT_LOCK:
+                ev = self._event
+                if ev is None:
+                    ev = self._event = threading.Event()
+            if self.resolved:
+                # resolve() may have read _event before the install; its
+                # write to ``resolved`` precedes this read under the GIL
+                return True
+        return ev.wait(timeout)
 
 
 class ServeResult:
@@ -575,14 +766,14 @@ class ServeResult:
         self._error = error
 
     def done(self) -> bool:
-        return self._slot is None or self._slot.event.is_set()
+        return self._slot is None or self._slot.resolved
 
     def error(self) -> Optional[BaseException]:
         """The request's exception without raising (None if none yet;
         a queued request's error is known only after it resolves)."""
         if self._error is not None:
             return self._error
-        if self._slot is not None and self._slot.event.is_set():
+        if self._slot is not None and self._slot.resolved:
             return self._slot.error
         return None
 
@@ -598,7 +789,7 @@ class ServeResult:
             raise self._error
         if self._slot is None:
             return self._value
-        if not self._slot.event.wait(timeout):
+        if not self._slot.wait(timeout):
             raise TimeoutError("serve request not resolved in time")
         if self._slot.error is not None:
             raise self._slot.error
@@ -766,10 +957,20 @@ class _Flush:
     at drain time; late admission may append to ``keys``/``slots`` up to it
     until `_seal_assembled` closes the flush. The fused path carries the
     drawn sampler ``key`` + the ``padded`` seed batch into its one-program
-    dispatch; the split path carries the pre-run sample ``ds``."""
+    dispatch; the split path carries the pre-run sample ``ds``.
+
+    Round 20 (array-native internals): once sealed, the flush also carries
+    SLOT ARRAYS — ``ids`` (int64 seed ids), ``rids`` (int64 journal
+    request ids, -1 when the journal is off) and ``tenant_ix`` (int32
+    indices into the engine's interned tenant table, built on first
+    sight) — aligned with ``slots`` so downstream consumers (result
+    delivery, replay tooling, the frontend bench) address the batch by
+    slot INDEX instead of walking per-request objects. ``slots`` itself
+    stays: waiters/version/resolution state is per-request by nature."""
 
     __slots__ = ("keys", "slots", "params", "seeds", "bucket", "ds", "key",
-                 "padded", "extra", "error", "fid")
+                 "padded", "extra", "error", "fid", "ids", "rids",
+                 "tenant_ix")
 
     def __init__(self, keys, slots, params):
         self.keys = keys
@@ -780,6 +981,9 @@ class _Flush:
         self.ds = None
         self.key = None
         self.padded = None
+        self.ids = None        # int64 [n] seed ids (sealed)
+        self.rids = None       # int64 [n] journal rids (sealed)
+        self.tenant_ix = None  # int32 [n] interned tenant indices (sealed)
         # extra padded per-seed dispatch arguments (round 19: the temporal
         # workload's query-time vector); None on the plain engine
         self.extra = None
@@ -788,6 +992,108 @@ class _Flush:
         # draw (assemble and seal happen under one _seq hold, so nothing
         # can interleave an increment between them)
         self.fid = -1
+
+
+def _admit_chunk_fast(eng, keys, nodes, tenants, i, now, events,
+                      results) -> Tuple[int, bool]:
+    """Vectorized chunk admission (round 20 tentpole) — the fast body
+    behind `ServeEngine._submit_keyed_many` and its router twin. The
+    caller holds ALL stripe locks and has checked the per-request slow
+    triggers are off (no workload tap, no queue-depth shedding); this
+    body then admits requests ``[i, n)`` with ONE engine-lock hold, ONE
+    batched cache probe per block (`EmbeddingCache.get_many`), C-level
+    dict ops for the coalesce probe/insert, and bulk stats/rid updates.
+    The per-request DECISION sequence (cache hit -> coalesce -> fresh
+    insert, in request order) is identical to `_admit_one_locked`; only
+    the mechanics are amortized, so dispatch logs, journal streams, rid
+    values and counters stay bit-identical to the scalar path.
+
+    Cache probes run ahead of admission in blocks no larger than the
+    guaranteed-consumable room ``max_batch - len(pending)``: a fill
+    needs that many fresh inserts, so it can only land on a block's
+    LAST entry — probe side effects (LRU touches, hit/miss counters)
+    never outrun the requests actually admitted before an inline flush.
+
+    Returns ``(i, need_flush)``. Stops early (``need_flush`` False,
+    ``i < n``) when a late-admission window is open — the caller's
+    per-request loop handles pad-slack admission; a window cannot OPEN
+    mid-chunk because publishing one needs the stripe locks the caller
+    holds, and it cannot CLOSE because sealing takes the engine lock
+    held here."""
+    n = len(keys)
+    pend = eng._pending
+    maps = pend.maps
+    tmaps = pend.tenants
+    ns = pend.n
+    skey = pend.stripe_key
+    arrival = pend._arrival
+    infl_get = eng._inflight.get
+    cache_many = eng.cache.get_many
+    stats = eng.stats
+    clock = eng._clock
+    max_batch = eng.config.max_batch
+    plen = len(pend)
+    requests = 0
+    coalesced = 0
+    ev_append = events.append
+    with eng._lock:
+        if eng._open is not None:
+            return i, False
+        ver = eng.params_version
+        jr_on = eng.journal.enabled
+        rid = eng._next_rid
+        while i < n:
+            room = max_batch - plen
+            if room < 1:
+                room = 1
+            j = i + room
+            if j > n:
+                j = n
+            for v in cache_many(keys[i:j], ver):
+                k = keys[i]
+                node = nodes[i]
+                ten = tenants[i]
+                requests += 1
+                if v is not None:  # cache hit: served on the spot
+                    ms = (clock() - now) * 1e3
+                    stats.latency.record_ms(ms)
+                    stats.tenant_hist(ten).record_ms(ms)
+                    if jr_on:
+                        ev_append(("cache_hit", -1, -1, node, 0))
+                    results[i] = ServeResult(value=v)
+                    i += 1
+                    continue
+                s = skey(k) % ns
+                slot = maps[s].get(k) or infl_get(k)
+                if slot is not None and slot.version == ver:
+                    coalesced += 1
+                    if jr_on:
+                        ev_append(("coalesce", slot.rid, -1, node, 0))
+                else:
+                    r = -1
+                    if jr_on:
+                        r = rid
+                        rid += 1
+                    slot = _Slot(k, ver, now, rid=r, tenant=ten)
+                    slot.seq = next(arrival)
+                    maps[s][k] = slot
+                    t = tmaps[s]
+                    t[ten] = t.get(ten, 0) + 1
+                    if jr_on:
+                        ev_append(("submit", r, -1, node, 0))
+                    plen += 1
+                slot.waiters.append((now, ten))
+                results[i] = ServeResult(slot=slot)
+                i += 1
+                if plen >= max_batch:
+                    eng._next_rid = rid
+                    stats.requests += requests
+                    stats.coalesced += coalesced
+                    return i, True
+        eng._next_rid = rid
+    stats.requests += requests
+    stats.coalesced += coalesced
+    return i, False
 
 
 class ServeEngine:
@@ -924,18 +1230,24 @@ class ServeEngine:
         self.graph_version = 0
         self.pending_delta = None
         self.dispatch_log: List[Tuple[np.ndarray, int]] = []
-        # queue state: _pending holds slots not yet flushed (insertion order
-        # = FIFO), _inflight slots snapshot-ed by a running flush
-        self._pending: "Dict[int, _Slot]" = {}
+        # queue state (round 20): _pending is the STRIPED pending store —
+        # per-stripe dicts of slots not yet flushed (merged arrival order
+        # = the rounds-8–19 FIFO, bit for bit), per-stripe locks so
+        # concurrent submitters don't serialize; _inflight (guarded by
+        # _lock) holds slots snapshot-ed by a running flush. Per-tenant
+        # pending counts live inside the store (insert/pop maintain them)
+        self._pending = _PendingStripes(self.config.submit_stripes)
         self._inflight: Dict[int, _Slot] = {}
         import collections
 
-        # round-15 per-tenant admission state (guarded by _lock):
-        # pending-slot counts per admitting tenant, and the deterministic
-        # shed decisions log [(request_seq, tenant, node_id)] — a bounded
-        # ring: sustained overload (when it fills) must not leak
-        self._pending_tenant: Dict[str, int] = {}
+        # round-15 deterministic shed decisions log [(request_seq,
+        # tenant, node_id)] — a bounded ring: sustained overload (when it
+        # fills) must not leak
         self.shed_log = collections.deque(maxlen=65536)
+        # round-20 array-native flush internals: per-engine tenant-name
+        # interning for the flush-level tenant-index arrays (grown on
+        # demand at seal; order = first-seen)
+        self._tenant_ids: Dict[str, int] = {}
         # the assembled-but-not-yet-sealed flush accepting late admissions
         # (guarded by _lock; non-None only while its flusher holds _seq)
         self._open: Optional[_Flush] = None
@@ -966,6 +1278,12 @@ class ServeEngine:
         admission enabled, pad slack left) rides that flush's pad lanes
         instead of waiting a whole extra flush.
 
+        Round 20: this is `submit_many` of ONE — the scalar spelling
+        stays the public API, but the cache-check/coalesce/shed/admit/
+        flush-at-fill sequence lives once in `_admit_one_locked`, so
+        scalar and batch admission are bit-identical by construction
+        (pinned in tests/test_frontend.py).
+
         ``tenant`` names the submitting tenant (round 15): its latency
         lands in ``stats.tenant_latency[tenant]``, its queue share is
         bounded by ``tenant_weights``/``max_queue_depth`` (an over-quota
@@ -974,52 +1292,161 @@ class ServeEngine:
         tenants in weighted proportion. Cache hits and coalesces never
         shed. KEEP IN LOCKSTEP with `DistServeEngine.submit`
         (serve/dist.py): the distributed router's hosts=1 bit-parity
-        contract rides this exact cache-check/coalesce/admit/flush-at-fill
-        sequence."""
-        key = int(node_id)
-        return self._submit_keyed(key, key, tenant)
+        contract rides this exact admission sequence."""
+        return self.submit_many((node_id,), tenant=tenant)[0]
+
+    def submit_many(self, node_ids, t=None,
+                    tenant: Union[None, str, Sequence[str]] = None,
+                    ) -> List[ServeResult]:
+        """Vectorized batch submit (round 20): admit N requests array-at-
+        a-time — one stripe-lock acquisition per admission chunk, one
+        clock read, one batched journal append (`EventJournal.
+        record_many`), per-request handles back in request order. The
+        admission DECISIONS (cache probe order, coalescing, shedding,
+        late admission, flush-at-fill) are made per request in request
+        order — by the vectorized `_admit_chunk_fast` body in the
+        common case (no shedding, no workload tap, no open
+        late-admission window), by the same `_admit_one_locked` body
+        the scalar path runs otherwise; the two are decision-for-
+        decision identical, so dispatch logs are bit-identical to N
+        scalar ``submit`` calls — the batch path amortizes the host
+        mechanics, never the semantics. Fills of ``max_batch`` flush
+        INLINE mid-batch, exactly where the scalar sequence would
+        flush.
+
+        ``t`` is rejected here (temporal engines override with vectorized
+        query-time quantization); ``tenant`` is None, one tenant name for
+        the whole batch, or a per-request sequence aligned with
+        ``node_ids``."""
+        if t is not None:
+            raise TypeError(
+                "t= is a temporal-serving argument (TemporalServeEngine / "
+                "TemporalDistServeEngine); this engine serves untimed nodes"
+            )
+        ids = np.asarray(node_ids, dtype=np.int64).reshape(-1)
+        keys = ids.tolist()  # python ints: dict keys + journal payloads
+        return self._submit_keyed_many(keys, keys, tenant)
+
+    def _submit_keyed_many(self, keys: List, nodes: List[int],
+                           tenant) -> List[ServeResult]:
+        """The batch admission loop behind `submit_many` (and, at N=1,
+        `submit`/`_submit_keyed`): chunked single-lock holds over the
+        striped pending store, per-request decisions in request order,
+        one journal append per chunk, inline flush at every fill — the
+        scalar admission sequence, amortized. KEEP IN LOCKSTEP with
+        `DistServeEngine._submit_keyed_many`."""
+        n = len(keys)
+        tenants = resolve_tenants(tenant, n)
+        results: List[Optional[ServeResult]] = [None] * n
+        max_batch = self.config.max_batch
+        jr = self.journal
+        i = 0
+        while i < n:
+            events: List[Tuple] = []
+            need_flush = False
+            now = self._clock()
+            with self._pending.all_locks():
+                if (self.workload is None
+                        and self.config.max_queue_depth == 0):
+                    # the round-20 tentpole: vectorized chunk admission
+                    # (one _lock hold, blocked cache probes, bulk
+                    # stats). Falls through to the per-request body
+                    # when a decision needs it (an open late-admission
+                    # window) or when shedding / the workload tap are
+                    # configured (checked above — those are inherently
+                    # per-request).
+                    i, need_flush = _admit_chunk_fast(
+                        self, keys, nodes, tenants, i, now, events,
+                        results,
+                    )
+                while i < n and not need_flush:
+                    res = self._admit_one_locked(
+                        keys[i], nodes[i], tenants[i], now, events
+                    )
+                    results[i] = res
+                    i += 1
+                    if (res._slot is not None
+                            and len(self._pending) >= max_batch):
+                        need_flush = True
+            jr.record_many(events)
+            if need_flush:
+                # flush-ahead prefetch at SUBMIT time (round 18): issue
+                # the filled bucket's closure reads on THIS thread before
+                # the flush work starts — when another flush already
+                # holds the dispatch path, the reads overlap its whole
+                # service time. Observe-only: never reorders admission,
+                # never fails a submit (the assemble-time pass is the
+                # catch-all).
+                if (self._prefetch_store is not None
+                        and self.config.tier_prefetch_at == "submit"):
+                    self._prefetch_pending()
+                self.flush()
+        return results
 
     def _submit_keyed(self, key, node: int,
                       tenant: Optional[str]) -> ServeResult:
-        """The one cache-check/coalesce/shed/admit/flush-at-fill sequence
-        behind every submit spelling: ``key`` is the coalescing/cache
-        identity (the plain node id here; ``(node, t_bucket)`` on the
-        round-19 temporal engine, which overrides only `submit` to build
-        it) and ``node`` the seed id telemetry/journal/shed entries
-        carry. One body, so a future change to shedding or admission can
-        never silently skip a workload."""
+        """Single-key admission under ONE stripe lock (the concurrent-
+        scalar-submit fast path: threads submitting keys in different
+        stripes never share a lock). Same `_admit_one_locked` body as the
+        batch path. ``key`` is the coalescing/cache identity (the plain
+        node id on this engine; ``(node, t_bucket)`` on the round-19
+        temporal engine; a pair-endpoint composite via `_PairServing`)
+        and ``node`` the seed id telemetry/journal/shed entries carry."""
         tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         now = self._clock()
-        need_flush = False
-        jr = self.journal
+        events: List[Tuple] = []
+        with self._pending.lock_for(key):
+            res = self._admit_one_locked(key, node, tenant, now, events)
+            need_flush = (res._slot is not None
+                          and len(self._pending) >= self.config.max_batch)
+        self.journal.record_many(events)
+        if need_flush:
+            if (self._prefetch_store is not None
+                    and self.config.tier_prefetch_at == "submit"):
+                self._prefetch_pending()
+            self.flush()
+        return res
+
+    def _admit_one_locked(self, key, node: int, tenant: str, now: float,
+                          events: List[Tuple]) -> ServeResult:
+        """The ONE cache-check/coalesce/shed/admit sequence behind every
+        submit spelling, scalar or batch (round 20: extracted so the two
+        can never drift). Caller holds ``key``'s stripe lock (or all
+        stripe locks on the batch path); ``_lock`` is taken here only for
+        the rid draw + late-admission window (stripe-before-_lock, per
+        the `_PendingStripes` hierarchy). Journal events append to
+        ``events`` as ``(kind, rid, fid, a, b)`` for the caller's batched
+        `record_many`. One body, so a future change to shedding or
+        admission can never silently skip a workload."""
+        self.stats.requests += 1
         wl = self.workload
-        with self._lock:
-            self.stats.requests += 1
-            if wl is not None:
-                wl.observe_seed(node)  # observe-only frequency tap
-            cached = self.cache.get(key, self.params_version)
-            if cached is not None:
-                ms = (self._clock() - now) * 1e3
-                self.stats.latency.record_ms(ms)
-                self.stats.tenant_hist(tenant).record_ms(ms)
-                jr.emit("cache_hit", -1, -1, node)
-                return ServeResult(value=cached)
-            slot = self._pending.get(key) or self._inflight.get(key)
-            if slot is not None and slot.version == self.params_version:
-                self.stats.coalesced += 1
-                jr.emit("coalesce", slot.rid, -1, node)
-            else:
-                if self._shed_locked(tenant):
-                    self.stats.shed += 1
-                    self.shed_log.append((self.stats.requests, tenant, node))
-                    jr.emit("shed", -1, -1, node)
-                    return ServeResult(error=ShedError(
-                        f"queue depth {len(self._pending)} >= "
-                        f"{self.config.max_queue_depth} and tenant "
-                        f"{tenant!r} is at its weighted quota"
-                    ))
+        if wl is not None:
+            wl.observe_seed(node)  # observe-only frequency tap
+        cached = self.cache.get(key, self.params_version)
+        if cached is not None:
+            ms = (self._clock() - now) * 1e3
+            self.stats.latency.record_ms(ms)
+            self.stats.tenant_hist(tenant).record_ms(ms)
+            events.append(("cache_hit", -1, -1, node, 0))
+            return ServeResult(value=cached)
+        slot = self._pending.get(key) or self._inflight.get(key)
+        if slot is not None and slot.version == self.params_version:
+            self.stats.coalesced += 1
+            events.append(("coalesce", slot.rid, -1, node, 0))
+        else:
+            if self._shed_locked(tenant):
+                self.stats.shed += 1
+                self.shed_log.append((self.stats.requests, tenant, node))
+                events.append(("shed", -1, -1, node, 0))
+                return ServeResult(error=ShedError(
+                    f"queue depth {len(self._pending)} >= "
+                    f"{self.config.max_queue_depth} and tenant "
+                    f"{tenant!r} is at its weighted quota"
+                ))
+            admitted_late = False
+            with self._lock:
                 rid = -1
-                if jr.enabled:
+                if self.journal.enabled:
                     rid = self._next_rid
                     self._next_rid += 1
                 slot = _Slot(key, self.params_version, now, rid=rid,
@@ -1033,27 +1460,15 @@ class ServeEngine:
                     fl.slots.append(slot)
                     self._inflight[key] = slot
                     self.stats.late_admitted += 1
-                    jr.emit("late_admit", rid, fl.fid, node)
-                else:
-                    self._pending[key] = slot
-                    self._pending_tenant[tenant] = (
-                        self._pending_tenant.get(tenant, 0) + 1
-                    )
-                    jr.emit("submit", rid, -1, node)
-            slot.waiters.append((now, tenant))
-            if len(self._pending) >= self.config.max_batch:
-                need_flush = True
-        if need_flush:
-            # flush-ahead prefetch at SUBMIT time (round 18): issue the
-            # filled bucket's closure reads on THIS thread before the
-            # flush work starts — when another flush already holds the
-            # dispatch path, the reads overlap its whole service time.
-            # Observe-only: never reorders admission, never fails a
-            # submit (the assemble-time pass is the catch-all).
-            if (self._prefetch_store is not None
-                    and self.config.tier_prefetch_at == "submit"):
-                self._prefetch_pending()
-            self.flush()
+                    events.append(("late_admit", rid, fl.fid, node, 0))
+                    admitted_late = True
+            if not admitted_late:
+                # still under the stripe lock: the probe-above/insert-
+                # here pair is atomic per key, and no drain can land in
+                # between (assemble needs every stripe lock)
+                self._pending.insert_unlocked(key, slot, tenant)
+                events.append(("submit", rid, -1, node, 0))
+        slot.waiters.append((now, tenant))
         return ServeResult(slot=slot)
 
     def _prefetch_pending(self) -> None:
@@ -1061,8 +1476,7 @@ class ServeEngine:
         remember them so the assemble-time pass skips the repeat walk
         (`PrefetchBuffer` dedups the READS either way; this skips the
         redundant closure BFS on the serve path)."""
-        with self._lock:
-            keys = tuple(self._pending.keys())
+        keys = self._pending.ordered_keys()
         if not keys:
             return
         try:
@@ -1077,7 +1491,7 @@ class ServeEngine:
 
     def _shed_locked(self, tenant: str) -> bool:
         return shed_decision(
-            len(self._pending), self._pending_tenant.get(tenant, 0), tenant,
+            len(self._pending), self._pending.tenant_count(tenant), tenant,
             self.config.max_queue_depth, self.config.tenant_weights,
         )
 
@@ -1096,10 +1510,7 @@ class ServeEngine:
             raise ValueError(
                 f"tenants has {len(tenants)} entries for {ids.shape[0]} ids"
             )
-        handles = [
-            self.submit(i, tenant=None if tenants is None else tenants[j])
-            for j, i in enumerate(ids)
-        ]
+        handles = self.submit_many(ids, tenant=tenants)
         if not handles:  # empty batch is a valid no-op (np.stack would raise)
             return np.zeros((0, 0), np.float32)
         if not self._running:
@@ -1110,13 +1521,18 @@ class ServeEngine:
     # -- flush policy -----------------------------------------------------
 
     def should_flush(self) -> bool:
-        with self._lock:
-            if not self._pending:
-                return False
-            if len(self._pending) >= self.config.max_batch:
-                return True
-            oldest = next(iter(self._pending.values())).enqueue_t
-            return (self._clock() - oldest) * 1e3 >= self.config.max_delay_ms
+        # lock-free probe (round 20): len() over the stripes is a sum of
+        # dict lens (GIL-consistent), the head slot comes from a per-
+        # stripe-locked min-arrival scan; a racing submit just makes the
+        # next poll flush — the policy is a timer, not an invariant
+        if not self._pending:
+            return False
+        if len(self._pending) >= self.config.max_batch:
+            return True
+        oldest = self._pending.oldest_enqueue_t()
+        if oldest is None:
+            return False
+        return (self._clock() - oldest) * 1e3 >= self.config.max_delay_ms
 
     def pump(self) -> int:
         """Apply the flush policy once: flush iff ``max_batch`` or
@@ -1132,18 +1548,16 @@ class ServeEngine:
         pending slots into a new flush, fix its bucket, and — when late
         admission is on and the bucket left pad slack — PUBLISH it so
         `submit` can fill the slack until `_seal_assembled` closes it
-        (typically while this flush waits for an in-flight window slot)."""
-        with self._lock:
+        (typically while this flush waits for an in-flight window slot).
+
+        Lock order (round 20): every stripe lock, THEN ``_lock`` — the
+        drain must see a frozen pending queue across all stripes, and the
+        striped hierarchy puts stripes strictly before the engine lock."""
+        with self._pending.all_locks(), self._lock:
             if not self._pending:
                 return None
             keys = self._drain_keys_locked()
-            slots = [self._pending.pop(k) for k in keys]
-            for s in slots:
-                n = self._pending_tenant.get(s.tenant, 1) - 1
-                if n > 0:
-                    self._pending_tenant[s.tenant] = n
-                else:
-                    self._pending_tenant.pop(s.tenant, None)
+            slots = [self._pending.pop_unlocked(k) for k in keys]
             self._inflight.update(zip(keys, slots))
             # params snapshot: the fence in update_params guarantees no
             # swap lands while this flush is in flight, so the snapshot and
@@ -1159,11 +1573,14 @@ class ServeEngine:
                 # the caller holds _seq, so the index _seal_assembled will
                 # draw is exactly the next one
                 fl.fid = self._dispatch_index + 1
-                for k, slot in zip(keys, slots):
-                    # a = the NODE id per the EVENT_KINDS contract (a
-                    # temporal key is a (node, t_bucket) tuple)
-                    jr.emit("assemble", slot.rid, fl.fid,
-                            k[0] if isinstance(k, tuple) else k)
+                # a = the NODE id per the EVENT_KINDS contract (a
+                # temporal key is a (node, t_bucket) tuple); one batched
+                # ring append for the whole drain (round 20)
+                jr.record_many([
+                    ("assemble", slot.rid, fl.fid,
+                     k[0] if isinstance(k, tuple) else k, 0)
+                    for k, slot in zip(keys, slots)
+                ])
                 jr.emit("flush", -1, fl.fid, len(keys), fl.bucket)
             if self.config.late_admission and len(keys) < fl.bucket:
                 self._open = fl
@@ -1186,6 +1603,17 @@ class ServeEngine:
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
         try:
             fl.seeds, extras = self._flush_arrays(fl)
+            # array-native slot views (round 20): sealed composition as
+            # int arrays — late admits included, addressed by slot index
+            fl.ids = fl.seeds
+            fl.rids = np.fromiter(
+                (s.rid for s in fl.slots), np.int64, len(fl.slots)
+            )
+            tix = self._tenant_ids
+            fl.tenant_ix = np.fromiter(
+                (tix.setdefault(s.tenant, len(tix)) for s in fl.slots),
+                np.int32, len(fl.slots),
+            )
             if self.config.max_in_flight == 1 and not extras:
                 # serial mode: reuse one pad buffer per bucket (round-8
                 # behavior); with in-flight > 1 each flush owns its buffer
@@ -1266,7 +1694,7 @@ class ServeEngine:
             now = t_res0 = self._clock()
             for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
                 self._inflight.pop(k, None)
-                if slot.event.is_set():
+                if slot.resolved:
                     # abandoned by a bounded stop() drain: the error was
                     # delivered and the waiters counted — a late
                     # completion must not overwrite it or double-count
@@ -1378,13 +1806,16 @@ class ServeEngine:
         return self._buckets[-1]
 
     def _drain_keys_locked(self) -> List[int]:
+        # materialize the striped store as one arrival-ordered dict: the
+        # weighted drain sees exactly the FIFO the round-15 single-dict
+        # queue presented (slot.seq is the global arrival stamp)
         return weighted_drain_keys(
-            self._pending, self.config.max_batch, self.config.tenant_weights
+            self._pending.ordered_dict_unlocked(),
+            self.config.max_batch, self.config.tenant_weights,
         )
 
     def _drainable(self) -> bool:
-        with self._lock:
-            return bool(self._pending)
+        return bool(self._pending)
 
     # -- flush-ahead prefetch (round 18, ROADMAP item 3a) ------------------
 
@@ -1672,21 +2103,28 @@ class ServeEngine:
         yet dispatched) slots are re-stamped to the new version — their
         flush will compute under the new weights. Requests resolved by the
         drained in-flight flushes were accepted under the old weights and
-        keep their old-version results (never cached past the bump)."""
+        keep their old-version results (never cached past the bump).
+
+        Lock order (round 20): stripes before ``_lock`` — the fence wait
+        releases only ``_lock`` while the stripe locks stay held, so
+        submits park at stripe acquire (holding nothing) and resolves
+        (which need only ``_lock``) drain freely: no cycle."""
         with self._seq:
-            with self._fence:
-                while self._inflight_flushes:
-                    self._fence.wait()
-                # a prefetch issued for a pre-fence flush may still be in
-                # flight: drop the staging (bytes stay valid forever, but
-                # the rows' consumers are gone — holding them would only
-                # skew waste accounting). Never blocks on the pool.
-                self._cancel_prefetch()
-                self._params = params
-                self.params_version += 1
-                self.cache.invalidate()
-                for slot in self._pending.values():
-                    slot.version = self.params_version
+            with self._pending.all_locks():
+                with self._fence:
+                    while self._inflight_flushes:
+                        self._fence.wait()
+                    # a prefetch issued for a pre-fence flush may still be
+                    # in flight: drop the staging (bytes stay valid
+                    # forever, but the rows' consumers are gone — holding
+                    # them would only skew waste accounting). Never blocks
+                    # on the pool.
+                    self._cancel_prefetch()
+                    self._params = params
+                    self.params_version += 1
+                    self.cache.invalidate()
+                    for slot in self._pending.values_unlocked():
+                        slot.version = self.params_version
 
     # -- streaming graph deltas (round 17; quiver_tpu.stream) --------------
 

@@ -77,6 +77,40 @@ class DeltaTrace(NamedTuple):
                 e += 1
             yield ("request", i, int(node))
 
+    def request_bursts(self):
+        """Array-at-a-time replay schedule (round 20): yields ``("edges",
+        src_row, dst_row)`` events and ``("requests", start_index,
+        node_array)`` BURSTS — each burst the maximal run of requests
+        between consecutive edge events, the natural `submit_many` unit.
+        Same commit order as `events` (an ``("edges", ...)`` that lands
+        before request ``i`` is yielded before the burst containing
+        ``i``), so a batched driver observes the identical schedule."""
+        for kind, start, end, e in _burst_spans(self.requests.shape[0],
+                                                self.edge_pos):
+            if kind == "edges":
+                yield ("edges", self.edge_src[e], self.edge_dst[e])
+            else:
+                yield ("requests", start, self.requests[start:end])
+
+
+def _burst_spans(n_requests: int, edge_pos: np.ndarray):
+    """The shared burst walk behind both ``request_bursts`` spellings:
+    yields ``("edges", -1, -1, event_index)`` and ``("requests", start,
+    end, -1)`` spans in commit order (an event at position ``p`` fires
+    before the burst starting at ``p``)."""
+    e = 0
+    n_events = int(edge_pos.shape[0])
+    i = 0
+    while i < n_requests:
+        while e < n_events and int(edge_pos[e]) == i:
+            yield ("edges", -1, -1, e)
+            e += 1
+        end = int(edge_pos[e]) if e < n_events else n_requests
+        end = min(max(end, i + 1), n_requests)
+        yield ("requests", i, end, -1)
+        i = end
+    # like `events`: edge positions at/after n_requests never fire
+
 
 def delta_interleaved_trace(
     n_nodes: int,
@@ -146,6 +180,21 @@ class TemporalTrace(NamedTuple):
                        self.edge_ts[e])
                 e += 1
             yield ("request", i, int(node), float(self.t_query[i]))
+
+    def request_bursts(self):
+        """Array-at-a-time schedule (round 20, see
+        `DeltaTrace.request_bursts`): yields ``("edges", src_row,
+        dst_row, ts_row)`` events and ``("requests", start_index,
+        node_array, t_array)`` bursts — node ids with their aligned query
+        times, ready for ``submit_many(ids, t=ts)``."""
+        for kind, start, end, e in _burst_spans(self.requests.shape[0],
+                                                self.edge_pos):
+            if kind == "edges":
+                yield ("edges", self.edge_src[e], self.edge_dst[e],
+                       self.edge_ts[e])
+            else:
+                yield ("requests", start, self.requests[start:end],
+                       self.t_query[start:end])
 
 
 def temporal_trace(

@@ -667,6 +667,27 @@ class EventJournal:
             self.dropped += 1
         ev.append((self.clock(), kind, rid, fid, a, b))
 
+    def record_many(self, events) -> None:
+        """Batched append (round 20): one clock read + one ``deque.extend``
+        covering N events — the journal half of the vectorized submit
+        path (`ServeEngine.submit_many` journals a whole admission chunk
+        through here instead of N ``emit`` calls). ``events`` is a
+        sequence of ``(kind, rid, fid, a, b)`` tuples; every entry lands
+        with the SAME timestamp (they are one host-path action).
+        `request_breakdown` reads these identically to emitted events —
+        per-stage deltas just collapse to zero within a chunk, exactly
+        what one batched admission costs."""
+        if not self.enabled or not events:
+            return
+        ev = self._events
+        overflow = len(ev) + len(events) - self.capacity
+        if overflow > 0:
+            self.dropped += overflow
+        t = self.clock()
+        ev.extend(
+            (t, kind, rid, fid, a, b) for kind, rid, fid, a, b in events
+        )
+
     def __len__(self) -> int:
         return len(self._events)
 
@@ -778,6 +799,9 @@ class _NullJournal(EventJournal):
         super().__init__(capacity=1, enabled=False)
 
     def emit(self, *_a, **_k) -> None:
+        return
+
+    def record_many(self, *_a, **_k) -> None:
         return
 
 

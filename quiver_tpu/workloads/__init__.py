@@ -32,6 +32,7 @@ from .serving import (
     TemporalDistServeEngine,
     TemporalServeEngine,
     quantize_t,
+    quantize_t_many,
     replay_temporal_fleet_oracle,
     replay_temporal_log,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "TemporalTiledGraph",
     "host_masked_oracle",
     "quantize_t",
+    "quantize_t_many",
     "replay_temporal_fleet_oracle",
     "replay_temporal_log",
     "temporal_sample_dense",

@@ -75,6 +75,7 @@ __all__ = [
     "TemporalDistServeEngine",
     "TemporalServeEngine",
     "quantize_t",
+    "quantize_t_many",
     "replay_temporal_fleet_oracle",
     "replay_temporal_log",
 ]
@@ -113,6 +114,33 @@ def quantize_t(t: float, quantum: float) -> float:
     if snapped == float(np.float32(t)):
         return snapped  # t is (a float32 round-trip of) a bucket value
     return float(np.float32(math.floor(x) * quantum))
+
+
+def quantize_t_many(t, quantum: float) -> np.ndarray:
+    """`quantize_t` over an ARRAY of query times (round 20): the batch
+    submit path quantizes its whole t vector in a handful of numpy ops
+    instead of one scalar float32 round-trip per request. Element-wise
+    EQUAL to the scalar rule — same banker's rounding (`np.rint` ==
+    Python `round`), same float32 grid snap, same nearest-bucket-first
+    idempotence check (NEVER an epsilon nudge — the docstring above
+    explains why both nudges mis-bucket), same non-finite/`quantum <= 0`
+    passthrough — pinned across the f32 grid in tests/test_frontend.py.
+    Returns float64 ``[n]`` (bucket values, float32-rounded like the
+    scalar's return)."""
+    tv = np.asarray(t, np.float64).reshape(-1).copy()
+    if quantum <= 0:
+        return tv
+    finite = np.isfinite(tv)
+    if not finite.any():
+        return tv
+    tf = tv[finite]
+    x = tf / quantum
+    j = np.rint(x)  # round-half-to-even, bit-matching Python round()
+    snapped = (j * quantum).astype(np.float32).astype(np.float64)
+    t32 = tf.astype(np.float32).astype(np.float64)
+    floored = (np.floor(x) * quantum).astype(np.float32).astype(np.float64)
+    tv[finite] = np.where(snapped == t32, snapped, floored)
+    return tv
 
 
 class _PairServing:
@@ -194,11 +222,26 @@ class TemporalServeEngine(_PairServing, ServeEngine):
                tenant: Optional[str] = None) -> ServeResult:
         """`ServeEngine.submit` with the request key extended by the
         query-time bucket: cache hits, coalescing, shedding, and late
-        admission all happen per ``(node, t_bucket)`` — the ONE base
-        `_submit_keyed` body, so the pinned admission sequence can never
-        drift between workloads."""
-        node = int(node_id)
-        return self._submit_keyed((node, self._tq(t)), node, tenant)
+        admission all happen per ``(node, t_bucket)`` — `submit_many` of
+        ONE through the shared `_admit_one_locked` body, so the pinned
+        admission sequence can never drift between workloads."""
+        return self.submit_many(
+            (node_id,), t=None if t is None else (t,), tenant=tenant
+        )[0]
+
+    def submit_many(self, node_ids, t=None, tenant=None
+                    ) -> List[ServeResult]:
+        """`ServeEngine.submit_many` with the t axis: the whole batch's
+        query times quantize in ONE vectorized `quantize_t_many` pass
+        (bit-equal to per-request `quantize_t` — the composite keys, and
+        therefore cache/coalesce decisions and the dispatch log, are
+        identical to N scalar submits). ``t`` is None (+inf), scalar, or
+        aligned with ``node_ids``."""
+        ids = np.asarray(node_ids, np.int64).reshape(-1)
+        tq = quantize_t_many(_aligned_t(t, ids.shape[0]), self.t_quantum)
+        nodes = ids.tolist()
+        keys = list(zip(nodes, tq.tolist()))
+        return self._submit_keyed_many(keys, nodes, tenant)
 
     def predict(self, node_ids, t=None, timeout: Optional[float] = None,
                 tenants: Optional[Sequence[str]] = None) -> np.ndarray:
@@ -210,11 +253,7 @@ class TemporalServeEngine(_PairServing, ServeEngine):
             raise ValueError(
                 f"tenants has {len(tenants)} entries for {ids.shape[0]} ids"
             )
-        handles = [
-            self.submit(i, t=tv[j],
-                        tenant=None if tenants is None else tenants[j])
-            for j, i in enumerate(ids)
-        ]
+        handles = self.submit_many(ids, t=tv, tenant=tenants)
         if not handles:
             return np.zeros((0, 0), np.float32)
         if not self._running:
@@ -238,15 +277,14 @@ class TemporalServeEngine(_PairServing, ServeEngine):
         raise RuntimeError("temporal serving is fused-only")  # unreachable
 
     def _prefetch_pending(self) -> None:
-        # base walks self._pending.keys() as seed ids; temporal keys are
-        # (node, t) pairs — walk the nodes
-        with self._lock:
-            keys = tuple(k[0] for k in self._pending.keys())
+        # base walks the pending keys as seed ids; temporal keys are
+        # (node, t) pairs — walk the nodes, memo the composite keys
+        keys = self._pending.ordered_keys()
         if not keys:
             return
         try:
-            self.prefetch_seeds(np.asarray(keys, np.int64))
-            self._pf_walked = frozenset(self._pending.keys())
+            self.prefetch_seeds(np.asarray([k[0] for k in keys], np.int64))
+            self._pf_walked = frozenset(keys)
         except Exception:
             pass
 
@@ -466,15 +504,31 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
 
     def submit(self, node_id: int, t: Optional[float] = None,
                tenant: Optional[str] = None) -> ServeResult:
-        """`DistServeEngine.submit` keyed by ``(node, t_bucket)`` — the
-        base `_submit_keyed` body, so router and single-host temporal
-        admission can never drift (the hosts=1 parity pin)."""
-        node = int(node_id)
-        if not 0 <= node < self.global2host.shape[0]:
+        """`DistServeEngine.submit` keyed by ``(node, t_bucket)`` —
+        `submit_many` of ONE through the base `_admit_one_locked` body,
+        so router and single-host temporal admission can never drift
+        (the hosts=1 parity pin)."""
+        return self.submit_many(
+            (node_id,), t=None if t is None else (t,), tenant=tenant
+        )[0]
+
+    def submit_many(self, node_ids, t=None, tenant=None
+                    ) -> List[ServeResult]:
+        """`DistServeEngine.submit_many` with the t axis: vectorized
+        id-range validation up front, then one `quantize_t_many` pass
+        over the batch's query times — composite keys (and the router
+        dispatch log) bit-identical to N scalar submits."""
+        ids = np.asarray(node_ids, np.int64).reshape(-1)
+        n_ids = self.global2host.shape[0]
+        bad = (ids < 0) | (ids >= n_ids)
+        if bad.any():
             raise ValueError(
-                f"node id {node} outside [0, {self.global2host.shape[0]})"
+                f"node id {int(ids[bad][0])} outside [0, {n_ids})"
             )
-        return self._submit_keyed((node, self._tq(t)), node, tenant)
+        tq = quantize_t_many(_aligned_t(t, ids.shape[0]), self.t_quantum)
+        nodes = ids.tolist()
+        keys = list(zip(nodes, tq.tolist()))
+        return self._submit_keyed_many(keys, nodes, tenant)
 
     def predict(self, node_ids, t=None, timeout: Optional[float] = None,
                 tenants: Optional[Sequence[str]] = None) -> np.ndarray:
@@ -484,11 +538,7 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
             raise ValueError(
                 f"tenants has {len(tenants)} entries for {ids.shape[0]} ids"
             )
-        handles = [
-            self.submit(i, t=tv[j],
-                        tenant=None if tenants is None else tenants[j])
-            for j, i in enumerate(ids)
-        ]
+        handles = self.submit_many(ids, t=tv, tenant=tenants)
         if not handles:
             return np.zeros((0, self.out_dim), np.float32)
         if not self._running:
@@ -514,11 +564,26 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
             tvec = np.asarray([k[1] for k in fl.keys], np.float32)
             fl.extra = tvec
             fl.tenants = [s.tenant for s in fl.slots]
+            fl.ids = arr
+            fl.rids = np.fromiter(
+                (s.rid for s in fl.slots), np.int64, len(fl.slots)
+            )
+            tix = self._tenant_index
+            fl.tenant_ix = np.fromiter(
+                (tix.get(tn, -1) for tn in fl.tenants), np.int32,
+                len(fl.tenants),
+            )
             owners = self.global2host[arr].astype(np.int64)
-            for h in range(self.hosts):
-                pos = np.nonzero(owners == h)[0]
-                if pos.size:
-                    fl.split.append((h, arr[pos], pos))
+            # one owner partition via stable argsort (round 20), mirroring
+            # the base seal: hosts ascending, positions ascending within
+            if arr.size:
+                order = np.argsort(owners, kind="stable")
+                so = owners[order]
+                cuts = np.nonzero(np.diff(so))[0] + 1
+                for pos in np.split(order, cuts):
+                    h = int(owners[pos[0]])
+                    if 0 <= h < self.hosts:
+                        fl.split.append((h, arr[pos], pos))
             if self.config.record_dispatches:
                 self.dispatch_log.append(
                     (arr.copy(),

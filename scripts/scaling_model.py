@@ -84,8 +84,31 @@ def main():
     ap.add_argument("--lp-head-us", type=float, default=None,
                     help="pair scoring-head cost per pair (us; bench "
                          "lp_head_s)")
+    # round 20: host-side admission cost. serve_table caps every QPS row
+    # at the serial submit-path rate 1e6/host_submit_us when > 0.
+    ap.add_argument("--frontend", default=None,
+                    help="host submit cost: a float (us/request) or a "
+                         "FRONTEND_r01.json path (reads host_submit_us, "
+                         "measured by scripts/bench_frontend.py)")
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
+
+    host_submit_us = 0.0
+    host_submit_source = (
+        "none (analytic: no host admission cap — pass --frontend)"
+    )
+    if args.frontend:
+        try:
+            host_submit_us = float(args.frontend)
+            host_submit_source = f"--frontend {host_submit_us}"
+        except ValueError:
+            with open(args.frontend) as fh:
+                fr = json.load(fh)
+            host_submit_us = float(fr["host_submit_us"])
+            host_submit_source = (
+                f"{args.frontend} host_submit_us (measured, "
+                "scripts/bench_frontend.py)"
+            )
 
     step_s = (args.step_ms or 0) / 1e3
     source = f"--step-ms {args.step_ms}"
@@ -121,6 +144,12 @@ def main():
             args.lp_step_ms = ctx["temporal_step_s"] * 1e3
         if args.lp_head_us is None and ctx.get("lp_head_s") is not None:
             args.lp_head_us = ctx["lp_head_s"] * 1e6
+        if not host_submit_us and ctx.get("host_submit_us"):
+            host_submit_us = float(ctx["host_submit_us"])
+            host_submit_source = (
+                f"{args.bench} context host_submit_us (measured, "
+                "bench.py serve)"
+            )
     if not step_s:
         step_s = 0.0415  # PERF_NOTES.md round-4 measured products step (fused, floor-corrected)
         source = "PERF_NOTES.md round-4 default 41.5 ms"
@@ -181,6 +210,7 @@ def main():
             serve_sample_s, 0.0, serve_forward_s, ref_batch=serve_ref_batch,
             buckets=(64, 256, 1024), hit_rates=(0.0, 0.5, 0.9),
             unique_frac=0.8, max_delay_ms=2.0,
+            host_submit_us=host_submit_us,
         )
         serve_cost_note = (
             "Device cost per dispatch is the MEASURED eval-shaped split "
@@ -194,6 +224,7 @@ def main():
         serve_rows = serve_table(
             step_s, 0.0, 0.0, ref_batch=1024, buckets=(64, 256, 1024),
             hit_rates=(0.0, 0.5, 0.9), unique_frac=0.8, max_delay_ms=2.0,
+            host_submit_us=host_submit_us,
         )
         serve_cost_note = (
             "Device cost per dispatch is the measured TRAIN step at batch "
@@ -254,6 +285,7 @@ def main():
             buckets=(256,), hit_rates=(0.0, 0.5), unique_frac=0.8,
             max_delay_ms=2.0, hosts=hosts, out_dim=args.serve_out_dim,
             bandwidths={"dcn_bytes_per_s": args.dcn_gbps * 1e9},
+            host_submit_us=host_submit_us,
         )
     serve_dist_md = (
         "## Distributed serving: predicted aggregate QPS vs host count "
@@ -494,6 +526,8 @@ def main():
         "serve_sample_s": serve_sample_s,
         "serve_forward_s": serve_forward_s,
         "serve_overhead_s": serve_overhead_s,
+        "host_submit_us": host_submit_us,
+        "host_submit_source": host_submit_source,
         "rows": [r._asdict() for r in rows],
         "sharded_fetch": [r._asdict() for r in fetch_rows],
         "quant_fetch": [r._asdict() for r in quant_rows],

@@ -1004,7 +1004,9 @@ def main():
             return dist
 
         def serve_seq(dist, trace, timeout=300):
-            handles = [dist.submit(int(nid)) for nid in trace]
+            # array-at-a-time replay (round 20): submit_many makes the
+            # same admission decisions as the per-request loop, pinned
+            handles = dist.submit_many(np.asarray(trace, np.int64))
             while dist._drainable():
                 dist.flush()
             out = []
@@ -1223,10 +1225,11 @@ def main():
             return dist
 
         def serve_seq(dist, trace, timeout=300):
-            """Deterministic sequential drive; returns (rows|exceptions)
-            per request — predict() would re-raise the first per-request
-            error, and the parity comparison wants every outcome."""
-            handles = [dist.submit(int(nid)) for nid in trace]
+            """Deterministic array-at-a-time drive; returns
+            (rows|exceptions) per request — predict() would re-raise the
+            first per-request error, and the parity comparison wants
+            every outcome."""
+            handles = dist.submit_many(np.asarray(trace, np.int64))
             while dist._drainable():
                 dist.flush()
             out = []

@@ -1,0 +1,497 @@
+"""Vectorized host path tests (round 20): `submit_many` + sharded router
+state.
+
+The contract under test, per docs/api.md "Batch submission & host path":
+
+- `submit_many(ids)` is BIT-IDENTICAL to the same ids through scalar
+  `submit` — same served rows, same dispatch log, same journal event
+  stream (modulo timestamps), same rid draws — at max_in_flight 1/2,
+  hosts 1/2, late admission on/off, mixed tenants, and temporal ``t``;
+- the striped pending queues lose nothing under concurrency: 8 threads
+  driving scalar and batch submits concurrently resolve every handle,
+  draw every rid exactly once, and every served row still bit-matches
+  the offline `batch_logits` replay of the dispatch log;
+- ShedError / tenant-quota decisions through the batch path are the
+  scalar decisions: same shed indices, same `shed_log`, same messages;
+- `quantize_t_many` equals element-wise scalar `quantize_t` across the
+  f32 grid (incl. the t/quantum ~1e3 degraded-grid gotcha and
+  non-finite passthrough);
+- `EventJournal.record_many` is emit-loop-equal under a pinned clock,
+  counts overflow, and `request_breakdown()` still accounts for every
+  request driven through `submit_many`;
+- `request_bursts()` flattens to the exact `events()` schedule.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.inference import _cached_apply, batch_logits
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    DeltaTrace,
+    DistServeConfig,
+    DistServeEngine,
+    ServeConfig,
+    ServeEngine,
+    temporal_trace,
+    zipfian_trace,
+)
+from quiver_tpu.serve.engine import ShedError
+from quiver_tpu.serve.trace_gen import delta_interleaved_trace
+from quiver_tpu.trace import NULL_JOURNAL, EventJournal
+from quiver_tpu.workloads import (
+    TemporalDistServeEngine,
+    TemporalServeEngine,
+    TemporalTiledGraph,
+    quantize_t,
+    quantize_t_many,
+)
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+EDGE_INDEX = make_random_graph(N_NODES, 2000, seed=0)
+
+
+def make_sampler():
+    return GraphSageSampler(
+        CSRTopo(edge_index=EDGE_INDEX), sizes=SIZES, mode="TPU", seed=SAMPLER_SEED
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    sampler = make_sampler()
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_engine(setup, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    return ServeEngine(model, params, make_sampler(), feat, ServeConfig(**cfg_kw))
+
+
+def make_dist(setup, hosts, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("cache_entries", 512)
+    return DistServeEngine.build(
+        model, params, CSRTopo(edge_index=EDGE_INDEX), feat, SIZES,
+        hosts=hosts, config=DistServeConfig(hosts=hosts, **cfg_kw),
+        sampler_seed=SAMPLER_SEED,
+    )
+
+
+def drain(engine):
+    while engine._drainable():
+        engine.flush()
+
+
+def rows_of(handles, timeout=60):
+    return np.stack([h.result(timeout=timeout) for h in handles])
+
+
+def assert_same_dispatch_log(a, b):
+    assert len(a.dispatch_log) == len(b.dispatch_log)
+    for ea, eb in zip(a.dispatch_log, b.dispatch_log):
+        assert len(ea) == len(eb)
+        # (padded, nvalid) or (padded, nvalid, tvals): compare every field
+        for fa, fb in zip(ea, eb):
+            if isinstance(fa, np.ndarray):
+                assert np.array_equal(fa, fb)
+            else:
+                assert fa == fb
+
+
+# -- scalar/batch bit-parity --------------------------------------------------
+
+@pytest.mark.parametrize("mif,late", [(1, False), (2, False), (1, True)])
+def test_engine_submit_many_bit_parity(setup, mif, late):
+    """One submit_many call == the same ids through scalar submit: rows,
+    dispatch log, and the journal event stream (timestamps aside) are
+    bit-identical — across in-flight windows and late admission."""
+    trace = zipfian_trace(N_NODES, 48, alpha=0.9, seed=11)
+    tenants = [None if i % 3 else "T" for i in range(len(trace))]
+    kw = dict(max_in_flight=mif, late_admission=late, cache_entries=64,
+              journal_events=4096)
+    a = make_engine(setup, **kw)
+    b = make_engine(setup, **kw)
+    ha = [a.submit(int(n), tenant=tn) for n, tn in zip(trace, tenants)]
+    hb = b.submit_many(trace, tenant=tenants)
+    drain(a)
+    drain(b)
+    assert np.array_equal(rows_of(ha), rows_of(hb))
+    assert_same_dispatch_log(a, b)
+    # identical admission stream: same kinds, rids, fids, payloads, order
+    # (timestamps aside; window_wait carries a measured duration, skip it)
+    ev_a = [e[1:] for e in a.journal.snapshot() if e[1] != "window_wait"]
+    ev_b = [e[1:] for e in b.journal.snapshot() if e[1] != "window_wait"]
+    assert ev_a == ev_b
+    assert a.stats.requests == b.stats.requests == len(trace)
+    assert a.stats.cache.hits == b.stats.cache.hits
+
+
+@pytest.mark.parametrize("hosts", [1, 2])
+def test_dist_submit_many_bit_parity(setup, hosts):
+    """The router's batch path: one argsort owner-partition per flush
+    must reproduce the per-request routing bit for bit — router split
+    log AND every shard engine's dispatch log."""
+    trace = zipfian_trace(N_NODES, 40, alpha=0.9, seed=13)
+    a = make_dist(setup, hosts=hosts)
+    b = make_dist(setup, hosts=hosts)
+    ha = [a.submit(int(n)) for n in trace]
+    hb = b.submit_many(trace)
+    drain(a)
+    drain(b)
+    assert np.array_equal(rows_of(ha), rows_of(hb))
+    assert len(a.dispatch_log) == len(b.dispatch_log)
+    for (ra, sa), (rb, sb) in zip(a.dispatch_log, b.dispatch_log):
+        assert np.array_equal(ra, rb)
+        assert len(sa) == len(sb)
+        for (h0, i0), (h1, i1) in zip(sa, sb):
+            assert h0 == h1 and np.array_equal(i0, i1)
+    for h in range(hosts):
+        assert_same_dispatch_log(a.engines[h], b.engines[h])
+
+
+def test_submit_is_submit_many_of_one(setup):
+    """The scalar API stays: submit(n) == submit_many((n,))[0] with the
+    same handle semantics."""
+    eng = make_engine(setup)
+    h1 = eng.submit(3)
+    h2 = eng.submit_many([4])[0]
+    drain(eng)
+    assert h1.result(timeout=60) is not None
+    assert h2.result(timeout=60) is not None
+    with pytest.raises(TypeError):
+        eng.submit_many([1, 2], t=[0.0, 1.0])  # t= is temporal-only
+
+
+def test_submit_many_validation(setup):
+    eng = make_engine(setup)
+    assert eng.submit_many([]) == []
+    with pytest.raises(ValueError, match="tenants has"):
+        eng.submit_many([1, 2, 3], tenant=["A", "B"])
+    dist = make_dist(setup, hosts=2)
+    # whole-batch up-front rejection: nothing admitted
+    with pytest.raises(ValueError, match="outside"):
+        dist.submit_many([1, N_NODES, 2])
+    assert dist.stats.requests == 0
+    with pytest.raises(TypeError):
+        dist.submit_many([1], t=[5.0])
+
+
+# -- striped-lock concurrency -------------------------------------------------
+
+def replay_oracle(setup, engine):
+    model, params, feat = setup
+    apply = _cached_apply(model)
+    ref_sampler = make_sampler()
+    served = {}
+    for padded, nvalid in engine.dispatch_log:
+        logits = np.asarray(batch_logits(apply, params, ref_sampler, feat, padded))
+        for i in range(nvalid):
+            served.setdefault(int(padded[i]), logits[i])
+    return served
+
+
+def test_striped_concurrent_submit_exactness(setup):
+    """8 threads — half scalar, half batch — over disjoint id ranges:
+    no lost or duplicated rids, every handle resolves, and every row
+    still bit-matches the offline replay of the dispatch log."""
+    eng = make_engine(setup, max_in_flight=1, journal_events=1 << 15)
+    parts = np.array_split(np.arange(N_NODES, dtype=np.int64), 8)
+    handles = [None] * 8
+    errs = []
+
+    def worker(k):
+        try:
+            if k % 2:
+                handles[k] = [eng.submit(int(n)) for n in parts[k]]
+            else:
+                handles[k] = list(eng.submit_many(parts[k]))
+        except Exception as ex:  # pragma: no cover - failure reporting
+            errs.append(ex)
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+    drain(eng)
+    assert eng.stats.requests == N_NODES
+    # every distinct id drew exactly one rid — nothing lost, nothing doubled
+    rids = [e[2] for e in eng.journal.snapshot()
+            if e[1] in ("submit", "late_admit")]
+    assert len(rids) == N_NODES and len(set(rids)) == N_NODES
+    served = replay_oracle(setup, eng)
+    for hs, part in zip(handles, parts):
+        for h, n in zip(hs, part):
+            assert np.array_equal(h.result(timeout=60), served[int(n)])
+
+
+# -- shed / tenant quota parity -----------------------------------------------
+
+@pytest.mark.parametrize("mode", ["uniform", "per_element"])
+def test_shed_and_tenant_quota_parity_batch(setup, mode):
+    """The batch path sheds EXACTLY where the scalar path sheds: same
+    indices, same shed_log (requests-counter stamps included), same
+    ShedError messages — decisions are made per element, in order."""
+    def drive(batched):
+        eng = make_engine(setup, max_batch=4, max_queue_depth=4,
+                          tenant_weights={"A": 1.0, "B": 1.0})
+        eng.flush = lambda: 0  # let the queue build past the depth bound
+        if not batched:
+            handles = [eng.submit(i, tenant="A") for i in range(5)]
+            handles += [eng.submit(10 + i, tenant="B") for i in range(3)]
+        elif mode == "uniform":
+            handles = list(eng.submit_many(np.arange(5), tenant="A"))
+            handles += list(eng.submit_many(np.arange(10, 13), tenant="B"))
+        else:
+            handles = list(eng.submit_many(
+                [0, 1, 2, 3, 4, 10, 11, 12],
+                tenant=["A"] * 5 + ["B"] * 3,
+            ))
+        return eng, handles
+
+    s_eng, s_h = drive(False)
+    b_eng, b_h = drive(True)
+    shed_s = [i for i, h in enumerate(s_h) if isinstance(h.error(), ShedError)]
+    shed_b = [i for i, h in enumerate(b_h) if isinstance(h.error(), ShedError)]
+    assert shed_s == shed_b == [4, 7]
+    assert list(s_eng.shed_log) == list(b_eng.shed_log)
+    for i in (4, 7):
+        assert str(s_h[i].error()) == str(b_h[i].error())
+    assert s_eng.stats.shed == b_eng.stats.shed == 2
+
+
+def test_dist_tenant_quota_batch(setup):
+    """Router-side weighted shed through submit_many mirrors the scalar
+    router admission."""
+    dist = make_dist(setup, hosts=2, max_queue_depth=4,
+                     tenant_weights={"gold": 3.0, "free": 1.0})
+    real_flush = dist.flush
+    dist.flush = lambda: 0
+    handles = dist.submit_many(np.arange(5), tenant="free")
+    dist.flush = real_flush
+    assert isinstance(handles[-1].error(), ShedError)
+    assert dist.stats.shed == 1 and dist.shed_log[0][1] == "free"
+    gold = dist.submit_many(np.array([100]), tenant="gold")[0]
+    assert gold.error() is None
+    drain(dist)
+    for h in handles[:-1]:
+        assert h.result(timeout=60) is not None
+
+
+# -- temporal submit_many -----------------------------------------------------
+
+T_DIM = 12
+T_SIZES = [3, 3]
+T_SEED = 5
+T_MAXD = 128
+T_TOPO = CSRTopo(edge_index=make_random_graph(N_NODES, 1400, seed=0))
+T_BASE_TS = np.random.default_rng(11).uniform(
+    0.0, 50.0, T_TOPO.indices.shape[0]
+).astype(np.float32)
+
+
+def make_temporal_sampler():
+    s = GraphSageSampler(T_TOPO, sizes=T_SIZES, mode="TPU", seed=T_SEED,
+                         dedup=False, max_deg=T_MAXD)
+    return s.bind_temporal(TemporalTiledGraph(T_TOPO, T_BASE_TS), recency=0.02)
+
+
+@pytest.fixture(scope="module")
+def tsetup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, T_DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    s0 = make_temporal_sampler()
+    ds0 = s0.sample_dense(np.arange(8, dtype=np.int64), t=100.0)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((ds0.n_id.shape[0], T_DIM)), ds0.adjs
+    )
+    return model, params, feat
+
+
+def make_tengine(tsetup, **cfg_kw):
+    model, params, feat = tsetup
+    cfg = ServeConfig(max_batch=8, buckets=(4, 8), max_delay_ms=1e9,
+                      record_dispatches=True, **cfg_kw)
+    return TemporalServeEngine(model, params, make_temporal_sampler(), feat,
+                               cfg, t_quantum=4.0)
+
+
+def test_temporal_submit_many_bit_parity(tsetup):
+    """submit_many(ids, t=ts) vs per-request submit(node, t): the
+    vectorized quantizer and composite (node, t) keys must not move a
+    single draw — rows and (padded, nvalid, tvals) logs bit-match."""
+    tr = temporal_trace(N_NODES, 32, seed=9, qps=50.0, t0=60.0)
+    a = make_tengine(tsetup)
+    b = make_tengine(tsetup)
+    ha = [a.submit(int(n), t=float(t))
+          for n, t in zip(tr.requests, tr.t_query)]
+    hb = b.submit_many(tr.requests, t=tr.t_query)
+    drain(a)
+    drain(b)
+    assert np.array_equal(rows_of(ha), rows_of(hb))
+    assert_same_dispatch_log(a, b)
+
+
+def test_temporal_dist_submit_many_bit_parity(tsetup):
+    """hosts=2 temporal fleet: the batched owner split with composite
+    keys reproduces scalar routing on every shard."""
+    model, params, feat = tsetup
+
+    def build():
+        return TemporalDistServeEngine.build(
+            model, params, T_TOPO, T_BASE_TS, feat, T_SIZES, hosts=2,
+            config=DistServeConfig(
+                hosts=2, max_batch=8, max_delay_ms=1e9, exchange="host",
+                record_dispatches=True,
+                shard_config=ServeConfig(max_batch=8, buckets=(4, 8),
+                                         max_delay_ms=1e9,
+                                         record_dispatches=True),
+            ),
+            sampler_seed=T_SEED, recency=0.02, max_deg=T_MAXD, t_quantum=4.0,
+        )
+
+    tr = temporal_trace(N_NODES, 24, seed=21, qps=50.0, t0=60.0)
+    a = build()
+    b = build()
+    ha = [a.submit(int(n), t=float(t))
+          for n, t in zip(tr.requests, tr.t_query)]
+    hb = b.submit_many(tr.requests, t=tr.t_query)
+    drain(a)
+    drain(b)
+    assert np.array_equal(rows_of(ha), rows_of(hb))
+    for h in range(2):
+        assert_same_dispatch_log(a.engines[h], b.engines[h])
+
+
+def test_quantize_t_many_elementwise_equals_scalar():
+    """The vectorized quantizer is the scalar quantizer, element-wise —
+    across uniform times, exact grid points, the f32-degraded grid
+    (t/quantum ~1e3, the NEXT.md round-19 gotcha), and non-finite
+    passthrough."""
+    rng = np.random.default_rng(0)
+    specials = np.array([np.inf, -np.inf, np.nan, 0.0, -0.0,
+                         2.0 ** 53, -(2.0 ** 53), 1e300])
+    for q in (0.0, 1e-3, 0.1, 1.0, 8.0, 3600.0):
+        pools = [rng.uniform(0.0, 100.0, 64), specials]
+        if q > 0:
+            j = rng.integers(0, 5000, 64)
+            pools.append(j.astype(np.float64) * q)            # on-grid
+            pools.append((j + 1000).astype(np.float64) * q)   # f32-degraded
+            pools.append(rng.uniform(900.0, 1100.0, 64) * q)  # ~1e3 quanta out
+        for pool in pools:
+            arr = np.asarray(pool, np.float64)
+            out = quantize_t_many(arr, q)
+            ref = np.array([quantize_t(float(t), q) for t in arr], np.float64)
+            assert out.dtype == np.float64
+            assert np.array_equal(out, ref, equal_nan=True), (q, arr, out, ref)
+
+
+# -- journal batching ---------------------------------------------------------
+
+def test_record_many_emit_loop_equal_and_overflow():
+    evs = [("submit", i, -1, i, 0) for i in range(40)]
+    j1 = EventJournal(capacity=64, clock=lambda: 2.5)
+    for k, r, f, a, b in evs:
+        j1.emit(k, r, f, a, b)
+    j2 = EventJournal(capacity=64, clock=lambda: 2.5)
+    j2.record_many(evs)
+    assert j1.snapshot() == j2.snapshot()
+    # overflow is counted, newest events win, bound holds
+    j3 = EventJournal(capacity=16, clock=lambda: 0.0)
+    j3.record_many([("submit", i, -1, i, 0) for i in range(100)])
+    assert len(j3) == 16 and j3.dropped == 84
+    assert [e[2] for e in j3.snapshot()] == list(range(84, 100))
+    # the disabled journal swallows batches too
+    NULL_JOURNAL.record_many(evs)
+    assert len(NULL_JOURNAL) == 0
+
+
+def test_request_breakdown_accounts_batch_submits(setup):
+    """request_breakdown() output is unchanged by the batched admission
+    records: every submit_many request shows up exactly once."""
+    eng = make_engine(setup, journal_events=4096, cache_entries=64)
+    eng.warmup()
+    trace = zipfian_trace(N_NODES, 64, alpha=0.9, seed=7)
+    eng.submit_many(trace)
+    drain(eng)
+    bd = eng.journal.request_breakdown()
+    assert bd["flushes"] == eng.stats.dispatches > 0
+    assert bd["pad_frac"]["n"] == bd["flushes"]
+    assert bd["cache_hits"] == eng.stats.cache.hits
+    assert bd["requests"] + bd["cache_hits"] == len(trace)
+    for stage in ("queue_ms", "device_ms", "resolve_ms"):
+        assert bd[stage]["n"] > 0
+        assert bd[stage]["p99"] >= bd[stage]["p50"] >= 0.0
+
+
+# -- burst replay schedule ----------------------------------------------------
+
+def _flatten_delta(it):
+    flat = []
+    for ev in it:
+        if ev[0] == "edges":
+            flat.append(("edges", ev[1].tolist(), ev[2].tolist()))
+        elif ev[0] == "requests":
+            start, nodes = ev[1], ev[2]
+            flat.extend(("request", start + k, int(n))
+                        for k, n in enumerate(nodes))
+        else:
+            flat.append(("request", ev[1], int(ev[2])))
+    return flat
+
+
+def test_delta_request_bursts_match_events():
+    dt = delta_interleaved_trace(100, 97, seed=3, edge_every=8,
+                                 edges_per_event=2)
+    assert _flatten_delta(dt.request_bursts()) == _flatten_delta(dt.events())
+    # hand-built edge cases: double event at position 0, event mid-run
+    dt2 = DeltaTrace(requests=np.arange(10, dtype=np.int64),
+                     edge_pos=np.array([0, 0, 7], np.int64),
+                     edge_src=np.zeros((3, 2), np.int64),
+                     edge_dst=np.ones((3, 2), np.int64))
+    assert _flatten_delta(dt2.request_bursts()) == _flatten_delta(dt2.events())
+
+
+def test_temporal_request_bursts_match_events():
+    tr = temporal_trace(100, 90, seed=4, edge_every=16, edges_per_event=2)
+    flat, ref = [], []
+    for ev in tr.request_bursts():
+        if ev[0] == "edges":
+            flat.append(("edges", ev[1].tolist(), ev[2].tolist(),
+                         ev[3].tolist()))
+        else:
+            start, nodes, ts = ev[1], ev[2], ev[3]
+            flat.extend(("request", start + k, int(n), float(t))
+                        for k, (n, t) in enumerate(zip(nodes, ts)))
+    for ev in tr.events():
+        if ev[0] == "edges":
+            ref.append(("edges", ev[1].tolist(), ev[2].tolist(),
+                        ev[3].tolist()))
+        else:
+            ref.append(("request", ev[1], int(ev[2]), float(ev[3])))
+    assert flat == ref
