@@ -705,6 +705,32 @@ def bench_stream(context, n=50_000, deg=8, edges_per_commit=512, reps=5):
         f"({edges_per_commit} edges, {rows_per_commit:.0f} tile rows)"
     )
 
+    # round-21 lifecycle legs — the measured inputs of delta_table's
+    # churn/compaction terms: delete the just-appended edges (masked lane
+    # rewrites on the delete-side dev stream), then one compaction pass
+    # over the waste the churn left behind
+    del_deltas = deltas(2)  # the same edges the dev stream applied
+    t0 = time.perf_counter()
+    for d in del_deltas:
+        rm = GraphDelta()
+        s_arr, d_arr = d.edges()
+        rm.remove_edges(s_arr, d_arr)
+        dev.apply(rm)
+    jax.block_until_ready(dev.graph()[1])
+    delete_s = (time.perf_counter() - t0) / reps
+    context["stream_delete_s"] = round(delete_s / edges_per_commit, 9)
+    t0 = time.perf_counter()
+    comp = dev.compact()
+    jax.block_until_ready(dev.graph()[1])
+    context["stream_compact_s"] = round(time.perf_counter() - t0, 6)
+    context["stream_compact_reclaimed"] = int(comp["tiles_reclaimed"])
+    log(
+        f"stream lifecycle: delete "
+        f"{context['stream_delete_s']*1e6:.2f} us/edge, compaction pass "
+        f"{context['stream_compact_s']*1e3:.2f} ms "
+        f"({comp['tiles_reclaimed']} tile rows reclaimed)"
+    )
+
 
 def bench_workloads(context, n=50_000, deg=8, reps=5):
     """Round-19 workload costs — the MEASURED inputs of

@@ -28,9 +28,11 @@ from .partition import (
 )
 from . import comm, obs, pyg, tiers, trace
 from . import quant
+from . import lifecycle
 from . import serve
 from . import stream
 from . import workloads
+from .lifecycle import CompactionPolicy, ProvisionPolicy, RetentionPolicy
 from .stream import GraphDelta, StreamingAdjacency, StreamingTiledGraph
 from .tiers import DiskShard, PlacementPlan, TierPlacement, TierStore
 from .quant import QuantizedFeature
@@ -77,6 +79,10 @@ __all__ = [
     "serve",
     "stream",
     "workloads",
+    "lifecycle",
+    "CompactionPolicy",
+    "ProvisionPolicy",
+    "RetentionPolicy",
     "GraphDelta",
     "StreamingAdjacency",
     "StreamingTiledGraph",

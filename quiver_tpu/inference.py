@@ -404,6 +404,39 @@ class BucketPrograms:
                 raise ValueError("rebind index_map avals differ from compiled")
             self._map = index_map
 
+    def reprovision(self, graph, params=None) -> int:
+        """Rebind the graph arguments across a SHAPE change — the
+        round-21 reserve re-provisioning event (`StreamingTiledGraph.
+        provision_reserve` grew the tile tables by a whole bank). This
+        is the one sanctioned exception to `rebind`'s shapes-never-
+        change contract, and it is paid for honestly: the program spec
+        is updated to the new graph avals, every previously-warmed
+        bucket executable is dropped and recompiled against them (via
+        the process-wide executable cache, so a second engine over the
+        same shapes compiles nothing), and the sealed/unsealed state is
+        preserved — after the rebuild the table is complete again, so
+        sealed hard-miss semantics still hold. One rebuild per provision
+        event; the per-commit path still never recompiles. Returns the
+        number of buckets rebuilt."""
+        new_avals = _aval_spec(graph)
+        if new_avals == _aval_spec(self._graph):
+            # same shapes (e.g. a retried provision already absorbed):
+            # a plain content rebind
+            self._graph = graph
+            return 0
+        self._graph = graph
+        if self._spec is not None:
+            # graph avals live at one spec slot — keep everything else
+            # (model, sampler config, table/map avals) identical so the
+            # executable cache shares across engines as before
+            self._spec = self._spec[:9] + (new_avals,) + self._spec[10:]
+        warmed = tuple(sorted(self._exes))
+        self._exes = {}
+        if params is not None:
+            for b in warmed:
+                self.compile_bucket(b, params)
+        return len(warmed)
+
     @property
     def buckets(self) -> Tuple[int, ...]:
         return tuple(sorted(self._exes))

@@ -1,0 +1,154 @@
+"""Graph lifecycle (round 21, ROADMAP item 2): the policy layer that
+makes a `stream.StreamingTiledGraph` live forever — deletes, TTL
+retention, background tile compaction, and reserve re-provisioning, all
+riding the existing fenced `update_graph` machinery on both engines.
+
+The mechanisms live in `quiver_tpu.stream` (they mutate tile state and
+must share its lock); this module holds the DETERMINISTIC POLICIES that
+decide *when* each one runs, so the decisions are replayable from the
+commit stream alone:
+
+- `RetentionPolicy(window=W)` — sliding-window TTL: at a commit whose
+  clock (the delta's max staged timestamp) is ``t_commit``, expire every
+  edge with ``ts <= t_commit - W``. The subtraction is FLOAT32 (the
+  `quantize_t` grid rule from NEXT.md: timestamps live on the f32 grid,
+  so window arithmetic must too — a float64 cutoff could straddle a
+  lane's f32 ts and expire on one host but not another). Expiry is a
+  masked ``ts -> +inf`` lane write, the exact bit-dual of querying the
+  unexpired stream through a ``cutoff < ts <= t`` band mask
+  (`ops.sample.temporal_weight_rows(cutoff=...)`), pinned in
+  tests/test_lifecycle.py.
+- `CompactionPolicy` — LSM-style background reclamation: trigger a
+  `plan_compaction`/`apply_compaction` pair when the reserve report
+  shows at least ``min_reclaimable`` reclaimable tile rows. Plans build
+  OFF-FENCE; the apply flips under the engine fence like an r16
+  migration and is strictly observe-only on bits (no draw changes, no
+  invalidation).
+- `ProvisionPolicy` — grow the tile bank by whole banks when free rows
+  sink below a floor (or reactively on `StreamCapacityError`), paying
+  exactly one sealed-program rebuild per event
+  (`inference.BucketPrograms.reprovision`) — never recompile-per-commit.
+
+Every policy is a pure function of observable state (commit clock,
+reserve report) with no wall-clock or RNG input, which is what keeps
+deletion-era dispatch logs replayable: `replay_fleet_oracle`/
+`replay_temporal_log` snapshot topology per version, and the policies
+re-derive the same expiry/compaction decisions from the same stream.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "RetentionPolicy",
+    "CompactionPolicy",
+    "ProvisionPolicy",
+    "retention_cutoff",
+]
+
+
+def retention_cutoff(t_commit: float, window: float) -> float:
+    """The sliding-window expiry cutoff ``t_commit - window`` computed
+    ON THE FLOAT32 GRID (both operands snapped to f32, subtraction in
+    f32, result returned as the exact f32 value) — the same discipline
+    as `workloads.serving.quantize_t`: edge timestamps are f32 lanes,
+    and a float64 cutoff sitting between two adjacent f32 values could
+    classify a lane differently than the f32 comparison the duality
+    test (and a second host) performs."""
+    return float(np.float32(np.float32(t_commit) - np.float32(window)))
+
+
+class RetentionPolicy:
+    """Deterministic sliding-window TTL retention for temporal streams.
+
+    ``window`` is in timestamp units. Each commit advances the policy's
+    clock to the largest timestamp it has seen (monotone — a late,
+    out-of-order arrival never moves the cutoff backwards), and
+    `cutoff_for` yields the expiry cutoff the engine passes to
+    `StreamingTiledGraph.expire_edges` — or None when nothing new could
+    expire (the cutoff hasn't advanced past the last one applied, so
+    the O(nodes-touched) expiry scan is skipped).
+
+    Deterministic and replayable: the cutoff is a pure f32 function of
+    the committed timestamps; two replicas fed the same commit stream
+    expire identical lane sets."""
+
+    def __init__(self, window: float):
+        if not (float(window) > 0.0) or not np.isfinite(window):
+            raise ValueError(
+                f"retention window must be positive and finite, got "
+                f"{window}"
+            )
+        self.window = float(np.float32(window))
+        self._clock: Optional[float] = None
+        self._last_cutoff: Optional[float] = None
+
+    def observe(self, t_commit: Optional[float]) -> None:
+        """Advance the policy clock to ``t_commit`` (monotone max)."""
+        if t_commit is None:
+            return
+        t = float(np.float32(t_commit))
+        if self._clock is None or t > self._clock:
+            self._clock = t
+
+    def cutoff_for(self, t_commit: Optional[float] = None
+                   ) -> Optional[float]:
+        """Observe ``t_commit`` and return the cutoff to expire at, or
+        None when the window hasn't advanced since the last expiry."""
+        self.observe(t_commit)
+        if self._clock is None:
+            return None
+        cut = retention_cutoff(self._clock, self.window)
+        if self._last_cutoff is not None and cut <= self._last_cutoff:
+            return None
+        return cut
+
+    def mark_expired(self, cutoff: float) -> None:
+        """Record that expiry ran at ``cutoff`` (the engine calls this
+        after `expire_edges` commits)."""
+        if self._last_cutoff is None or cutoff > self._last_cutoff:
+            self._last_cutoff = float(np.float32(cutoff))
+
+    def state(self) -> Dict[str, Optional[float]]:
+        return {"window": self.window, "clock": self._clock,
+                "last_cutoff": self._last_cutoff}
+
+
+class CompactionPolicy:
+    """When to run a compaction pass: once the reserve report shows at
+    least ``min_reclaimable`` reclaimable tile rows (spill-retired
+    ranges + trimmable tails). ``max_moves`` bounds optional defrag
+    relocations per pass (0 = reclaim only, never move live rows).
+    Pure function of the report — no clock, no RNG."""
+
+    def __init__(self, min_reclaimable: int = 8, max_moves: int = 0):
+        self.min_reclaimable = max(int(min_reclaimable), 1)
+        self.max_moves = max(int(max_moves), 0)
+
+    def should_compact(self, report: Dict[str, object]) -> bool:
+        return int(report.get("reclaimable_tiles", 0)) >= (
+            self.min_reclaimable
+        )
+
+
+class ProvisionPolicy:
+    """When (and by how much) to grow the tile bank: provision
+    ``bank_tiles`` fresh rows whenever free rows sink below
+    ``min_free_tiles``. Growing by whole banks keeps the r17 contract
+    honest — shapes change at provision events only, each paying ONE
+    sealed-program rebuild, so the per-commit path still never
+    recompiles."""
+
+    def __init__(self, bank_tiles: int, min_free_tiles: int = 0):
+        if int(bank_tiles) <= 0:
+            raise ValueError(
+                f"bank_tiles must be positive, got {bank_tiles}"
+            )
+        self.bank_tiles = int(bank_tiles)
+        self.min_free_tiles = max(int(min_free_tiles), 0)
+
+    def should_provision(self, report: Dict[str, object]) -> bool:
+        return int(report.get("reserve_free", 0)) < self.min_free_tiles

@@ -443,7 +443,7 @@ def temporal_edge_weights(ts: jax.Array, recency: float) -> jax.Array:
 
 
 def temporal_weight_rows(
-    ts_rows: jax.Array, t: jax.Array, recency: float
+    ts_rows: jax.Array, t: jax.Array, recency: float, cutoff=None
 ) -> jax.Array:
     """The masked weight window of a temporal draw: recency weights where
     ``ts <= t`` (per-row query times ``t`` [B] broadcast over lanes),
@@ -453,9 +453,23 @@ def temporal_weight_rows(
     masked oracle (`workloads.temporal.host_masked_oracle`): both build
     their ``[B, W]`` timestamp windows differently (tile fetch vs host
     CSR slices) but weight them through this one function, which is what
-    makes the oracle a bit-parity pin on the tile path."""
+    makes the oracle a bit-parity pin on the tile path.
+
+    ``cutoff`` (scalar, optional) additionally excludes ``ts <= cutoff``
+    — the sliding-window band mask ``cutoff < ts <= t``. This is the
+    bit-dual of round-21 retention: `stream.expire_edges(cutoff)`
+    rewrites expired lanes' ts to ``+inf`` (masked here by ``ts <= t``
+    at any finite t), and because the Gumbel uniform stream is
+    positional and weights agree lane-for-lane on the survivors, an
+    expired stream draws bit-identically to its unexpired twin queried
+    through this band (pinned in tests/test_lifecycle.py)."""
     w = temporal_edge_weights(ts_rows, recency)
-    return jnp.where(ts_rows.astype(jnp.float32) <= t[:, None], w, 0.0)
+    keep = ts_rows.astype(jnp.float32) <= t[:, None]
+    if cutoff is not None:
+        keep = keep & (
+            ts_rows.astype(jnp.float32) > jnp.float32(cutoff)
+        )
+    return jnp.where(keep, w, 0.0)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "max_deg", "recency"))
@@ -470,10 +484,13 @@ def tiled_temporal_sample_layer(
     t: jax.Array,
     max_deg: int = 512,
     recency: float = 0.0,
+    cutoff=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """TEMPORAL one-hop sample over the tile layout (ROADMAP item 4):
     draw k neighbors per seed among edges with ``ts <= t``, recency-
-    biased via the existing Gumbel machinery.
+    biased via the existing Gumbel machinery. ``cutoff`` (optional
+    traced scalar) narrows the draw to the ``cutoff < ts <= t`` band —
+    the retention duality surface (`temporal_weight_rows`).
 
     ``ttiles`` is the per-edge timestamp payload laid out with the SAME
     tile map as ``tiles`` (`build_tiled_host(indptr, edge_ts,
@@ -499,7 +516,8 @@ def tiled_temporal_sample_layer(
     base, deg = _tiled_bd_lookup(bd, seeds, seed_valid)
     deg = jnp.minimum(deg, max_deg)
     ts_rows = _tiled_payload_window(base, ttiles, max_deg)
-    w_rows = temporal_weight_rows(ts_rows, t.astype(jnp.float32), recency)
+    w_rows = temporal_weight_rows(ts_rows, t.astype(jnp.float32), recency,
+                                  cutoff=cutoff)
     pos, valid = gumbel_topk_positions(key, deg, k, w_rows)
     return _tiled_resolve(tiles, base, pos, k), valid
 

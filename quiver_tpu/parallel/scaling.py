@@ -1120,6 +1120,9 @@ class DeltaPrediction(NamedTuple):
     duty_frac: float         # commit wall over the commit period
     fence_stall_s: float     # serving stall per commit (the fenced part)
     sustainable: bool        # duty < 1 (the stream keeps up)
+    # round-21 lifecycle terms (default 0: the round-17 table unchanged)
+    churn_s: float = 0.0         # per-commit delete/expiry lane rewrites
+    compact_amort_s: float = 0.0  # compaction wall amortized per commit
 
 
 def delta_table(
@@ -1127,6 +1130,10 @@ def delta_table(
     append_s_per_edge: float,
     swap_s_per_commit: float,
     commit_period_s: float = 1.0,
+    delete_frac: float = 0.0,
+    delete_s_per_edge: float = 0.0,
+    compact_s_per_pass: float = 0.0,
+    compact_every_commits: float = 0.0,
 ) -> List[DeltaPrediction]:
     """Price streaming-graph ingest (round 17) from MEASURED per-edge
     costs: "at edge rate R with a commit every ``commit_period_s``, what
@@ -1143,19 +1150,33 @@ def delta_table(
     Batching is the lever the table makes visible: the swap cost
     amortizes over ``edges_per_commit``, so longer periods trade delta
     visibility lag for lower duty.
+
+    Round-21 lifecycle terms (all default 0 — the round-17 table is
+    unchanged without them): a ``delete_frac`` of arrivals also pay
+    ``delete_s_per_edge`` (the measured lane-rewrite cost of a removal
+    or TTL expiry, bench ``stream_delete_s``) per commit, and a
+    background compaction pass costing ``compact_s_per_pass`` (bench
+    ``stream_compact_s``) every ``compact_every_commits`` commits is
+    amortized into the duty — the steady-state price of a stream that
+    lives forever instead of only growing.
     """
     if append_s_per_edge < 0 or swap_s_per_commit < 0:
         raise ValueError("per-edge/per-commit costs must be >= 0")
     if commit_period_s <= 0:
         raise ValueError("commit_period_s must be > 0")
+    if delete_frac < 0 or delete_s_per_edge < 0 or compact_s_per_pass < 0:
+        raise ValueError("lifecycle costs must be >= 0")
+    compact_amort = (compact_s_per_pass / compact_every_commits
+                     if compact_every_commits > 0 else 0.0)
     rows: List[DeltaPrediction] = []
     for name, rate in cases:
         rate = float(rate)
         if rate < 0:
             raise ValueError(f"edge rate must be >= 0 for case {name!r}")
         per_commit = rate * commit_period_s
-        commit_s = per_commit * append_s_per_edge + swap_s_per_commit
-        duty = commit_s / commit_period_s
+        churn = per_commit * delete_frac * delete_s_per_edge
+        commit_s = per_commit * append_s_per_edge + swap_s_per_commit + churn
+        duty = (commit_s + compact_amort) / commit_period_s
         rows.append(
             DeltaPrediction(
                 name=str(name),
@@ -1165,30 +1186,48 @@ def delta_table(
                 duty_frac=duty,
                 fence_stall_s=commit_s,
                 sustainable=duty < 1.0,
+                churn_s=churn,
+                compact_amort_s=compact_amort,
             )
         )
     return rows
 
 
 def format_delta_markdown(rows: Sequence[DeltaPrediction]) -> str:
-    lines = [
-        "| case | edges/s | edges/commit | commit ms | fence stall ms "
-        "| duty | sustainable |",
-        "|---|---|---|---|---|---|---|",
-    ]
+    lifecycle = any(r.churn_s or r.compact_amort_s for r in rows)
+    if lifecycle:
+        lines = [
+            "| case | edges/s | edges/commit | commit ms | churn ms "
+            "| compact ms | fence stall ms | duty | sustainable |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+    else:
+        lines = [
+            "| case | edges/s | edges/commit | commit ms | fence stall ms "
+            "| duty | sustainable |",
+            "|---|---|---|---|---|---|---|",
+        ]
     for r in rows:
+        mid = (f"| {r.churn_s*1e3:.2f} | {r.compact_amort_s*1e3:.2f} "
+               if lifecycle else "")
         lines.append(
             f"| {r.name} | {r.edges_per_s:.0f} | {r.edges_per_commit:.0f} "
-            f"| {r.commit_s*1e3:.2f} | {r.fence_stall_s*1e3:.2f} "
+            f"| {r.commit_s*1e3:.2f} {mid}"
+            f"| {r.fence_stall_s*1e3:.2f} "
             f"| {r.duty_frac:.1%} | {'yes' if r.sustainable else 'NO'} |"
         )
     lines.append("")
     lines.append(
         "Streaming-graph ingest priced from MEASURED bench legs "
-        "(stream_append_s per edge, stream_swap_s per batched commit). "
+        "(stream_append_s per edge, stream_swap_s per batched commit"
+        + (", stream_delete_s per lane rewrite, stream_compact_s per "
+           "background pass" if lifecycle else "")
+        + "). "
         "The commit runs fenced, so its wall is the per-commit serving "
         "stall; longer commit periods amortize the swap at the cost of "
-        "delta visibility lag — the round-17 ingest planning table."
+        "delta visibility lag — the round-17 ingest planning table"
+        + (" with the round-21 lifecycle churn/compaction terms."
+           if lifecycle else ".")
     )
     return "\n".join(lines)
 

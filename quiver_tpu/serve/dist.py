@@ -883,6 +883,9 @@ class DistServeStats:
     delta_cache_invalidated: int = 0
     delta_closure_installs: int = 0
     replica_delta_invalidations: int = 0
+    # round-21 lifecycle: removals committed fleet-wide (expiry and
+    # compaction are per-owner-engine — they ride the merged ServeStats)
+    edges_deleted: int = 0
     inflight_peak: int = 0
     sub_batches: Dict[int, int] = field(default_factory=dict)
     sub_batch_seeds: Dict[int, int] = field(default_factory=dict)
@@ -933,6 +936,7 @@ class DistServeStats:
             "delta_cache_invalidated": self.delta_cache_invalidated,
             "delta_closure_installs": self.delta_closure_installs,
             "replica_delta_invalidations": self.replica_delta_invalidations,
+            "edges_deleted": self.edges_deleted,
             "inflight_peak": self.inflight_peak,
             "sub_batches": dict(self.sub_batches),
             "mean_sub_batch_width": self.mean_sub_batch_width(),
@@ -2253,6 +2257,29 @@ class DistServeEngine:
         self.journal.emit("graph_delta", -1, -1, n)
         return n
 
+    def stage_removals(self, src, dst) -> int:
+        """Accumulate edge DELETIONS into ``pending_delta`` (round 21)
+        — mirrors `ServeEngine.stage_removals`: ids validated here,
+        existence validated fleet-wide at commit preflight (the edge may
+        net out against a same-batch append). Timestamp updates are NOT
+        staged here: dist streaming is structural-only, updates ride the
+        single-host temporal engine."""
+        from ..stream import GraphDelta, validate_edge_ids
+
+        src, dst = validate_edge_ids(
+            src, dst,
+            (self._stream_adj.n if self._stream_adj is not None
+             else self.global2host.shape[0]),
+            "removed",
+        )
+        with self._lock:
+            if self.pending_delta is None:
+                self.pending_delta = GraphDelta()
+            self.pending_delta.remove_edges(src, dst)
+            n = len(self.pending_delta)
+        self.journal.emit("graph_delta", -1, -1, n)
+        return n
+
     def _current_full_topo(self):
         """The build()-time full topology, RE-MATERIALIZED from the
         stream when graph deltas landed since (lazy: only the auxiliary
@@ -2300,7 +2327,16 @@ class DistServeEngine:
         seeds see it too. ``delta=None`` commits ``pending_delta``; an
         empty commit is a strict no-op (frozen == empty-delta replay,
         pinned). An appended edge is visible to the next routed sample
-        after this returns."""
+        after this returns.
+
+        Round 21 — staged REMOVALS commit fleet-wide under the same
+        fence: existence is validated all-or-none before any mutation,
+        each owner holding the row (per its post-install mask) rewrites
+        the lanes locally, the fallback and the shared adjacency follow,
+        and the removal sources join the invalidation closure — a
+        delete-then-replay matches a fleet built without the edge, bit
+        for bit (tests/test_lifecycle.py, hosts=2). Timestamp updates
+        are rejected here: dist streaming is structural-only."""
         from ..stream import GraphDelta
 
         if self._stream_adj is None:
@@ -2317,6 +2353,41 @@ class DistServeEngine:
                     "cache_invalidated": 0, "closure_installs": 0,
                     "replica_invalidated": False}
         src, dst = delta.edges()
+        rsrc, rdst = delta.removals()
+        usrc, _, _ = delta.updates()
+        if usrc.size:
+            raise ValueError(
+                "timestamp updates ride the single-host temporal engine "
+                "— dist streaming is structural-only (owner streams "
+                "carry no ts payload to rewrite)"
+            )
+        if rsrc.size:
+            # all-or-none existence check BEFORE any mutation: count each
+            # removal against the shared adjacency plus this batch's own
+            # appends, so a bad removal raises with the whole fleet (and
+            # the staged buffer, re-staged in the except below) untouched
+            avail: Dict[Tuple[int, int], int] = {}
+            for u, v in zip(src.tolist(), dst.tolist()):
+                avail[(u, v)] = avail.get((u, v), 0) + 1
+            adj0 = self._stream_adj
+            for u, v in zip(rsrc.tolist(), rdst.tolist()):
+                k = (u, v)
+                if k not in avail:
+                    avail[k] = int(np.sum(
+                        np.asarray(adj0.neighbors(u)) == v
+                    ))
+                if avail[k] <= 0:
+                    if from_pending:
+                        with self._lock:
+                            if self.pending_delta is not None:
+                                delta.extend(self.pending_delta)
+                            self.pending_delta = delta
+                    raise ValueError(
+                        f"removal of absent edge ({u}, {v}) — the whole "
+                        "batch is rejected (all-or-none), nothing was "
+                        "applied"
+                    )
+                avail[k] -= 1
         m = self._replica_materials
         sizes = list(m["sizes"])
         hops = max(len(sizes) - 1, 0)
@@ -2347,8 +2418,15 @@ class DistServeEngine:
                     # adjacency, rolled back below) untouched, never one
                     # owner committed and the next one not
                     try:
-                        affected = adj.reverse_closure(np.unique(src),
-                                                       inv_hops)
+                        # invalidation seeds: append sources UNION removal
+                        # sources — a removal changes its src row's draws
+                        # too. The reverse closure runs over the POST-
+                        # append, PRE-removal adjacency: reverse reach is
+                        # a superset there (removals only shrink forward
+                        # lists), so we over-invalidate, never under
+                        inv_seeds = (np.unique(np.concatenate([src, rsrc]))
+                                     if rsrc.size else np.unique(src))
+                        affected = adj.reverse_closure(inv_seeds, inv_hops)
                         plans = []
                         for h in sorted(self.engines):
                             stream_h = self._owner_streams.get(h)
@@ -2390,6 +2468,17 @@ class DistServeEngine:
                                         for nd in topo_new]
                             rel = topo_mask[src]
                             owner_delta = GraphDelta(src[rel], dst[rel])
+                            if rsrc.size:
+                                # filter removals by the NEW mask: install
+                                # rows are snapshotted from the shared
+                                # adjacency BEFORE removals apply (below),
+                                # so a freshly-installed row still carries
+                                # the doomed edge — every owner holding
+                                # the row (old or just-installed) must
+                                # delete it locally
+                                rel_r = new_topo[rsrc]
+                                owner_delta.remove_edges(rsrc[rel_r],
+                                                         rdst[rel_r])
                             feat_new = np.nonzero(new_feat & ~feat_mask)[0]
                             stream_h.preflight(owner_delta,
                                                installs=installs)
@@ -2399,12 +2488,15 @@ class DistServeEngine:
                                 )
                             plans.append((h, new_topo, new_feat, installs,
                                           owner_delta, feat_new))
+                        fb_delta = GraphDelta(src, dst)
+                        if rsrc.size:
+                            fb_delta.remove_edges(rsrc, rdst)
                         fb_stream = (getattr(self.fallback._sampler,
                                              "stream", None)
                                      if self.fallback is not None
                                      else None)
                         if fb_stream is not None:
-                            fb_stream.preflight(GraphDelta(src, dst))
+                            fb_stream.preflight(fb_delta)
                     except BaseException:
                         adj.pop_edges(src, dst)
                         if from_pending:
@@ -2420,6 +2512,13 @@ class DistServeEngine:
                                     delta.extend(self.pending_delta)
                                 self.pending_delta = delta
                         raise
+                    # every preflight passed: apply removals to the shared
+                    # adjacency (cannot fail — existence was validated
+                    # upfront and the batch's appends just landed). Owner
+                    # install rows above were snapshotted pre-removal; the
+                    # filtered owner_delta removals bring them in line
+                    for u, v in zip(rsrc.tolist(), rdst.tolist()):
+                        adj.remove_one(int(u), int(v))
                     self._materials_stale = True
                 self.graph_version += 1
                 for (h, new_topo, new_feat, installs, owner_delta,
@@ -2437,7 +2536,7 @@ class DistServeEngine:
                     self._owner_masks[h] = (new_topo, new_feat)
                 if self.fallback is not None:
                     self.fallback.update_graph(
-                        GraphDelta(src, dst), invalidate=affected
+                        fb_delta, invalidate=affected
                     )
                 rep = self.replica
                 if (rep is not None and rep.ids.size
@@ -2465,11 +2564,16 @@ class DistServeEngine:
                 )
                 self.stats.graph_deltas += 1
                 self.stats.delta_edges += int(src.size)
+                self.stats.edges_deleted += int(rsrc.size)
                 self.stats.delta_cache_invalidated += invalidated
                 self.stats.delta_closure_installs += installs_total
         self.journal.emit("delta_commit", -1, self.graph_version,
                           int(src.size), invalidated)
+        if rsrc.size:
+            self.journal.emit("edge_delete", -1, self.graph_version,
+                              int(rsrc.size))
         out = {"edges": int(src.size),
+               "edges_deleted": int(rsrc.size),
                "graph_version": self.graph_version,
                "cache_invalidated": invalidated,
                "affected_seeds": int(affected.size),
@@ -2481,6 +2585,35 @@ class DistServeEngine:
             out["replica_refresh"] = self.refresh_replicas(
                 ids=stale_replica_ids
             )
+        return out
+
+    def compact_graph(self, max_moves: Optional[int] = None
+                      ) -> Dict[str, Dict[str, object]]:
+        """One fleet-wide compaction pass (round 21): each owner
+        engine's `ServeEngine.compact_graph` plus the fallback's, in
+        deterministic host order. Each engine plans off-fence and flips
+        under its OWN fence (compaction is per-stream row bookkeeping —
+        no cross-owner coordination needed, because it is strictly
+        observe-only on served bits: no version bump, no invalidation,
+        no routing change). Owners without a bound stream are skipped.
+        Returns per-owner summaries keyed ``"host<h>"`` plus
+        ``"fallback"``, and an aggregate ``"tiles_reclaimed"``."""
+        out: Dict[str, Dict[str, object]] = {}
+        total = 0
+        for h in sorted(self.engines):
+            eng = self.engines[h]
+            if getattr(eng._sampler, "stream", None) is None:
+                continue
+            s = eng.compact_graph(max_moves=max_moves)
+            out[f"host{h}"] = s
+            total += int(s["tiles_reclaimed"])
+        if (self.fallback is not None
+                and getattr(self.fallback._sampler, "stream", None)
+                is not None):
+            s = self.fallback.compact_graph(max_moves=max_moves)
+            out["fallback"] = s
+            total += int(s["tiles_reclaimed"])
+        out["tiles_reclaimed"] = total  # type: ignore[assignment]
         return out
 
     def adapt_tiers(self) -> Dict[int, Dict[str, object]]:
@@ -3150,7 +3283,7 @@ class DistServeEngine:
                   "migration_rollforwards", "migrated_seeds",
                   "replica_refreshes", "graph_deltas", "delta_edges",
                   "delta_cache_invalidated", "delta_closure_installs",
-                  "replica_delta_invalidations"):
+                  "replica_delta_invalidations", "edges_deleted"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"DistServeStats.{f}", labels)

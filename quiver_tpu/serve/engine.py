@@ -415,6 +415,21 @@ def register_stream_reserve(reg, prefix: str, get_stream,
                  field("projected_commits_to_exhaustion"),
                  "commits of runway left at the observed consumption "
                  "rate (-1 = no consumption observed yet)", labels)
+    # round-21 lifecycle gauges: the compaction planner's inputs, so the
+    # "is the working set actually flat" question is alertable
+    reg.gauge_fn(f"{prefix}_stream_fragmented_lanes",
+                 field("fragmented_lanes"),
+                 "slack lanes inside held tile rows (spill growth + "
+                 "deletions) — the compaction trim target", labels)
+    reg.gauge_fn(f"{prefix}_stream_reclaimable_tiles",
+                 field("reclaimable_tiles"),
+                 "tile rows a compaction pass could reclaim now "
+                 "(spill-retired ranges + trimmable tails)", labels)
+    reg.gauge_fn(f"{prefix}_stream_dead_lane_frac",
+                 field("dead_lane_frac"),
+                 "expired (masked) lanes as a fraction of live lane "
+                 "content — appends re-use these before consuming "
+                 "reserve rows", labels)
 
 
 def abandon_undrained(engine, drained: bool = True) -> None:
@@ -669,6 +684,24 @@ class ServeConfig:
     # engine's locking exactly; batch composition and dispatch logs are
     # stripe-count-invariant either way (arrival-order merge).
     submit_stripes: int = 8
+    # round-21 graph lifecycle (`quiver_tpu.lifecycle`):
+    # >0 = sliding-window TTL on a temporal stream — every update_graph
+    # commit expires edges older than (max committed ts - window) under
+    # the same fence, as masked ts->+inf lane writes (see
+    # lifecycle.RetentionPolicy; window arithmetic on the f32 grid)
+    stream_retention_window: float = 0.0
+    # >0 = background compaction: a timer thread plans off-fence and
+    # applies under the fence every this-many seconds, when at least
+    # stream_compact_min_reclaim tile rows are reclaimable. Strictly
+    # observe-only on bits (pinned).
+    stream_compact_every_s: float = 0.0
+    stream_compact_min_reclaim: int = 8
+    stream_compact_max_moves: int = 0
+    # >0 = auto re-provisioning: a commit that would raise
+    # StreamCapacityError first grows the tile bank by this many rows
+    # (one sealed-program rebuild via BucketPrograms.reprovision) and
+    # retries once. 0 = capacity stays a planned hard error (r17).
+    stream_provision_tiles: int = 0
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -852,6 +885,14 @@ class ServeStats:
     delta_tile_writes: int = 0
     delta_tile_spills: int = 0
     delta_cache_invalidated: int = 0
+    # round-21 graph lifecycle: deletions/expiries are masked lane work,
+    # reclaims/compactions are the background row economy — together they
+    # are the "does the stream actually live forever" signal (expired +
+    # reclaimed keeping pace with appended = flat reserve occupancy)
+    edges_deleted: int = 0
+    edges_expired: int = 0
+    tiles_reclaimed: int = 0
+    compactions: int = 0
     inflight_peak: int = 0
     dispatch_buckets: Dict[int, int] = field(default_factory=dict)
     cache: HitRateCounter = field(default_factory=HitRateCounter)
@@ -903,6 +944,10 @@ class ServeStats:
         self.delta_tile_writes += other.delta_tile_writes
         self.delta_tile_spills += other.delta_tile_spills
         self.delta_cache_invalidated += other.delta_cache_invalidated
+        self.edges_deleted += other.edges_deleted
+        self.edges_expired += other.edges_expired
+        self.tiles_reclaimed += other.tiles_reclaimed
+        self.compactions += other.compactions
         self.inflight_peak = max(self.inflight_peak, other.inflight_peak)
         for b, n in other.dispatch_buckets.copy().items():
             self.dispatch_buckets[b] = self.dispatch_buckets.get(b, 0) + n
@@ -937,6 +982,10 @@ class ServeStats:
             "delta_tile_writes": self.delta_tile_writes,
             "delta_tile_spills": self.delta_tile_spills,
             "delta_cache_invalidated": self.delta_cache_invalidated,
+            "edges_deleted": self.edges_deleted,
+            "edges_expired": self.edges_expired,
+            "tiles_reclaimed": self.tiles_reclaimed,
+            "compactions": self.compactions,
             "inflight_peak": self.inflight_peak,
             "dispatch_buckets": dict(self.dispatch_buckets),
             "cache": self.cache.snapshot(),
@@ -1204,6 +1253,7 @@ class ServeEngine:
         self._tier_feature = find_tiered_feature(feature)
         self.placement_version = 0
         self.tier_adapt_errors = 0  # failed background adapt passes
+        self.compact_errors = 0     # failed background compaction passes
         # round-18 flush-ahead prefetch: bind the tier store's staging
         # buffer when the config asks for it AND the feature can serve it
         # (adaptive store + read pool); inert otherwise — a prefetch-on
@@ -1229,6 +1279,17 @@ class ServeEngine:
         # until update_graph commits them — both guarded by _lock
         self.graph_version = 0
         self.pending_delta = None
+        # round-21 lifecycle: the deterministic retention clock (None when
+        # retention is off) — a pure function of committed timestamps, so
+        # two replicas fed the same commit stream expire identical lanes
+        if self.config.stream_retention_window > 0:
+            from ..lifecycle import RetentionPolicy
+
+            self.retention = RetentionPolicy(
+                self.config.stream_retention_window
+            )
+        else:
+            self.retention = None
         self.dispatch_log: List[Tuple[np.ndarray, int]] = []
         # queue state (round 20): _pending is the STRIPED pending store —
         # per-stripe dicts of slots not yet flushed (merged arrival order
@@ -1935,7 +1996,8 @@ class ServeEngine:
                   "shed", "request_errors",
                   "undrained", "graph_deltas", "delta_edges",
                   "delta_tile_writes", "delta_tile_spills",
-                  "delta_cache_invalidated"):
+                  "delta_cache_invalidated", "edges_deleted",
+                  "edges_expired", "tiles_reclaimed", "compactions"):
             reg.counter_fn(f"{prefix}_{f}_total",
                            (lambda f=f: getattr(self.stats, f)),
                            f"ServeStats.{f}", labels)
@@ -1978,6 +2040,9 @@ class ServeEngine:
         reg.gauge_fn(f"{prefix}_tier_adapt_errors",
                      lambda: self.tier_adapt_errors,
                      "failed background tier-adaptation passes", labels)
+        reg.gauge_fn(f"{prefix}_compact_errors",
+                     lambda: self.compact_errors,
+                     "failed background compaction passes", labels)
         reg.gauge_fn(
             f"{prefix}_tier_prefetch_hit_rate",
             lambda: (self.stats.tier_prefetch_hit
@@ -2177,6 +2242,64 @@ class ServeEngine:
         self.journal.emit("graph_delta", -1, -1, n)
         return n
 
+    def stage_removals(self, src, dst) -> int:
+        """Accumulate edge DELETIONS host-side into ``pending_delta``
+        (round 21) — the removal side of `stage_edges`: validated here
+        against the bound stream's node range so one bad id raises at
+        the call site, applied at the next `update_graph` commit as
+        masked lane rewrites (survivors shift left — a delete-then-
+        replay is bit-identical to a graph built without the edge).
+        EXISTENCE is checked at commit preflight, not here: the edge may
+        legitimately be in the same pending batch (append then remove in
+        one commit is valid and nets out). Returns the pending count."""
+        from ..stream import GraphDelta, validate_edge_ids
+
+        stream = getattr(self._sampler, "stream", None)
+        if stream is not None:
+            n = stream.n
+        else:
+            topo = getattr(self._sampler, "csr_topo", None)
+            n = topo.node_count if topo is not None else None
+        src, dst = validate_edge_ids(src, dst, n, "removed")
+        with self._lock:
+            if self.pending_delta is None:
+                self.pending_delta = GraphDelta()
+            self.pending_delta.remove_edges(src, dst)
+            n = len(self.pending_delta)
+        self.journal.emit("graph_delta", -1, -1, n)
+        return n
+
+    def stage_updates(self, src, dst, ts) -> int:
+        """Accumulate per-edge TIMESTAMP REWRITES into ``pending_delta``
+        (round 21): each (src, dst) must exist at commit time and gets
+        its ts lane overwritten in place — no lane moves, no degree
+        change, so only the recency weighting of future draws shifts.
+        Temporal streams only (the ts lane is the one mutable per-edge
+        payload); ``ts`` must be finite (+inf is the retention expiry
+        sentinel). Returns the pending count."""
+        from ..stream import GraphDelta, validate_edge_ids
+
+        stream = getattr(self._sampler, "stream", None)
+        if stream is not None:
+            n = stream.n
+            if not getattr(stream, "temporal", False):
+                raise ValueError(
+                    "timestamp updates need a temporal stream "
+                    "(StreamingTiledGraph(edge_ts=...)) — plain streamed "
+                    "tiles carry no per-edge payload to rewrite"
+                )
+        else:
+            topo = getattr(self._sampler, "csr_topo", None)
+            n = topo.node_count if topo is not None else None
+        src, dst = validate_edge_ids(src, dst, n, "updated")
+        with self._lock:
+            if self.pending_delta is None:
+                self.pending_delta = GraphDelta()
+            self.pending_delta.update_edges(src, dst, ts)
+            n = len(self.pending_delta)
+        self.journal.emit("graph_delta", -1, -1, n)
+        return n
+
     def update_graph(self, delta=None, *, installs=None,
                      invalidate=None) -> Dict[str, object]:
         """Commit a graph delta behind the SAME fence as `update_params`:
@@ -2199,7 +2322,20 @@ class ServeEngine:
         moves: frozen-graph replay == delta-replay with an empty delta,
         pinned in tests/test_stream.py. The appended edges are visible to
         the next sample after this returns (copy-all semantics: a draw
-        with fanout >= degree must include them)."""
+        with fanout >= degree must include them).
+
+        Round 21 — the same fenced commit also carries the LIFECYCLE
+        flows: staged removals rewrite their nodes' lanes in place
+        (delete-then-replay == built-without-the-edge, bit for bit),
+        staged ts updates overwrite payload lanes, TTL retention (when
+        ``stream_retention_window`` > 0 on a temporal stream) expires
+        every edge older than the commit clock minus the window as
+        masked ``ts -> +inf`` lane writes, and a `StreamCapacityError`
+        triggers one reactive bank grow + sealed-program rebuild when
+        ``stream_provision_tiles`` > 0. All under ONE fence, one version
+        bump, one closure-exact invalidation pass."""
+        from ..stream import StreamCapacityError
+
         stream = getattr(self._sampler, "stream", None)
         if stream is None:
             raise ValueError(
@@ -2217,6 +2353,8 @@ class ServeEngine:
             return {"edges": 0, "installs": 0, "cache_invalidated": 0,
                     "affected_seeds": 0, "graph_version": self.graph_version}
         applied = False
+        provisioned = False
+        expired = None
         try:
             with self._seq:
                 with self._fence:
@@ -2226,9 +2364,42 @@ class ServeEngine:
                     # prefetch rows keep valid bytes but stale intent —
                     # drop them with the other fence consumers
                     self._cancel_prefetch()
-                    summary = stream.apply(delta, installs=installs)
+                    try:
+                        summary = stream.apply(delta, installs=installs)
+                    except StreamCapacityError:
+                        if self.config.stream_provision_tiles <= 0:
+                            raise
+                        # reactive re-provisioning (round 21): grow the
+                        # bank by one configured increment and retry the
+                        # SAME batch once — one sealed-program rebuild
+                        # below, never recompile-per-commit. A second
+                        # failure propagates (the batch outgrows even the
+                        # grown bank; the caller sizes the increment).
+                        stream.provision_reserve(
+                            self.config.stream_provision_tiles
+                        )
+                        provisioned = True
+                        summary = stream.apply(delta, installs=installs)
                     applied = True
                     self.graph_version += 1
+                    # TTL retention (round 21): expire at the commit
+                    # clock, under the SAME fence as the delta it rides —
+                    # the cutoff is a pure f32 function of committed
+                    # timestamps (lifecycle.RetentionPolicy), so replicas
+                    # fed the same commit stream expire identical lanes
+                    if (self.retention is not None
+                            and getattr(stream, "temporal", False)):
+                        cut = self.retention.cutoff_for(delta.max_ts())
+                        if cut is not None:
+                            exp = stream.expire_edges(cut)
+                            self.retention.mark_expired(cut)
+                            if exp["edges_expired"]:
+                                expired = exp
+                                self.stats.edges_expired += (
+                                    exp["edges_expired"]
+                                )
+                            summary["edges_expired"] = exp["edges_expired"]
+                            summary["retention_cutoff"] = cut
                     if self._programs is not None:
                         # sealed executables take the graph/table as
                         # ARGUMENTS: swap same-shaped arrays, never
@@ -2241,20 +2412,53 @@ class ServeEngine:
                             from ..inference import feature_gather_spec
 
                             table, imap = feature_gather_spec(self._feature)
-                        self._programs.rebind(
-                            graph=self._sampler.fused_graph_arrays(),
-                            table=table, index_map=imap,
-                        )
+                        if provisioned:
+                            # shapes changed at the provision event: the
+                            # one sanctioned rebuild (reprovision swaps
+                            # the spec's graph avals and recompiles the
+                            # warmed buckets through the process cache).
+                            # _params is read bare: the fence Condition
+                            # wraps _lock, so it is already held here
+                            self._programs.reprovision(
+                                self._sampler.fused_graph_arrays(),
+                                params=self._params,
+                            )
+                            if table is not None:
+                                self._programs.rebind(table=table,
+                                                      index_map=imap)
+                        else:
+                            self._programs.rebind(
+                                graph=self._sampler.fused_graph_arrays(),
+                                table=table, index_map=imap,
+                            )
+                    # invalidation seeds: every staged source (appends +
+                    # removals + updates via delta.sources()) UNION the
+                    # retention-expired sources — expiry changed those
+                    # rows' draws under this same fence, so their reverse
+                    # closure is stale too
                     if invalidate is not None:
                         affected = np.asarray(list(invalidate), np.int64)
-                    elif n_edges:
-                        hops = self.config.stream_invalidate_hops
-                        if hops is None:
-                            hops = max(len(self._sampler.sizes) - 1, 0)
-                        affected = stream.affected_seeds(delta.sources(),
-                                                         hops)
+                        if expired is not None:
+                            hops = self.config.stream_invalidate_hops
+                            if hops is None:
+                                hops = max(len(self._sampler.sizes) - 1, 0)
+                            affected = np.union1d(
+                                affected,
+                                stream.affected_seeds(expired["sources"],
+                                                      hops),
+                            )
                     else:
-                        affected = np.array([], np.int64)
+                        srcs = (np.asarray(delta.sources(), np.int64)
+                                if n_edges else np.array([], np.int64))
+                        if expired is not None:
+                            srcs = np.union1d(srcs, expired["sources"])
+                        if srcs.size:
+                            hops = self.config.stream_invalidate_hops
+                            if hops is None:
+                                hops = max(len(self._sampler.sizes) - 1, 0)
+                            affected = stream.affected_seeds(srcs, hops)
+                        else:
+                            affected = np.array([], np.int64)
                     # invalidate by NODE, not exact key: temporal cache
                     # entries are (node, t)-keyed, and a changed row
                     # staleness-taints every cached t of an affected seed
@@ -2268,6 +2472,9 @@ class ServeEngine:
                     self.stats.delta_tile_writes += summary["pad_writes"]
                     self.stats.delta_tile_spills += summary["tile_spills"]
                     self.stats.delta_cache_invalidated += invalidated
+                    self.stats.edges_deleted += summary.get(
+                        "edges_deleted", 0
+                    )
         except BaseException:
             # `stream.apply` is atomic (preflight before any mutation),
             # so a commit that raised BEFORE apply returned left the
@@ -2285,7 +2492,14 @@ class ServeEngine:
             raise
         self.journal.emit("delta_commit", -1, self.graph_version,
                           n_edges, invalidated)
+        if summary.get("edges_deleted"):
+            self.journal.emit("edge_delete", -1, self.graph_version,
+                              summary["edges_deleted"])
+        if expired is not None:
+            self.journal.emit("retention_expire", -1, self.graph_version,
+                              expired["edges_expired"], expired["nodes"])
         summary["cache_invalidated"] = invalidated
+        summary["provisioned"] = provisioned
         summary["affected_seeds"] = int(affected.size)
         summary["graph_version"] = self.graph_version
         if (self.config.stream_adapt_tiers
@@ -2299,6 +2513,163 @@ class ServeEngine:
             except Exception:
                 self.tier_adapt_errors += 1
         return summary
+
+    # -- graph lifecycle (round 21; quiver_tpu.lifecycle) ------------------
+
+    def expire_edges(self, t_commit=None) -> Dict[str, object]:
+        """Run TTL retention NOW, off the commit path: advance the
+        retention clock to ``t_commit`` (None keeps the clock where the
+        last commit left it) and expire every edge older than
+        ``clock - window`` behind the `update_params` fence — masked
+        ``ts -> +inf`` lane writes, one version bump, closure-exact
+        invalidation of the expired rows' reverse k-hop closure. The
+        commit path runs this automatically; this entry point is for
+        wall-clock-driven expiry between commits (e.g. a quiet stream
+        whose window keeps sliding). Returns the stream's expiry summary
+        plus ``cache_invalidated``/``graph_version``."""
+        stream = getattr(self._sampler, "stream", None)
+        if stream is None or not getattr(stream, "temporal", False):
+            raise ValueError(
+                "retention expiry needs a temporal stream-bound sampler "
+                "(StreamingTiledGraph(edge_ts=...) + bind_stream)"
+            )
+        if self.retention is None:
+            raise ValueError(
+                "retention is off — set "
+                "ServeConfig(stream_retention_window=W)"
+            )
+        cut = self.retention.cutoff_for(t_commit)
+        if cut is None:
+            return {"edges_expired": 0, "nodes": 0,
+                    "cache_invalidated": 0,
+                    "graph_version": self.graph_version}
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                self._cancel_prefetch()
+                exp = stream.expire_edges(cut)
+                self.retention.mark_expired(cut)
+                invalidated = 0
+                if exp["edges_expired"]:
+                    self.graph_version += 1
+                    if self._programs is not None:
+                        self._programs.rebind(
+                            graph=self._sampler.fused_graph_arrays()
+                        )
+                    hops = self.config.stream_invalidate_hops
+                    if hops is None:
+                        hops = max(len(self._sampler.sizes) - 1, 0)
+                    affected = stream.affected_seeds(exp["sources"], hops)
+                    invalidated = self.cache.invalidate_nodes(
+                        int(x) for x in affected
+                    )
+                    self.stats.edges_expired += exp["edges_expired"]
+                    self.stats.delta_cache_invalidated += invalidated
+        if exp["edges_expired"]:
+            self.journal.emit("retention_expire", -1, self.graph_version,
+                              exp["edges_expired"], exp["nodes"])
+        exp["cache_invalidated"] = invalidated
+        exp["graph_version"] = self.graph_version
+        exp["retention_cutoff"] = cut
+        return exp
+
+    def compact_graph(self, max_moves=None) -> Dict[str, object]:
+        """One background compaction pass, LSM-style: PLAN off-fence
+        (reads under the stream lock only — live traffic keeps flowing),
+        then flip under the `update_params` fence like an r16 migration
+        (`plan_compaction` stamped the plan with version/node_version, so
+        `apply_compaction` skips anything a racing commit moved first).
+        Strictly observe-only on served bits: row reclaims and base-
+        indirection moves never change a draw, so there is NO version
+        bump and NO cache invalidation — pinned (logits + dispatch logs
+        identical with compaction racing an in-flight flush) in
+        tests/test_lifecycle.py. Returns the apply summary."""
+        stream = getattr(self._sampler, "stream", None)
+        if stream is None:
+            raise ValueError(
+                "compaction needs a stream-bound sampler"
+            )
+        if max_moves is None:
+            max_moves = self.config.stream_compact_max_moves
+        plan = stream.plan_compaction(max_moves=max_moves)
+        self.journal.emit("compact_begin", -1, self.graph_version,
+                          len(plan["retired"]) + len(plan["trims"]),
+                          len(plan["moves"]))
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                # staged prefetch intent survives a compaction (bytes
+                # and closures are untouched) — no _cancel_prefetch
+                summary = stream.apply_compaction(plan)
+                self.stats.tiles_reclaimed += summary["tiles_reclaimed"]
+                self.stats.compactions += 1
+        self.journal.emit("compact_commit", -1, self.graph_version,
+                          summary["tiles_reclaimed"], summary["moves"])
+        summary["graph_version"] = self.graph_version
+        return summary
+
+    def provision_reserve(self, tiles=None) -> Dict[str, object]:
+        """Grow the tile bank by ``tiles`` whole rows (default: the
+        ``stream_provision_tiles`` knob) behind the fence, then pay the
+        ONE sanctioned sealed-program rebuild
+        (`inference.BucketPrograms.reprovision`) — shapes change at
+        provision events only; the per-commit path still never
+        recompiles. Served bits are untouched (fresh rows are free
+        rows). Returns the post-grow reserve report."""
+        stream = getattr(self._sampler, "stream", None)
+        if stream is None:
+            raise ValueError(
+                "provisioning needs a stream-bound sampler"
+            )
+        if tiles is None:
+            tiles = self.config.stream_provision_tiles
+        if int(tiles) <= 0:
+            raise ValueError(
+                f"provision_reserve needs a positive tile count, got "
+                f"{tiles} (set ServeConfig(stream_provision_tiles=...) "
+                "or pass tiles=)"
+            )
+        with self._seq:
+            with self._fence:
+                while self._inflight_flushes:
+                    self._fence.wait()
+                self._cancel_prefetch()
+                report = stream.provision_reserve(int(tiles))
+                if self._programs is not None:
+                    # the fence Condition wraps _lock (already held)
+                    self._programs.reprovision(
+                        self._sampler.fused_graph_arrays(),
+                        params=self._params,
+                    )
+        return report
+
+    def _compact_loop(self) -> None:
+        """The background compaction daemon body: on a
+        ``stream_compact_every_s`` timer, read the reserve report (no
+        fence) and run `compact_graph` when `lifecycle.CompactionPolicy`
+        says the reclaimable mass crossed ``stream_compact_min_reclaim``.
+        A failing pass is counted in ``tier_adapt_errors``' sibling
+        pattern — never fatal to serving."""
+        from ..lifecycle import CompactionPolicy
+
+        policy = CompactionPolicy(
+            min_reclaimable=self.config.stream_compact_min_reclaim,
+            max_moves=self.config.stream_compact_max_moves,
+        )
+        while self._running:
+            time.sleep(self.config.stream_compact_every_s)
+            if not self._running:
+                return
+            try:
+                stream = getattr(self._sampler, "stream", None)
+                if stream is None:
+                    continue
+                if policy.should_compact(stream.reserve_report()):
+                    self.compact_graph()
+            except Exception:
+                self.compact_errors += 1
 
     # -- adaptive tier placement (round 14) --------------------------------
 
@@ -2459,6 +2830,19 @@ class ServeEngine:
                 threading.Thread(
                     target=self._tier_loop,
                     name="quiver-serve-tiers",
+                    daemon=True,
+                )
+            )
+        if (
+            self.config.stream_compact_every_s > 0
+            and getattr(self._sampler, "stream", None) is not None
+        ):
+            # the round-21 background compactor: plans off-fence, flips
+            # under the fence, observe-only on bits (see compact_graph)
+            self._threads.append(
+                threading.Thread(
+                    target=self._compact_loop,
+                    name="quiver-serve-compactor",
                     daemon=True,
                 )
             )

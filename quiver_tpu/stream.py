@@ -55,6 +55,38 @@ stamp invalidation set — every seed whose k-hop expansion could reach a
 changed row). The serve engines wire all of this through
 ``update_graph(delta)`` — see `serve.engine.ServeEngine.update_graph` and
 docs/api.md "Streaming graphs".
+
+Round 21 (graph lifecycle, `quiver_tpu.lifecycle`) makes the stream live
+forever — the tile map learns to SHRINK, under three distinct bit
+disciplines (docs/api.md "Graph lifecycle" has the contract table):
+
+- **edge deletion / timestamp update** (`GraphDelta.remove_edges` /
+  `update_edges`): a deletion rewrites the node's lanes in place (the
+  surviving edges shift left, preserving base-first-arrivals-after
+  order), so the stream stays bit-equal to a graph FRESHLY BUILT without
+  the edge — deletion parity is rebuild parity, the same oracle appends
+  ride. Draw bits for touched rows change BY DESIGN (the Gumbel uniform
+  stream is positional).
+- **TTL retention** (`expire_edges`): expiry must NOT shift lanes — the
+  per-lane uniform draw makes any shift a bit change, which would break
+  the retention<->masking duality — so an expired edge's timestamp is
+  overwritten with ``+inf`` (a masked lane write: invisible at every
+  finite query t, exactly like a ``cutoff < ts`` band mask on the
+  unexpired twin). Dead lanes are RE-USED by later appends to the same
+  node (the adjacency replaces the entry in place, so rebuild parity
+  still holds), which is what keeps a sliding-window working set's tile
+  footprint flat.
+- **compaction** (`plan_compaction`/`apply_compaction`): strictly
+  observe-only on bits — it reclaims whole tile ROWS (spill-retired
+  ranges, over-allocated tails, defrag relocations through the ``base``
+  indirection), never lanes, because `ops.sample._tiled_resolve` reads
+  positions through ``base`` and the degree mask: row placement is
+  invisible to every draw.
+
+Reserve exhaustion stops being terminal: `provision_reserve` grows the
+tile tables by a whole bank (one shape change, one sealed-program
+rebuild — never a per-commit recompile; see
+`inference.BucketPrograms.reprovision`).
 """
 
 from __future__ import annotations
@@ -126,7 +158,8 @@ class GraphDelta:
     commits the whole batch. Deterministic: two buffers fed the same
     arrivals apply identically."""
 
-    __slots__ = ("_src", "_dst", "_ts", "_n")
+    __slots__ = ("_src", "_dst", "_ts", "_n",
+                 "_rsrc", "_rdst", "_usrc", "_udst", "_uts")
 
     def __init__(self, src=None, dst=None, ts=None):
         self._src: List[np.ndarray] = []
@@ -136,6 +169,16 @@ class GraphDelta:
         # buffer could not commit into a temporal tile map deterministically
         self._ts: List[np.ndarray] = []
         self._n = 0
+        # round-21 lifecycle: staged removals and timestamp updates, in
+        # their own arrival order. One commit applies installs, then
+        # appends, then removals, then updates — the fixed order every
+        # preflight simulates, so "remove an edge this same batch
+        # appended" validates exactly once, the same everywhere.
+        self._rsrc: List[np.ndarray] = []
+        self._rdst: List[np.ndarray] = []
+        self._usrc: List[np.ndarray] = []
+        self._udst: List[np.ndarray] = []
+        self._uts: List[np.ndarray] = []
         if src is not None or dst is not None:
             if (src is None) != (dst is None):
                 raise ValueError("src/dst lengths differ")
@@ -170,6 +213,55 @@ class GraphDelta:
                 self._ts.append(ts.copy())
             self._n += int(src.size)
 
+    def remove_edge(self, src: int, dst: int) -> None:
+        self.remove_edges(np.asarray([src], np.int64),
+                          np.asarray([dst], np.int64))
+
+    def remove_edges(self, src, dst) -> None:
+        """Stage edge DELETIONS: each ``(src, dst)`` pair removes one
+        occurrence of that edge (first in lane order) at commit time.
+        All-or-none: the commit preflight validates every removal
+        against the post-append adjacency and a single miss fails the
+        whole batch before any state moves. A deletion rewrites the
+        source row's lanes (survivors shift left), so the stream stays
+        bit-equal to a graph freshly built WITHOUT the edge — touched
+        rows' draws change by design and are invalidated like appends."""
+        src, dst = validate_edge_ids(src, dst)
+        if src.size:
+            self._rsrc.append(src.copy())
+            self._rdst.append(dst.copy())
+
+    def update_edge(self, src: int, dst: int, ts: float) -> None:
+        self.update_edges(np.asarray([src], np.int64),
+                          np.asarray([dst], np.int64),
+                          np.asarray([ts], np.float32))
+
+    def update_edges(self, src, dst, ts) -> None:
+        """Stage per-edge TIMESTAMP updates (temporal streams only —
+        the timestamp is the one mutable per-edge payload a streamed
+        tile map carries; plain streams have no weight tiles to write).
+        Each pair retargets the first lane-order occurrence of
+        ``(src, dst)``; timestamps must be finite (``+inf`` is the
+        retention layer's expiry sentinel — see ``expire_edges``)."""
+        src, dst = validate_edge_ids(src, dst)
+        if ts is None:
+            raise ValueError(
+                "update_edges needs a timestamp per edge — the ts lane "
+                "is the only mutable per-edge payload"
+            )
+        ts = np.asarray(ts, np.float32).reshape(-1)
+        if ts.shape != src.shape:
+            raise ValueError(f"ts {ts.shape} != edges {src.shape}")
+        if ts.size and not np.isfinite(ts).all():
+            raise ValueError(
+                "non-finite edge timestamps staged — +inf is reserved "
+                "as the retention expiry sentinel"
+            )
+        if src.size:
+            self._usrc.append(src.copy())
+            self._udst.append(dst.copy())
+            self._uts.append(ts.copy())
+
     def extend(self, other: "GraphDelta") -> None:
         if self._n and other._n and bool(self._ts) != bool(other._ts):
             raise ValueError(
@@ -179,9 +271,23 @@ class GraphDelta:
         self._dst.extend(other._dst)
         self._ts.extend(other._ts)
         self._n += other._n
+        self._rsrc.extend(other._rsrc)
+        self._rdst.extend(other._rdst)
+        self._usrc.extend(other._usrc)
+        self._udst.extend(other._udst)
+        self._uts.extend(other._uts)
+
+    @property
+    def n_appends(self) -> int:
+        return self._n
 
     def __len__(self) -> int:
-        return self._n
+        # total staged OPERATIONS: appends + removals + updates (the
+        # engines use this for "is there anything to commit" and for
+        # their delta_edges op counters)
+        return self._n + sum(c.size for c in self._rsrc) + sum(
+            c.size for c in self._usrc
+        )
 
     def edges(self) -> Tuple[np.ndarray, np.ndarray]:
         """``(src, dst)`` int64 arrays in arrival order."""
@@ -196,19 +302,53 @@ class GraphDelta:
             return None
         return np.concatenate(self._ts)
 
+    def removals(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Staged removal pairs ``(src, dst)`` in arrival order."""
+        if not self._rsrc:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        return np.concatenate(self._rsrc), np.concatenate(self._rdst)
+
+    def updates(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Staged timestamp updates ``(src, dst, ts)`` in arrival
+        order."""
+        if not self._usrc:
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    np.empty(0, np.float32))
+        return (np.concatenate(self._usrc), np.concatenate(self._udst),
+                np.concatenate(self._uts))
+
+    def max_ts(self):
+        """Largest staged timestamp (appends and updates), or None when
+        nothing timestamped is staged — the commit clock the retention
+        layer advances on (`lifecycle.RetentionPolicy`)."""
+        parts = [c for c in self._ts if c.size] + [
+            c for c in self._uts if c.size
+        ]
+        if not parts:
+            return None
+        return float(max(float(c.max()) for c in parts))
+
     def sources(self) -> np.ndarray:
-        """Sorted unique source ids — the rows whose degree (and hence
-        whose downstream draws) this delta changes. Destinations are new
-        LEAVES: they change no other row's draw, so invalidation closures
-        seed from sources only."""
-        if not self._src:
+        """Sorted unique source ids — the rows whose lanes (and hence
+        whose downstream draws) this delta changes: append, removal, and
+        update sources alike. Destinations are new LEAVES: they change
+        no other row's draw, so invalidation closures seed from sources
+        only."""
+        parts = self._src + self._rsrc + self._usrc
+        if not parts:
             return np.empty(0, np.int64)
-        return np.unique(np.concatenate(self._src))
+        return np.unique(np.concatenate(parts))
 
     def clear(self) -> None:
         self._src.clear()
         self._dst.clear()
+        self._ts.clear()
         self._n = 0
+        self._rsrc.clear()
+        self._rdst.clear()
+        self._usrc.clear()
+        self._udst.clear()
+        self._uts.clear()
 
 
 class StreamingAdjacency:
@@ -246,6 +386,15 @@ class StreamingAdjacency:
         self._extra_ts: Dict[int, List[float]] = {}
         self._rev_extra: Dict[int, List[int]] = {}
         self._n_extra = 0
+        # round-21 lifecycle: once a row is deleted-from / expired /
+        # ts-updated, its FULL lane list moves into an override (base
+        # slice copied out + extras folded in — `_materialize`), and the
+        # base CSR stops describing it. Keys here are disjoint from
+        # `_extra` by construction. The REVERSE adjacency is never
+        # shrunk by removals: reverse closures become supersets, which
+        # only ever over-invalidates (safe; pinned in tests).
+        self._override: Dict[int, List[int]] = {}
+        self._override_ts: Dict[int, List[float]] = {}
         # reverse base CSR (counting sort, same construction as CSRTopo)
         order = np.argsort(self.indices, kind="stable")
         counts = np.bincount(self.indices, minlength=self.n)
@@ -259,7 +408,10 @@ class StreamingAdjacency:
 
     @property
     def extra_edges(self) -> int:
-        return self._n_extra
+        # net appended-beyond-base count; clamped because a
+        # deletion-heavy lifecycle can remove more base edges than were
+        # ever appended
+        return max(self._n_extra, 0)
 
     def add_edges(self, src, dst, ts=None) -> None:
         src, dst = validate_edge_ids(src, dst, self.n)
@@ -273,11 +425,25 @@ class StreamingAdjacency:
             if ts.shape != src.shape:
                 raise ValueError(f"ts {ts.shape} != edges {src.shape}")
         for i, (u, v) in enumerate(zip(src, dst)):
-            self._extra.setdefault(int(u), []).append(int(v))
+            self._append_one(
+                int(u), int(v),
+                ts=None if self.edge_ts is None else float(ts[i]),
+            )
+
+    def _append_one(self, u: int, v: int,
+                    ts: Optional[float] = None) -> None:
+        """Append one edge to ``u``'s lane tail — into the override list
+        when the row is materialized, the extra list otherwise."""
+        if u in self._override:
+            self._override[u].append(v)
             if self.edge_ts is not None:
-                self._extra_ts.setdefault(int(u), []).append(float(ts[i]))
-            self._rev_extra.setdefault(int(v), []).append(int(u))
-        self._n_extra += src.shape[0]
+                self._override_ts[u].append(float(ts))
+        else:
+            self._extra.setdefault(u, []).append(v)
+            if self.edge_ts is not None:
+                self._extra_ts.setdefault(u, []).append(float(ts))
+        self._rev_extra.setdefault(v, []).append(u)
+        self._n_extra += 1
 
     def pop_edges(self, src, dst) -> None:
         """Reverse a JUST-APPLIED `add_edges(src, dst)` — the caller's
@@ -289,18 +455,108 @@ class StreamingAdjacency:
         src = np.asarray(src, np.int64).reshape(-1)
         dst = np.asarray(dst, np.int64).reshape(-1)
         for u, v in zip(src[::-1], dst[::-1]):
-            self._extra[int(u)].pop()
-            if self.edge_ts is not None:
-                self._extra_ts[int(u)].pop()
-            self._rev_extra[int(v)].pop()
+            u, v = int(u), int(v)
+            if u in self._override:
+                self._override[u].pop()
+                if self.edge_ts is not None:
+                    self._override_ts[u].pop()
+            else:
+                self._extra[u].pop()
+                if self.edge_ts is not None:
+                    self._extra_ts[u].pop()
+            self._rev_extra[v].pop()
         self._n_extra -= src.shape[0]
+
+    # ------------------------------------------------ lifecycle (r21)
+    def _materialize(self, u: int) -> List[int]:
+        """Fold ``u``'s base CSR slice and extras into a mutable
+        override list (idempotent). Lane order is preserved exactly, so
+        a materialized-but-untouched row answers every query the same as
+        before — materialization itself changes no bit."""
+        ov = self._override.get(u)
+        if ov is not None:
+            return ov
+        base = self.indices[self.indptr[u]:self.indptr[u + 1]]
+        ov = [int(x) for x in base] + self._extra.pop(u, [])
+        self._override[u] = ov
+        if self.edge_ts is not None:
+            bts = self.edge_ts[self.indptr[u]:self.indptr[u + 1]]
+            self._override_ts[u] = (
+                [float(x) for x in bts] + self._extra_ts.pop(u, [])
+            )
+        return ov
+
+    def remove_one(self, u: int, v: int) -> int:
+        """Delete the first lane-order occurrence of ``(u, v)``; returns
+        the lane position it held. Survivors shift left — the caller
+        rewrites the row's tiles from the updated list. Raises KeyError
+        semantics as ValueError when the edge is absent (commit-level
+        all-or-none is the stream preflight's job)."""
+        ov = self._materialize(u)
+        try:
+            p = ov.index(v)
+        except ValueError:
+            raise ValueError(f"edge ({u}, {v}) not present") from None
+        del ov[p]
+        if self.edge_ts is not None:
+            del self._override_ts[u][p]
+        self._n_extra -= 1
+        return p
+
+    def update_one(self, u: int, v: int, ts: float) -> int:
+        """Retarget the first lane-order occurrence of ``(u, v)`` to a
+        new timestamp; returns its lane position (the tile lane the
+        caller rewrites). Temporal adjacencies only."""
+        if self.edge_ts is None:
+            raise ValueError("adjacency was built without edge_ts")
+        ov = self._materialize(u)
+        try:
+            p = ov.index(v)
+        except ValueError:
+            raise ValueError(f"edge ({u}, {v}) not present") from None
+        self._override_ts[u][p] = float(ts)
+        return p
+
+    def replace_at(self, u: int, p: int, v: int,
+                   ts: Optional[float] = None) -> None:
+        """Overwrite lane position ``p`` of ``u`` with a NEW edge —
+        dead-lane reuse: the expired entry it replaces was already
+        invisible to every draw, and replacing in place (instead of
+        appending) is what keeps the adjacency in lane-lockstep with the
+        tiles, so rebuild parity survives. The expired neighbor's
+        reverse entry stays (reverse closures are supersets)."""
+        ov = self._materialize(u)
+        ov[p] = v
+        if self.edge_ts is not None:
+            self._override_ts[u][p] = float(ts)
+        self._rev_extra.setdefault(v, []).append(u)
+
+    def expire_node(self, u: int, cutoff: float) -> List[int]:
+        """Mask every edge of ``u`` with ``ts <= cutoff`` by overwriting
+        its timestamp with ``+inf`` (already-expired lanes hold +inf and
+        never re-match). Returns the masked lane positions, ascending.
+        NO lane shifts: expiry must stay the bit-dual of a
+        ``cutoff < ts`` band mask, and the Gumbel uniform stream is
+        positional."""
+        if self.edge_ts is None:
+            raise ValueError("adjacency was built without edge_ts")
+        self._materialize(u)
+        tsl = self._override_ts[u]
+        pos = [p for p, t in enumerate(tsl) if t <= cutoff]
+        for p in pos:
+            tsl[p] = float("inf")
+        return pos
 
     def neighbors(self, node: int) -> np.ndarray:
         """Current adjacency of ``node`` in TILE-LANE order: the base CSR
         row first, appended arrivals after (the order `to_csr_topo`
         materializes and the tile writes preserve — draw parity rides
-        it)."""
+        it). Materialized (lifecycle-touched) rows answer from their
+        override list — same order contract."""
         node = int(node)
+        ov = self._override.get(node)
+        if ov is not None:
+            return np.asarray(ov, np.int64)
         base = self.indices[self.indptr[node]:self.indptr[node + 1]]
         extra = self._extra.get(node)
         if not extra:
@@ -309,11 +565,14 @@ class StreamingAdjacency:
 
     def neighbors_ts(self, node: int) -> np.ndarray:
         """Per-edge timestamps of `neighbors(node)`, same lane order
-        (base CSR ts first, appended arrival ts after). Temporal
-        adjacencies only."""
+        (base CSR ts first, appended arrival ts after; expired lanes
+        read ``+inf``). Temporal adjacencies only."""
         if self.edge_ts is None:
             raise ValueError("adjacency was built without edge_ts")
         node = int(node)
+        ov = self._override_ts.get(node)
+        if ov is not None:
+            return np.asarray(ov, np.float32)
         base = self.edge_ts[self.indptr[node]:self.indptr[node + 1]]
         extra = self._extra_ts.get(node)
         if not extra:
@@ -322,6 +581,9 @@ class StreamingAdjacency:
 
     def degree(self, node: int) -> int:
         node = int(node)
+        ov = self._override.get(node)
+        if ov is not None:
+            return len(ov)
         return int(self.indptr[node + 1] - self.indptr[node]) + len(
             self._extra.get(node, ())
         )
@@ -342,7 +604,7 @@ class StreamingAdjacency:
             if frontier.size == 0:
                 break
             nxt = self._expand(frontier, self.indptr, self.indices,
-                               self._extra)
+                               self._extra, self._override)
             nxt = nxt[~mask[nxt]]
             if nxt.size == 0:
                 break
@@ -375,14 +637,27 @@ class StreamingAdjacency:
         return np.nonzero(mask)[0]
 
     @staticmethod
-    def _expand(frontier, indptr, indices, extra):
+    def _expand(frontier, indptr, indices, extra, override=None):
         """One BFS hop: base-CSR rows vectorized, appended edges via the
-        per-node dicts (bounded by the delta volume, never O(E))."""
+        per-node dicts (bounded by the delta volume, never O(E)).
+        Materialized rows (``override``, forward direction only) answer
+        from their override lists instead of base+extra — the reverse
+        direction has no overrides and stays a superset after
+        removals."""
+        if override:
+            keep = np.fromiter(
+                (int(u) not in override for u in frontier), bool,
+                frontier.shape[0],
+            )
+            ov_nodes = frontier[~keep]
+            frontier = frontier[keep]
+        else:
+            ov_nodes = None
         parts = []
         starts = indptr[frontier]
         ends = indptr[frontier + 1]
         widths = ends - starts
-        if widths.sum() > 0:
+        if frontier.size and widths.sum() > 0:
             flat = np.concatenate([
                 indices[s:e] for s, e in zip(starts, ends) if e > s
             ])
@@ -392,6 +667,12 @@ class StreamingAdjacency:
             if ext:
                 parts.append(np.concatenate(
                     [np.asarray(x, np.int64) for x in ext]
+                ))
+        if ov_nodes is not None and ov_nodes.size:
+            ov = [override[int(u)] for u in ov_nodes if override[int(u)]]
+            if ov:
+                parts.append(np.concatenate(
+                    [np.asarray(x, np.int64) for x in ov]
                 ))
         if not parts:
             return np.array([], np.int64)
@@ -405,25 +686,39 @@ class StreamingAdjacency:
         NOT the serving path — serving mutates tiles in place."""
         from .utils import CSRTopo
 
-        if not self._extra:
+        if not self._extra and not self._override:
             return CSRTopo(indptr=self.indptr.copy(),
                            indices=self.indices.copy())
-        extra_deg = np.zeros(self.n, np.int64)
-        for u, vs in self._extra.items():
-            extra_deg[u] = len(vs)
         base_deg = self.indptr[1:] - self.indptr[:-1]
-        new_deg = base_deg + extra_deg
+        new_deg = base_deg.copy()
+        for u, vs in self._extra.items():
+            new_deg[u] += len(vs)
+        for u, vs in self._override.items():
+            new_deg[u] = len(vs)
         new_indptr = np.zeros(self.n + 1, np.int64)
         np.cumsum(new_deg, out=new_indptr[1:])
         new_indices = np.empty(int(new_indptr[-1]), np.int64)
-        # base block copy: each row's base edges land at its new offset
+        # base block copy: each non-overridden row's base edges land at
+        # its new offset; materialized rows are written wholesale below
         src_per_edge = np.repeat(np.arange(self.n, dtype=np.int64), base_deg)
         pos_in_row = np.arange(self.indices.shape[0], dtype=np.int64) - (
             np.repeat(self.indptr[:-1], base_deg)
         )
-        new_indices[new_indptr[src_per_edge] + pos_in_row] = self.indices
+        if self._override:
+            keep = np.ones(self.n, bool)
+            keep[np.fromiter(self._override.keys(), np.int64,
+                             len(self._override))] = False
+            sel = keep[src_per_edge]
+            new_indices[new_indptr[src_per_edge[sel]] + pos_in_row[sel]] = (
+                self.indices[sel]
+            )
+        else:
+            new_indices[new_indptr[src_per_edge] + pos_in_row] = self.indices
         for u, vs in self._extra.items():
             lo = int(new_indptr[u] + base_deg[u])
+            new_indices[lo:lo + len(vs)] = vs
+        for u, vs in self._override.items():
+            lo = int(new_indptr[u])
             new_indices[lo:lo + len(vs)] = vs
         return CSRTopo(indptr=new_indptr, indices=new_indices)
 
@@ -436,7 +731,7 @@ class StreamingAdjacency:
         if self.edge_ts is None:
             raise ValueError("adjacency was built without edge_ts")
         topo = self.to_csr_topo()
-        if not self._extra:
+        if not self._extra and not self._override:
             return topo, self.edge_ts.copy()
         new_indptr = np.asarray(topo.indptr, np.int64)
         base_deg = self.indptr[1:] - self.indptr[:-1]
@@ -445,12 +740,27 @@ class StreamingAdjacency:
         pos_in_row = np.arange(self.indices.shape[0], dtype=np.int64) - (
             np.repeat(self.indptr[:-1], base_deg)
         )
-        new_ts[new_indptr[src_per_edge] + pos_in_row] = self.edge_ts
+        if self._override:
+            keep = np.ones(self.n, bool)
+            keep[np.fromiter(self._override.keys(), np.int64,
+                             len(self._override))] = False
+            sel = keep[src_per_edge]
+            new_ts[new_indptr[src_per_edge[sel]] + pos_in_row[sel]] = (
+                self.edge_ts[sel]
+            )
+        else:
+            new_ts[new_indptr[src_per_edge] + pos_in_row] = self.edge_ts
         for u, vs in self._extra.items():
             lo = int(new_indptr[u] + base_deg[u])
             new_ts[lo:lo + len(vs)] = np.asarray(
                 self._extra_ts.get(u, []), np.float32
             )
+        # materialized rows carry their ts wholesale (expired lanes as
+        # +inf — a rebuild over this surface reproduces the masked lanes
+        # bit for bit, which is what deletion/retention parity pins)
+        for u, tsl in self._override_ts.items():
+            lo = int(new_indptr[u])
+            new_ts[lo:lo + len(tsl)] = np.asarray(tsl, np.float32)
         return topo, new_ts
 
 
@@ -535,7 +845,33 @@ class StreamingTiledGraph:
             self.ttiles[:m] = tt
         deg = self.bd[:, 1].astype(np.int64)
         self.alloc_rows = (-(-deg // LANE)).astype(np.int32)  # rows held
-        self._free_row = m
+        # free tile rows as a sorted, coalescing range list — first-fit
+        # from the LOWEST start (deterministic). Starts as the whole
+        # reserve; compaction releases reclaimed rows back here, and
+        # `provision_reserve` appends whole new banks.
+        self._free_ranges: List[List[int]] = (
+            [[m, self.m_cap - m]] if self.m_cap > m else []
+        )
+        # rows vacated by spill relocations park here (NOT freed at
+        # relocate time — r17 semantics: the reserve report counts them
+        # as consumed) until a compaction releases them
+        self._retired: List[Tuple[int, int]] = []
+        self._retired_rows = 0
+        # expired (masked, ts=+inf) lane positions per node, ascending —
+        # appends re-use the lowest dead lane before growing the degree
+        self._dead: Dict[int, List[int]] = {}
+        self._dead_lanes = 0
+        # per-node min finite edge ts (+inf when none): makes
+        # `expire_edges(cutoff)` an O(expiring) scan, not O(N * deg)
+        self._min_ts: Optional[np.ndarray] = None
+        if edge_ts is not None:
+            self._min_ts = np.full(self.n, np.inf, np.float32)
+            base_deg = (self.adj.indptr[1:] - self.adj.indptr[:-1])
+            np.minimum.at(
+                self._min_ts,
+                np.repeat(np.arange(self.n, dtype=np.int64), base_deg),
+                self.adj.edge_ts,
+            )
         self.version = 0
         # versioned node stamps: the graph version at which a node's row
         # last changed — the invalidation consumers (cache / replicas /
@@ -543,7 +879,12 @@ class StreamingTiledGraph:
         self.node_version = np.zeros(self.n, np.int64)
         self.stats = {"pad_writes": 0, "tile_spills": 0, "installs": 0,
                       "tile_rows_swapped": 0, "bd_rows_swapped": 0,
-                      "edges": 0}
+                      "edges": 0,
+                      # round-21 lifecycle counters
+                      "edges_deleted": 0, "edges_expired": 0,
+                      "ts_updates": 0, "lanes_reused": 0,
+                      "tiles_reclaimed": 0, "compactions": 0,
+                      "provisions": 0}
         self._lock = threading.Lock()
         self._bd_dev = None
         self._tiles_dev = None
@@ -556,16 +897,77 @@ class StreamingTiledGraph:
             if self.ttiles is not None:
                 self._tt_dev = jnp.asarray(self.ttiles)
 
+    # -------------------------------------------------- row allocator
+    @staticmethod
+    def _take(ranges: List[List[int]], k: int) -> Optional[int]:
+        """First-fit ``k`` contiguous rows from the LOWEST-start free
+        range (deterministic); None when no single range fits. The
+        preflight simulates allocation on a copy with this same
+        function, so "enough total rows but too fragmented" fails there,
+        not mid-commit."""
+        for r in ranges:
+            if r[1] >= k:
+                start = r[0]
+                r[0] += k
+                r[1] -= k
+                if r[1] == 0:
+                    ranges.remove(r)
+                return start
+        return None
+
+    @staticmethod
+    def _put(ranges: List[List[int]], start: int, k: int) -> None:
+        """Return ``k`` rows at ``start`` to a free list, keeping it
+        sorted and coalescing with adjacent ranges."""
+        if k <= 0:
+            return
+        i = 0
+        while i < len(ranges) and ranges[i][0] < start:
+            i += 1
+        ranges.insert(i, [start, k])
+        if i + 1 < len(ranges) and (
+            ranges[i][0] + ranges[i][1] == ranges[i + 1][0]
+        ):
+            ranges[i][1] += ranges[i + 1][1]
+            del ranges[i + 1]
+        if i > 0 and ranges[i - 1][0] + ranges[i - 1][1] == ranges[i][0]:
+            ranges[i - 1][1] += ranges[i][1]
+            del ranges[i]
+
+    def _release_locked(self, start: int, k: int) -> None:
+        """Free ``k`` rows at ``start`` AND zero their host mirror, so a
+        later reallocation's device sync ships bytes identical to a
+        fresh reserve row (released device rows keep stale bytes until
+        then — unreachable: the degree mask gates every read)."""
+        if k <= 0:
+            return
+        self.tiles[start:start + k] = 0
+        if self.ttiles is not None:
+            self.ttiles[start:start + k] = 0
+        self._put(self._free_ranges, start, k)
+
     # ------------------------------------------------------------ reads
     @property
     def free_rows(self) -> int:
-        return self.m_cap - self._free_row
+        return sum(r[1] for r in self._free_ranges)
+
+    @property
+    def _free_row(self) -> int:
+        # compatibility view of the pre-r21 bump pointer: rows consumed
+        # so far, measured from the table base (== the old next-free-row
+        # watermark whenever nothing has been reclaimed)
+        return self.m_cap - self.free_rows
 
     def _reserve_report_locked(self) -> Dict[str, object]:
-        used = self._free_row - self.m_base
-        free = self.m_cap - self._free_row
+        free = self.free_rows
+        used = max((self.m_cap - self.m_base) - free, 0)
         commits = self.version
         per_commit = used / commits if commits else 0.0
+        deg = self.bd[:, 1].astype(np.int64)
+        tight = -(-deg // LANE)
+        alloc = self.alloc_rows.astype(np.int64)
+        deg_sum = int(deg.sum())
+        trimmable = int(np.maximum(alloc - tight, 0).sum())
         return {
             "tiles_base": self.m_base,
             "tiles_cap": self.m_cap,
@@ -581,6 +983,19 @@ class StreamingTiledGraph:
             ),
             "tile_spills": self.stats["tile_spills"],
             "installs": self.stats["installs"],
+            # round-21 lifecycle fields (exported as gauges by
+            # `serve.engine.register_stream_reserve`):
+            # slack lanes inside held rows — over-allocation from spill
+            # growth and deletions, the compaction trim target
+            "fragmented_lanes": int(alloc.sum()) * LANE - deg_sum,
+            # rows a compaction pass could hand back to the free list
+            # right now: spill-retired ranges + trimmable tails
+            "reclaimable_tiles": self._retired_rows + trimmable,
+            # expired (masked) lanes as a fraction of live lane content —
+            # the append path re-uses these before consuming new rows
+            "dead_lane_frac": (
+                self._dead_lanes / deg_sum if deg_sum else 0.0
+            ),
         }
 
     def reserve_report(self) -> Dict[str, object]:
@@ -604,8 +1019,10 @@ class StreamingTiledGraph:
             f"({r['rows_per_commit']:.2f} rows/commit"
             + (f", ~{proj:.0f} commits of runway were left"
                if proj is not None else "")
-            + "); rebuild the stream with a larger reserve_frac/"
-            "reserve_tiles (shapes are frozen — see StreamingTiledGraph)"
+            + "); reclaim rows with compaction "
+            "(plan_compaction/apply_compaction), grow the bank with "
+            "provision_reserve (one sealed-program rebuild), or rebuild "
+            "the stream with a larger reserve_frac/reserve_tiles"
         )
 
     @property
@@ -676,9 +1093,12 @@ class StreamingTiledGraph:
             np.array([], np.int64), np.array([], np.int64)
         )
         ts = delta.edges_ts() if delta is not None else None
+        removals = delta.removals() if delta is not None else None
+        updates = delta.updates() if delta is not None else None
         installs = self._normalize_installs(installs)
         with self._lock:
-            return self._preflight_locked(src, dst, installs, ts)
+            return self._preflight_locked(src, dst, installs, ts,
+                                          removals, updates)
 
     def _normalize_installs(self, installs):
         """Normalize install entries to ``(node, nbrs, ts_row|None)`` —
@@ -721,14 +1141,88 @@ class StreamingTiledGraph:
                     "edge timestamps staged into a non-temporal stream — "
                     "build StreamingTiledGraph(edge_ts=...) to carry them"
                 )
+        if ts is not None and ts.size and not np.isfinite(ts).all():
+            raise ValueError(
+                "non-finite appended timestamps — +inf is reserved as "
+                "the retention expiry sentinel (expire_edges)"
+            )
+        for node, _nbrs, ts_row in installs:
+            if ts_row is not None and ts_row.size and (
+                not np.isfinite(ts_row).all()
+            ):
+                raise ValueError(
+                    f"non-finite install timestamps for node {node} — "
+                    "+inf is reserved as the retention expiry sentinel"
+                )
 
-    def _preflight_locked(self, src, dst, installs, ts=None) -> int:
+    def _preflight_locked(self, src, dst, installs, ts=None,
+                          removals=None, updates=None) -> int:
         if src.size:
             validate_edge_ids(src, dst, self.n)
         self._check_ts(src, ts, installs)
+        rsrc, rdst = removals if removals is not None else (
+            np.empty(0, np.int64), np.empty(0, np.int64)
+        )
+        usrc, udst, uts = updates if updates is not None else (
+            np.empty(0, np.int64), np.empty(0, np.int64),
+            np.empty(0, np.float32),
+        )
+        if rsrc.size:
+            validate_edge_ids(rsrc, rdst, self.n, what="removal")
+        if usrc.size:
+            validate_edge_ids(usrc, udst, self.n, what="update")
+            if not self.temporal:
+                raise ValueError(
+                    "timestamp updates staged into a non-temporal "
+                    "stream — streamed tiles carry no weight payload; "
+                    "the ts lane (edge_ts=...) is the one mutable "
+                    "per-edge field"
+                )
+        # removal/update existence, simulated in APPLY ORDER (installs,
+        # appends, removals, updates) over per-(u, v) occurrence counts —
+        # all-or-none: one missing edge fails the whole batch here
+        if rsrc.size or usrc.size:
+            pairs = set(zip(rsrc.tolist(), rdst.tolist())) | set(
+                zip(usrc.tolist(), udst.tolist())
+            )
+            inst_rows = {node: nbrs for node, nbrs, _ in installs}
+            avail: Dict[Tuple[int, int], int] = {}
+            rows_cache: Dict[int, np.ndarray] = {}
+            for (u, v) in pairs:
+                if u not in rows_cache:
+                    rows_cache[u] = (
+                        inst_rows[u] if u in inst_rows
+                        else self.adj.neighbors(u)
+                    )
+                avail[(u, v)] = int((rows_cache[u] == v).sum())
+            for u, v in zip(src.tolist(), dst.tolist()):
+                if (u, v) in avail:
+                    avail[(u, v)] += 1
+            for u, v in zip(rsrc.tolist(), rdst.tolist()):
+                avail[(u, v)] -= 1
+                if avail[(u, v)] < 0:
+                    raise ValueError(
+                        f"removal of absent edge ({u}, {v}) — the whole "
+                        "batch is rejected (all-or-none), nothing was "
+                        "applied"
+                    )
+            for u, v in zip(usrc.tolist(), udst.tolist()):
+                if avail[(u, v)] <= 0:
+                    raise ValueError(
+                        f"timestamp update of absent edge ({u}, {v}) — "
+                        "the whole batch is rejected (all-or-none), "
+                        "nothing was applied"
+                    )
+        # reserve capacity: simulate the allocator EXACTLY (same
+        # first-fit walk apply will take, on a scratch copy of the free
+        # ranges) — with reclamation the free pool fragments, and
+        # "enough total rows but no contiguous fit" must fail here, not
+        # mid-commit
         need = 0
+        sim_ranges = [r[:] for r in self._free_ranges]
         sim_alloc: Dict[int, int] = {}
         sim_deg: Dict[int, int] = {}
+        sim_dead: Dict[int, int] = {}
         for node, nbrs, _ts_row in installs:
             if not 0 <= node < self.n:
                 raise ValueError(
@@ -753,25 +1247,48 @@ class StreamingTiledGraph:
                     f"{node} has degree {int(self.bd[node, 1])}); use "
                     "apply() appends for materialized rows"
                 )
+            if nbrs.size == 0:
+                sim_deg[node] = 0
+                sim_alloc[node] = int(self.alloc_rows[node])
+                continue
+            # a deleted-to-zero row re-installing releases its old rows
+            # first, exactly as _install_locked will
+            old = int(self.alloc_rows[node])
+            if old:
+                self._put(sim_ranges, int(self.bd[node, 0]), old)
             rows = -(-int(nbrs.size) // LANE)
             need += rows
+            if self._take(sim_ranges, rows) is None:
+                raise self._capacity_error(
+                    f"tile reserve exhausted: install of node {node} "
+                    f"needs {rows} contiguous rows, "
+                    f"{sum(r[1] for r in sim_ranges)} free"
+                )
             sim_alloc[node] = rows
             sim_deg[node] = int(nbrs.size)
+            sim_dead[node] = 0
         for u in src:
             u = int(u)
+            dead = sim_dead.get(u, len(self._dead.get(u, ())))
+            if dead > 0:
+                # the append re-uses an expired lane: no degree growth,
+                # no spill risk
+                sim_dead[u] = dead - 1
+                continue
+            sim_dead[u] = 0
             d = sim_deg.get(u, int(self.bd[u, 1]))
             a = sim_alloc.get(u, int(self.alloc_rows[u]))
             if d >= a * LANE:
                 a += self.grow_tiles
                 need += a
+                if self._take(sim_ranges, a) is None:
+                    raise self._capacity_error(
+                        f"tile reserve exhausted: batch needs {need} "
+                        f"rows ({a} contiguous for node {u}), "
+                        f"{sum(r[1] for r in sim_ranges)} free"
+                    )
                 sim_alloc[u] = a
             sim_deg[u] = d + 1
-        free = self.m_cap - self._free_row
-        if need > free:
-            raise self._capacity_error(
-                f"tile reserve exhausted: batch needs {need} rows, "
-                f"{free} free"
-            )
         return need
 
     def apply(self, delta: GraphDelta,
@@ -790,31 +1307,54 @@ class StreamingTiledGraph:
             np.array([], np.int64), np.array([], np.int64)
         )
         ts = delta.edges_ts() if delta is not None else None
+        removals = delta.removals() if delta is not None else (
+            np.empty(0, np.int64), np.empty(0, np.int64)
+        )
+        updates = delta.updates() if delta is not None else (
+            np.empty(0, np.int64), np.empty(0, np.int64),
+            np.empty(0, np.float32),
+        )
+        rsrc, rdst = removals
+        usrc, udst, uts = updates
         installs = self._normalize_installs(installs)
-        if src.size == 0 and not installs:
+        if (src.size == 0 and not installs and rsrc.size == 0
+                and usrc.size == 0):
             return {"edges": 0, "pad_writes": 0, "tile_spills": 0,
                     "installs": 0, "tile_rows_swapped": 0,
                     "bd_rows_swapped": 0, "free_rows": self.free_rows,
-                    "version": self.version}
+                    "version": self.version, "edges_deleted": 0,
+                    "ts_updates": 0, "lanes_reused": 0}
         with self._lock:
-            self._preflight_locked(src, dst, installs, ts)
+            self._preflight_locked(src, dst, installs, ts,
+                                   removals, updates)
             touched_tiles: set = set()
             touched_bd: set = set()
-            pad_writes = spills = 0
+            pad_writes = spills = reused = 0
             for node, nbrs, ts_row in installs:
                 self._install_locked(node, nbrs, touched_tiles, touched_bd,
                                      ts_row=ts_row)
-            if src.size:
-                # adjacency bookkeeping feeds closures (ids validated by
-                # the preflight above)
-                self.adj.add_edges(src, dst, ts=ts)
-                for i, (u, v) in enumerate(zip(src, dst)):
-                    p, s = self._append_locked(
-                        int(u), int(v), touched_tiles, touched_bd,
-                        ts=None if ts is None else float(ts[i]),
-                    )
-                    pad_writes += p
-                    spills += s
+            # per-edge: the adjacency and the tiles advance in lockstep
+            # (an append that re-uses a dead lane REPLACES the adjacency
+            # entry instead of appending — lane order stays shared, which
+            # is what keeps rebuild parity through the whole lifecycle).
+            # Ids were validated by the preflight above.
+            for i, (u, v) in enumerate(zip(src, dst)):
+                p, s, r = self._append_locked(
+                    int(u), int(v), touched_tiles, touched_bd,
+                    ts=None if ts is None else float(ts[i]),
+                )
+                pad_writes += p
+                spills += s
+                reused += r
+            if rsrc.size:
+                for u, v in zip(rsrc, rdst):
+                    self.adj.remove_one(int(u), int(v))
+                for u in np.unique(rsrc):
+                    self._rewrite_node_locked(int(u), touched_tiles,
+                                              touched_bd)
+            for u, v, t in zip(usrc, udst, uts):
+                self._update_one_locked(int(u), int(v), float(t),
+                                        touched_tiles, touched_bd)
             self.version += 1
             changed = np.fromiter(touched_bd, np.int64, len(touched_bd))
             self.node_version[changed] = self.version
@@ -824,12 +1364,17 @@ class StreamingTiledGraph:
             self.stats["tile_spills"] += spills
             self.stats["installs"] += len(installs)
             self.stats["edges"] += int(src.size)
+            self.stats["edges_deleted"] += int(rsrc.size)
+            self.stats["ts_updates"] += int(usrc.size)
+            self.stats["lanes_reused"] += reused
             self.stats["tile_rows_swapped"] += n_tiles
             self.stats["bd_rows_swapped"] += n_bd
             return {"edges": int(src.size), "pad_writes": pad_writes,
                     "tile_spills": spills, "installs": len(installs),
                     "tile_rows_swapped": n_tiles, "bd_rows_swapped": n_bd,
-                    "free_rows": self.free_rows, "version": self.version}
+                    "free_rows": self.free_rows, "version": self.version,
+                    "edges_deleted": int(rsrc.size),
+                    "ts_updates": int(usrc.size), "lanes_reused": reused}
 
     def install_rows(self, rows: Sequence[Tuple[int, np.ndarray]]
                      ) -> Dict[str, int]:
@@ -840,9 +1385,225 @@ class StreamingTiledGraph:
         `apply`."""
         return self.apply(None, installs=rows)
 
+    # -------------------------------------------------- lifecycle (r21)
+    def expire_edges(self, cutoff) -> Dict[str, object]:
+        """TTL retention commit: mask every edge with ``ts <= cutoff``
+        by overwriting its timestamp lane with ``+inf`` — NO lane
+        shifts, so the expired stream stays the exact bit-dual of the
+        unexpired stream queried with a ``cutoff < ts <= t`` band mask
+        (the r19 masking's natural dual; pinned in
+        tests/test_lifecycle.py). Masked lanes become the dead pool
+        later appends re-use. One batched device ttile swap; bumps the
+        version and stamps touched nodes (their draws at any t change),
+        so the engines' invalidation consumers fire exactly as for
+        appends. ``cutoff`` is snapped to the float32 grid — window
+        arithmetic must follow the `quantize_t` f32 rule."""
+        if not self.temporal:
+            raise ValueError(
+                "expire_edges needs a temporal stream (edge_ts=...) — "
+                "a plain stream has no timestamps to retire"
+            )
+        cutoff = np.float32(cutoff)
+        with self._lock:
+            cand = np.nonzero(self._min_ts <= cutoff)[0]
+            if cand.size == 0:
+                return {"edges_expired": 0, "nodes": 0,
+                        "version": self.version, "tile_rows_swapped": 0,
+                        "sources": np.empty(0, np.int64)}
+            touched_tiles: set = set()
+            touched_bd: set = set()
+            n_exp = 0
+            for u in cand:
+                u = int(u)
+                pos = self.adj.expire_node(u, float(cutoff))
+                if not pos:
+                    # stale min (shouldn't persist — reindex below keeps
+                    # it exact); recompute defensively
+                    self._reindex_node_ts_locked(
+                        u, self.adj.neighbors_ts(u))
+                    continue
+                base = int(self.bd[u, 0])
+                for p in pos:
+                    self.ttiles[base + p // LANE, p % LANE] = np.inf
+                    touched_tiles.add(base + p // LANE)
+                touched_bd.add(u)
+                n_exp += len(pos)
+                self._reindex_node_ts_locked(u, self.adj.neighbors_ts(u))
+            self.version += 1
+            changed = np.fromiter(touched_bd, np.int64, len(touched_bd))
+            self.node_version[changed] = self.version
+            n_tiles, n_bd = self._sync_device_locked(touched_tiles,
+                                                     touched_bd)
+            self.stats["edges_expired"] += n_exp
+            self.stats["tile_rows_swapped"] += n_tiles
+            self.stats["bd_rows_swapped"] += n_bd
+            return {"edges_expired": n_exp, "nodes": len(touched_bd),
+                    "version": self.version, "tile_rows_swapped": n_tiles,
+                    "sources": np.sort(changed)}
+
+    def plan_compaction(self, max_moves: int = 0) -> Dict[str, object]:
+        """Snapshot a reclamation plan — built OFF-FENCE (only the
+        stream lock, no traffic drain): spill-retired ranges to release,
+        over-allocated rows to trim (``alloc > ceil(deg/128)``), and up
+        to ``max_moves`` defrag relocations (highest-based nodes first).
+        Every per-node entry carries the node's version stamp;
+        `apply_compaction` skips entries whose row committed in between
+        (stale) — the LSM discipline: plan cheap, validate at flip."""
+        with self._lock:
+            plan: Dict[str, object] = {
+                "retired": [tuple(r) for r in self._retired],
+                "planned_at": self.version,
+            }
+            deg = self.bd[:, 1].astype(np.int64)
+            tight = -(-deg // LANE)
+            slack = self.alloc_rows.astype(np.int64) - tight
+            plan["trims"] = [
+                (int(u), int(self.node_version[u]))
+                for u in np.nonzero(slack > 0)[0]
+            ]
+            moves: List[Tuple[int, int]] = []
+            if max_moves:
+                order = np.argsort(self.bd[:, 0], kind="stable")[::-1]
+                for u in order:
+                    if len(moves) >= int(max_moves):
+                        break
+                    u = int(u)
+                    if self.alloc_rows[u] and int(self.bd[u, 0]):
+                        moves.append((u, int(self.node_version[u])))
+            plan["moves"] = moves
+            return plan
+
+    def apply_compaction(self, plan: Dict[str, object]) -> Dict[str, int]:
+        """Apply a `plan_compaction` plan: release retired ranges, trim
+        over-allocated tails, relocate planned nodes downward (verbatim
+        row copies through the ``base`` indirection). STRICTLY
+        observe-only on bits — no version bump, no node-version stamps,
+        no draw changes (pinned: logits and dispatch logs identical with
+        compaction on/off). Engines fence the flip
+        (`engine.compact_graph`); stale per-node entries are skipped."""
+        with self._lock:
+            freed = trims = 0
+            touched_tiles: set = set()
+            touched_bd: set = set()
+            for rng in plan.get("retired", ()):
+                rng = (int(rng[0]), int(rng[1]))
+                if rng in self._retired:
+                    self._retired.remove(rng)
+                    self._retired_rows -= rng[1]
+                    self._release_locked(rng[0], rng[1])
+                    freed += rng[1]
+            for u, ver in plan.get("trims", ()):
+                u = int(u)
+                if int(self.node_version[u]) != int(ver):
+                    continue  # raced a commit — the next plan retries
+                deg = int(self.bd[u, 1])
+                tight = -(-deg // LANE)
+                alloc = int(self.alloc_rows[u])
+                if alloc > tight:
+                    base = int(self.bd[u, 0])
+                    self._release_locked(base + tight, alloc - tight)
+                    self.alloc_rows[u] = tight
+                    freed += alloc - tight
+                    trims += 1
+            moved = 0
+            for u, ver in plan.get("moves", ()):
+                u = int(u)
+                if int(self.node_version[u]) != int(ver):
+                    continue
+                rows = int(self.alloc_rows[u])
+                base = int(self.bd[u, 0])
+                if rows == 0:
+                    continue
+                new = self._take(self._free_ranges, rows)
+                if new is None or new >= base:
+                    if new is not None:
+                        # no downward fit — put the trial back
+                        self._put(self._free_ranges, new, rows)
+                    continue
+                self.tiles[new:new + rows] = self.tiles[base:base + rows]
+                if self.ttiles is not None:
+                    self.ttiles[new:new + rows] = (
+                        self.ttiles[base:base + rows]
+                    )
+                self.bd[u, 0] = new
+                self._release_locked(base, rows)
+                touched_tiles.update(range(new, new + rows))
+                touched_bd.add(u)
+                moved += 1
+            n_tiles, n_bd = self._sync_device_locked(touched_tiles,
+                                                     touched_bd)
+            self.stats["tiles_reclaimed"] += freed
+            self.stats["compactions"] += 1
+            self.stats["tile_rows_swapped"] += n_tiles
+            self.stats["bd_rows_swapped"] += n_bd
+            return {"tiles_reclaimed": freed, "trims": trims,
+                    "moves": moved, "tile_rows_swapped": n_tiles,
+                    "free_rows": self.free_rows}
+
+    def compact(self, max_moves: int = 0) -> Dict[str, int]:
+        """Plan + apply in one call (bare callers; engines split the
+        two around their fence)."""
+        return self.apply_compaction(self.plan_compaction(max_moves))
+
+    def provision_reserve(self, tiles: int) -> Dict[str, object]:
+        """Grow the tile tables by a whole BANK of ``tiles`` rows — the
+        one sanctioned shape change. Host mirrors reallocate, the new
+        bank joins the free pool, and (when device arrays exist) fresh
+        device tables upload. Sealed AOT executables bound to the old
+        shapes must be rebuilt ONCE per provision event
+        (`inference.BucketPrograms.reprovision` — never
+        recompile-per-commit); `serve.engine.ServeEngine.
+        provision_reserve` fences and does both sides."""
+        bank = int(tiles)
+        if bank <= 0:
+            raise ValueError(f"provision_reserve needs tiles > 0, got "
+                             f"{tiles}")
+        with self._lock:
+            old_cap = self.m_cap
+            self.m_cap = old_cap + bank
+            new_tiles = np.zeros((self.m_cap, LANE), self.tiles.dtype)
+            new_tiles[:old_cap] = self.tiles
+            self.tiles = new_tiles
+            if self.ttiles is not None:
+                new_tt = np.zeros((self.m_cap, LANE), np.float32)
+                new_tt[:old_cap] = self.ttiles
+                self.ttiles = new_tt
+            self._put(self._free_ranges, old_cap, bank)
+            self.stats["provisions"] += 1
+            if self._tiles_dev is not None:
+                import jax.numpy as jnp
+
+                self._tiles_dev = jnp.asarray(self.tiles)
+                if self.ttiles is not None:
+                    self._tt_dev = jnp.asarray(self.ttiles)
+            return self._reserve_report_locked()
+
     # ------------------------------------------------------- internals
     def _append_locked(self, u: int, v: int, touched_tiles, touched_bd,
                        ts: Optional[float] = None):
+        """One edge append, advancing adjacency and tiles together.
+        Returns ``(pad_writes, spills, lanes_reused)``. A node with dead
+        (expired) lanes re-uses the LOWEST one first: the new edge takes
+        the masked position (adjacency entry replaced in place, degree
+        unchanged) — no reserve consumption, which is what keeps a
+        sliding-window workload's tile footprint flat."""
+        dead = self._dead.get(u)
+        if dead:
+            p = dead.pop(0)
+            if not dead:
+                del self._dead[u]
+            self._dead_lanes -= 1
+            base = int(self.bd[u, 0])
+            row = base + p // LANE
+            self.tiles[row, p % LANE] = v
+            # dead lanes exist only on temporal streams (expiry made them)
+            self.ttiles[row, p % LANE] = ts
+            self.adj.replace_at(u, p, v, ts=ts)
+            self._min_ts[u] = min(float(self._min_ts[u]), float(ts))
+            touched_tiles.add(row)
+            touched_bd.add(u)
+            return 0, 0, 1
+        self.adj._append_one(u, v, ts=ts)
         base = int(self.bd[u, 0])
         deg = int(self.bd[u, 1])
         cap = int(self.alloc_rows[u]) * LANE
@@ -856,27 +1617,29 @@ class StreamingTiledGraph:
             # the timestamp lands in the SAME (row, lane) as the edge —
             # one commit makes both drawable (arity checked by preflight)
             self.ttiles[row, deg % LANE] = ts
+            self._min_ts[u] = min(float(self._min_ts[u]), float(ts))
         self.bd[u, 1] = deg + 1
         touched_tiles.add(row)
         touched_bd.add(u)
-        return 1 - spilled, spilled
+        return 1 - spilled, spilled, 0
 
     def _relocate_locked(self, u: int, touched_tiles) -> int:
         """Move node ``u`` to ``alloc + grow_tiles`` fresh rows from the
-        reserve (copy its existing tiles, bump base). The old rows become
-        dead padding the degree mask never reads — draws are unchanged
-        because `ops.sample._tiled_resolve` only ever dereferences
-        ``base + pos // 128`` for valid positions."""
+        free pool (copy its existing tiles, bump base). The old rows
+        become dead padding the degree mask never reads — draws are
+        unchanged because `ops.sample._tiled_resolve` only ever
+        dereferences ``base + pos // 128`` for valid positions. The
+        vacated rows park in ``_retired`` (still counted as consumed —
+        r17 semantics) until a compaction releases them."""
         old_base = int(self.bd[u, 0])
         old_rows = int(self.alloc_rows[u])
         need = old_rows + self.grow_tiles
-        if self._free_row + need > self.m_cap:
+        new_base = self._take(self._free_ranges, need)
+        if new_base is None:
             raise self._capacity_error(
-                f"tile reserve exhausted: node {u} needs {need} rows, "
-                f"{self.m_cap - self._free_row} free"
+                f"tile reserve exhausted: node {u} needs {need} "
+                f"contiguous rows, {self.free_rows} free"
             )
-        new_base = self._free_row
-        self._free_row += need
         if old_rows:
             self.tiles[new_base:new_base + old_rows] = (
                 self.tiles[old_base:old_base + old_rows]
@@ -885,10 +1648,70 @@ class StreamingTiledGraph:
                 self.ttiles[new_base:new_base + old_rows] = (
                     self.ttiles[old_base:old_base + old_rows]
                 )
+            self._retired.append((old_base, old_rows))
+            self._retired_rows += old_rows
         touched_tiles.update(range(new_base, new_base + old_rows + 1))
         self.bd[u, 0] = new_base
         self.alloc_rows[u] = need
         return new_base
+
+    def _rewrite_node_locked(self, u: int, touched_tiles,
+                             touched_bd) -> None:
+        """Re-emit node ``u``'s lanes from its (just-mutated) adjacency
+        — the deletion shift: survivors pack left in lane order,
+        trailing lanes zero. Dead-lane positions and the min-ts index
+        are recomputed from the shifted timestamp row."""
+        base = int(self.bd[u, 0])
+        rows = int(self.alloc_rows[u])
+        nbrs = self.adj.neighbors(u)
+        d = int(nbrs.size)
+        tvals = None
+        if rows:
+            flat = self.tiles[base:base + rows].reshape(-1)
+            flat[:d] = nbrs.astype(self.tiles.dtype)
+            flat[d:] = 0
+            if self.ttiles is not None:
+                tvals = self.adj.neighbors_ts(u)
+                tflat = self.ttiles[base:base + rows].reshape(-1)
+                tflat[:d] = tvals
+                tflat[d:] = 0
+            touched_tiles.update(range(base, base + rows))
+        self.bd[u, 1] = d
+        touched_bd.add(u)
+        if self.ttiles is not None:
+            if tvals is None:
+                tvals = np.empty(0, np.float32)
+            self._reindex_node_ts_locked(u, tvals)
+
+    def _reindex_node_ts_locked(self, u: int, tvals: np.ndarray) -> None:
+        """Rebuild ``u``'s dead-lane list and min-ts entry from its
+        current timestamp row."""
+        old = self._dead.pop(u, None)
+        if old:
+            self._dead_lanes -= len(old)
+        deadpos = np.nonzero(np.isinf(tvals))[0]
+        if deadpos.size:
+            self._dead[u] = deadpos.tolist()
+            self._dead_lanes += int(deadpos.size)
+        finite = tvals[np.isfinite(tvals)]
+        self._min_ts[u] = finite.min() if finite.size else np.inf
+
+    def _update_one_locked(self, u: int, v: int, t: float,
+                           touched_tiles, touched_bd) -> None:
+        """Retarget one edge's timestamp lane (first lane-order
+        occurrence of ``(u, v)``). A formerly-dead lane given a finite
+        ts comes back to life (leaves the re-use pool)."""
+        p = self.adj.update_one(u, v, t)
+        base = int(self.bd[u, 0])
+        row = base + p // LANE
+        self.ttiles[row, p % LANE] = t
+        touched_tiles.add(row)
+        touched_bd.add(u)
+        # recompute (not just min): the update may have MOVED the row's
+        # minimum up, and a stale min would re-scan this node at every
+        # expiry; this also drops lane p from the dead list if the
+        # update revived it
+        self._reindex_node_ts_locked(u, self.adj.neighbors_ts(u))
 
     def _install_locked(self, node: int, nbrs: np.ndarray, touched_tiles,
                         touched_bd, ts_row: Optional[np.ndarray] = None,
@@ -903,14 +1726,19 @@ class StreamingTiledGraph:
             )
         if nbrs.size == 0:
             return
+        # a deleted-to-zero row re-installing hands its old rows back
+        # first (they hold nothing a draw can reach)
+        old_rows = int(self.alloc_rows[node])
+        if old_rows:
+            self._release_locked(int(self.bd[node, 0]), old_rows)
+            self.alloc_rows[node] = 0
         need = -(-int(nbrs.size) // LANE)
-        if self._free_row + need > self.m_cap:
+        base = self._take(self._free_ranges, need)
+        if base is None:
             raise self._capacity_error(
                 f"tile reserve exhausted installing node {node} "
-                f"({need} rows needed, {self.m_cap - self._free_row} free)"
+                f"({need} contiguous rows needed, {self.free_rows} free)"
             )
-        base = self._free_row
-        self._free_row += need
         flat = self.tiles[base:base + need].reshape(-1)
         flat[: nbrs.size] = nbrs.astype(self.tiles.dtype)
         flat[nbrs.size:] = 0
@@ -924,13 +1752,23 @@ class StreamingTiledGraph:
         touched_tiles.update(range(base, base + need))
         touched_bd.add(node)
         # bookkeeping: an installed row's neighbors enter the adjacency
-        # view as "extras" over its empty base row (same lane order)
-        self.adj._extra[node] = [int(x) for x in nbrs]
-        if self.ttiles is not None:
-            self.adj._extra_ts[node] = [float(x) for x in ts_row]
+        # view as "extras" over its empty base row (same lane order) —
+        # or replace the override list wholesale when the row was
+        # already materialized by a lifecycle op
+        if node in self.adj._override:
+            self.adj._override[node] = [int(x) for x in nbrs]
+            if self.ttiles is not None:
+                self.adj._override_ts[node] = [float(x) for x in ts_row]
+        else:
+            self.adj._extra[node] = [int(x) for x in nbrs]
+            if self.ttiles is not None:
+                self.adj._extra_ts[node] = [float(x) for x in ts_row]
         for v in nbrs:
             self.adj._rev_extra.setdefault(int(v), []).append(node)
         self.adj._n_extra += int(nbrs.size)
+        if self._min_ts is not None:
+            finite = ts_row[np.isfinite(ts_row)]
+            self._min_ts[node] = finite.min() if finite.size else np.inf
 
     def _sync_device_locked(self, touched_tiles, touched_bd):
         n_tiles, n_bd = len(touched_tiles), len(touched_bd)

@@ -555,8 +555,11 @@ def expected_closure(sampler, seeds, hops: int,
         n = adj.n
 
         def expand(frontier):
+            # forward expansion must honor round-21 lifecycle rewrites:
+            # a node with deletions/updates answers from its override
+            # list, not the base CSR slice
             return adj._expand(frontier, adj.indptr, adj.indices,
-                               adj._extra)
+                               adj._extra, adj._override)
     else:
         topo = getattr(sampler, "csr_topo", None)
         if topo is None:

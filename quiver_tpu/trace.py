@@ -552,6 +552,14 @@ EVENT_KINDS = (
     # (fid -1 — staging is engine-global, not per-flush).
     "prefetch_issue",    # -, fid, a=rows_issued, b=closure_rows
     "prefetch_hit",      # -,  -,  a=rows_consumed_from_staging
+    # round-21 graph-lifecycle journal (policy markers; fid carries the
+    # engine's GRAPH VERSION — the flush fold ignores all four kinds.
+    # Observe-only pinned bit-neutral in tests/test_lifecycle.py: journal
+    # on changes no served bit, including across deletes/expiry/compaction)
+    "edge_delete",       # -, ver, a=edges_deleted   fenced lane rewrites
+    "retention_expire",  # -, ver, a=edges_expired, b=nodes   TTL masking
+    "compact_begin",     # -, ver, a=reclaims_planned, b=moves_planned
+    "compact_commit",    # -, ver, a=tiles_reclaimed, b=moves_applied
 )
 
 # rough per-event host bytes: 6-slot tuple + boxed floats/small ints. Used
@@ -575,6 +583,8 @@ def _fold_flush_events(events) -> Dict[int, Dict[str, float]]:
             "migrate", "migrate_commit", "migrate_rollback",
             "graph_delta", "delta_commit",
             "prefetch_issue", "prefetch_hit",
+            "edge_delete", "retention_expire",
+            "compact_begin", "compact_commit",
         ):
             continue
         f = flushes.setdefault(fid, {})
@@ -1213,6 +1223,13 @@ def chrome_trace_events(
                     # round-18 predictive-IO markers (rows per EVENT_KINDS)
                     instants.append(
                         (pid, t, kind, {"fid": fid, "rows": a, "b": b})
+                    )
+                elif kind in ("edge_delete", "retention_expire",
+                              "compact_begin", "compact_commit"):
+                    # round-21 lifecycle markers: fid carries the graph
+                    # version, a/b counts per EVENT_KINDS
+                    instants.append(
+                        (pid, t, kind, {"version": fid, "a": a, "b": b})
                     )
             items = []
             for fid, f in sorted(flushes.items()):

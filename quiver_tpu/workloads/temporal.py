@@ -191,6 +191,7 @@ def host_masked_oracle(
     t: np.ndarray,
     max_deg: int = 512,
     recency: float = 0.0,
+    cutoff=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """The HOST-MASKED parity oracle for one temporal hop: build each
     seed's neighbor/timestamp windows directly from the host CSR slices
@@ -206,7 +207,13 @@ def host_masked_oracle(
     Gumbel draw's uniform-sample shape must match for bit equality);
     lanes beyond a row's clamped degree carry garbage on both sides and
     are masked to ``-inf`` before the top-k, so they never influence a
-    drawn bit."""
+    drawn bit.
+
+    ``cutoff`` (optional scalar) narrows the oracle to the round-21
+    retention band ``cutoff < ts <= t`` through the same
+    `temporal_weight_rows` — the reference side of the expire==mask
+    duality pin (tests/test_lifecycle.py, ``serve_probe
+    --lifecycle``)."""
     indptr = np.asarray(indptr, np.int64)
     indices = np.asarray(indices, np.int64)
     edge_ts = np.asarray(edge_ts, np.float32)
@@ -227,7 +234,8 @@ def host_masked_oracle(
         ts_win[b, :d] = edge_ts[lo:lo + d]
         deg[b] = d
     w_rows = temporal_weight_rows(
-        jnp.asarray(ts_win), jnp.asarray(np.asarray(t, np.float32)), recency
+        jnp.asarray(ts_win), jnp.asarray(np.asarray(t, np.float32)),
+        recency, cutoff=cutoff,
     )
     pos, valid = gumbel_topk_positions(key, jnp.asarray(deg), k, w_rows)
     pos_np = np.asarray(pos)

@@ -74,6 +74,24 @@ def main():
                          "bench stream_swap_s)")
     ap.add_argument("--stream-commit-s", type=float, default=1.0,
                     help="commit period for the ingest table")
+    # round-21 graph lifecycle pricing: steady-state churn (deletes/TTL
+    # expiry lane rewrites) + amortized background compaction on top of
+    # the round-17 ingest table (delta_table's lifecycle kwargs)
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="emit the round-21 lifecycle section (ingest "
+                         "table re-priced with churn + compaction terms)")
+    ap.add_argument("--stream-delete-us", type=float, default=None,
+                    help="lane-rewrite cost per deleted/expired edge "
+                         "(us; bench stream_delete_s)")
+    ap.add_argument("--stream-compact-ms", type=float, default=None,
+                    help="one background compaction pass (ms; bench "
+                         "stream_compact_s)")
+    ap.add_argument("--delete-frac", type=float, default=1.0,
+                    help="deletions+expiries per appended edge at steady "
+                         "state (1.0 = flat footprint: every append "
+                         "eventually expires)")
+    ap.add_argument("--compact-every-commits", type=float, default=10.0,
+                    help="commits between background compaction passes")
     # round-19 link-prediction pricing (lp_table): measured fused
     # temporal step + per-pair head costs from bench.py's workloads leg
     # (context temporal_step_s / lp_head_s, picked up via --bench)
@@ -140,6 +158,12 @@ def main():
         if (args.stream_swap_ms is None
                 and ctx.get("stream_swap_s") is not None):
             args.stream_swap_ms = ctx["stream_swap_s"] * 1e3
+        if (args.stream_delete_us is None
+                and ctx.get("stream_delete_s") is not None):
+            args.stream_delete_us = ctx["stream_delete_s"] * 1e6
+        if (args.stream_compact_ms is None
+                and ctx.get("stream_compact_s") is not None):
+            args.stream_compact_ms = ctx["stream_compact_s"] * 1e3
         if args.lp_step_ms is None and ctx.get("temporal_step_s") is not None:
             args.lp_step_ms = ctx["temporal_step_s"] * 1e3
         if args.lp_head_us is None and ctx.get("lp_head_s") is not None:
@@ -464,6 +488,54 @@ def main():
         "invalidation counts).\n\n"
         + format_delta_markdown(delta_rows)
     )
+    # -- round-21: graph-lifecycle pricing (delta_table churn terms) -----
+    lifecycle_md = None
+    lifecycle_rows = []
+    lifecycle_source = None
+    if args.lifecycle:
+        delete_s = (5e-6 if args.stream_delete_us is None
+                    else args.stream_delete_us / 1e6)
+        compact_s = (5e-3 if args.stream_compact_ms is None
+                     else args.stream_compact_ms / 1e3)
+        if (args.stream_delete_us is not None
+                and args.stream_compact_ms is not None):
+            lifecycle_source = (
+                "measured bench stream_delete_s/stream_compact_s"
+            )
+        elif args.stream_delete_us is None and args.stream_compact_ms is None:
+            lifecycle_source = (
+                "analytic placeholder costs (pass --bench or "
+                "--stream-delete-us/--stream-compact-ms)"
+            )
+        else:
+            lifecycle_source = (
+                "partially measured — pass both --stream-delete-us and "
+                "--stream-compact-ms (or --bench) for a fully measured "
+                "table"
+            )
+        lifecycle_rows = delta_table(
+            [("feed_trickle", 100), ("feed_busy", 2_000),
+             ("fraud_burst", 20_000), ("ingest_storm", 200_000)],
+            append_s_per_edge=append_s, swap_s_per_commit=swap_s,
+            commit_period_s=args.stream_commit_s,
+            delete_frac=args.delete_frac,
+            delete_s_per_edge=delete_s,
+            compact_s_per_pass=compact_s,
+            compact_every_commits=args.compact_every_commits,
+        )
+        lifecycle_md = (
+            "## Graph lifecycle: steady-state churn + compaction "
+            "(round 21)\n\n"
+            f"Cost source: {lifecycle_source}; append/swap as the ingest "
+            f"table above;\ndelete_frac {args.delete_frac} (deletes+TTL "
+            "expiries per append — 1.0 is the\nflat-footprint regime), "
+            f"compaction every {args.compact_every_commits:.0f} commits "
+            "amortized into duty.\nMeasured counterpart: "
+            "scripts/serve_probe.py --lifecycle -> LIFECYCLE_r01.json\n"
+            "(appends+expiries at steady state under live Zipf traffic, "
+            "flat reserve\noccupancy, in-run oracle parity).\n\n"
+            + format_delta_markdown(lifecycle_rows)
+        )
     # -- round-19: link-prediction pricing (lp_table) --------------------
     lp_step_s = (2e-3 if args.lp_step_ms is None else args.lp_step_ms / 1e3)
     lp_head_s = (1e-6 if args.lp_head_us is None else args.lp_head_us / 1e6)
@@ -498,6 +570,8 @@ def main():
     print("\n" + skew_md, file=sys.stderr)
     print("\n" + tier_md, file=sys.stderr)
     print("\n" + delta_md, file=sys.stderr)
+    if lifecycle_md is not None:
+        print("\n" + lifecycle_md, file=sys.stderr)
     print("\n" + lp_md, file=sys.stderr)
     if args.out:
         header = (
@@ -514,7 +588,9 @@ def main():
                 header + md + "\n\n" + fetch_md + "\n\n" + quant_md
                 + "\n\n" + serve_md + "\n\n" + serve_dist_md
                 + "\n\n" + skew_md + "\n\n" + tier_md + "\n\n"
-                + delta_md + "\n\n" + lp_md + "\n"
+                + delta_md + "\n\n"
+                + ((lifecycle_md + "\n\n") if lifecycle_md else "")
+                + lp_md + "\n"
             )
     print(json.dumps({
         "step_s_1chip": step_s,
@@ -538,6 +614,8 @@ def main():
         "skew_replication": [r._asdict() for r in skew_rows],
         "delta_source": delta_source,
         "delta_table": [r._asdict() for r in delta_rows],
+        "lifecycle_source": lifecycle_source,
+        "lifecycle_table": [r._asdict() for r in lifecycle_rows],
         "lp_source": lp_source,
         "lp_table": [r._asdict() for r in lp_rows],
     }))

@@ -219,6 +219,23 @@ def main():
     ap.add_argument("--stream-edge-every", type=int, default=40,
                     help="requests between edge-arrival events")
     ap.add_argument("--stream-edges-per-event", type=int, default=4)
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="round-21 graph-lifecycle soak: append+expire at "
+                         "steady state for ~10^6 edges under live Zipf "
+                         "traffic with periodic compaction — flat reserve "
+                         "occupancy, zero dropped requests, zero "
+                         "StreamCapacityError, in-run temporal oracle "
+                         "parity rows (-> LIFECYCLE_r01.json)")
+    ap.add_argument("--lifecycle-commits", type=int, default=500)
+    ap.add_argument("--lifecycle-edges-per-commit", type=int, default=2000)
+    ap.add_argument("--lifecycle-window-commits", type=int, default=8,
+                    help="retention window in commit clock ticks — the "
+                         "steady-state live set is window*edges_per_commit")
+    ap.add_argument("--lifecycle-requests-per-commit", type=int, default=4)
+    ap.add_argument("--lifecycle-compact-every", type=int, default=25,
+                    help="commits between explicit compaction passes")
+    ap.add_argument("--lifecycle-parity-every", type=int, default=100,
+                    help="commits between in-run oracle parity checkpoints")
     ap.add_argument("--scale", action="store_true",
                     help="round-16 elastic-fleet leg: ramp a Zipf trace "
                          "1->2->4->2 hosts with live resharding, zero "
@@ -964,6 +981,222 @@ def main():
                 "replica_version": dist.replica_version,
                 "qps": round(args.stream_requests / wall_dist, 1),
             },
+        }
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(line + "\n")
+        return
+
+    # -- round-21 graph-lifecycle soak (--lifecycle -> LIFECYCLE_r01.json) ---
+    if args.lifecycle:
+        from quiver_tpu.stream import StreamingTiledGraph
+        from quiver_tpu.workloads import (
+            TemporalServeEngine,
+            TemporalTiledGraph,
+            quantize_t,
+            replay_temporal_log,
+        )
+
+        MAXD = 512
+        REC, QUANT = 0.02, 0.05
+        T0, DT = 50.0, 1.0
+        W = args.lifecycle_window_commits * DT
+        EPC = args.lifecycle_edges_per_commit
+        COMMITS = args.lifecycle_commits
+
+        rng_lc = np.random.default_rng(123)
+        E = topo.indices.shape[0]
+        base_ts = rng_lc.uniform(0.0, 50.0, E).astype(np.float32)
+
+        stream_lc = StreamingTiledGraph(topo, reserve_frac=1.0,
+                                        edge_ts=base_ts)
+        # pre-size the reserve for the steady-state live set: the window
+        # holds window_commits*EPC streamed lanes, plus one partial tile
+        # row per touched node and spill-chain slack. NO auto-provision
+        # backstop is configured below — a StreamCapacityError anywhere
+        # in the soak fails the probe, which is the acceptance pin.
+        live_lanes = args.lifecycle_window_commits * EPC
+        want_rows = 4 * (live_lanes // 128 + 1) + 2 * n
+        if stream_lc.free_rows < want_rows:
+            stream_lc.provision_reserve(want_rows - stream_lc.free_rows)
+
+        s_lc = GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED,
+                                dedup=False, max_deg=MAXD)
+        s_lc.bind_temporal(stream_lc, recency=REC)
+        eng = TemporalServeEngine(
+            model, params, s_lc, feat,
+            ServeConfig(max_batch=args.max_batch,
+                        buckets=(8, args.max_batch), max_delay_ms=1e9,
+                        record_dispatches=True,
+                        stream_retention_window=W,
+                        stream_compact_min_reclaim=8,
+                        stream_provision_tiles=0),
+            t_quantum=QUANT,
+        )
+        eng.warmup()
+
+        total_edges = COMMITS * EPC
+        app_src = zipfian_trace(n, total_edges, alpha=1.1, seed=31)
+        app_dst = rng_lc.integers(0, n, total_edges)
+        qry = zipfian_trace(n, COMMITS * args.lifecycle_requests_per_commit,
+                            alpha=1.1, seed=17)
+        RM_PER = max(EPC // 100, 1)  # deletes ride along every commit
+
+        occ, dropped, cap_errors, parity_rows = [], 0, 0, 0
+        compact_passes = rows_reclaimed = 0
+        prev_batch = None
+        t_wall0 = time.perf_counter()
+        for k in range(COMMITS):
+            lo = k * EPC
+            src_k = app_src[lo:lo + EPC]
+            dst_k = app_dst[lo:lo + EPC]
+            # commit-k arrivals land inside (T0+k*DT, T0+(k+1)*DT]
+            ts_k = (T0 + k * DT
+                    + (np.arange(EPC) + 1.0) / EPC * DT).astype(np.float32)
+            eng.stage_edges(src_k, dst_k, ts=ts_k)
+            if prev_batch is not None:
+                # delete a slice of LAST commit's arrivals — live, well
+                # inside the window, exercising lane-shift removal under
+                # traffic (each picked index is one appended copy, so
+                # existence holds even across duplicate pairs)
+                eng.stage_removals(prev_batch[0][:RM_PER],
+                                   prev_batch[1][:RM_PER])
+            prev_batch = (src_k, dst_k)
+            try:
+                eng.update_graph()  # retention expires at commit time
+            except Exception as exc:
+                cap_errors += 1
+                raise AssertionError(
+                    f"LIFECYCLE: commit {k} failed ({exc!r})"
+                ) from exc
+            tc = T0 + (k + 1) * DT
+
+            # live Zipf traffic between commits
+            qlo = k * args.lifecycle_requests_per_commit
+            nodes_k = qry[qlo:qlo + args.lifecycle_requests_per_commit]
+            try:
+                eng.predict([int(x) for x in nodes_k], t=tc + 0.5 * DT)
+            except Exception:
+                dropped += 1
+
+            occ.append(int(stream_lc.reserve_report()["reserve_used"]))
+
+            if (k + 1) % args.lifecycle_compact_every == 0:
+                cs = eng.compact_graph()
+                compact_passes += 1
+                rows_reclaimed += cs["tiles_reclaimed"]
+
+            if (k + 1) % args.lifecycle_parity_every == 0:
+                # in-run oracle parity at serving grain: rows served NOW
+                # must bit-match a fresh rebuild of the live stream
+                # ((topo, ts) materialized in tile-lane order) replayed
+                # through a twin sampler with a synced key stream
+                call0 = s_lc._call
+                off = len(eng.dispatch_log)
+                tq = tc + 0.25 * DT
+                chk_nodes = [int(x) for x in nodes_k]
+                rows = eng.predict(chk_nodes, t=tq)
+                topo2, ts2 = stream_lc.adj.to_temporal()
+                s2 = GraphSageSampler(topo2, sizes=SIZES, mode="TPU",
+                                      seed=SEED, dedup=False, max_deg=MAXD)
+                s2.bind_temporal(TemporalTiledGraph(
+                    topo2, ts2, id_dtype=stream_lc.tiles.dtype), recency=REC)
+                s2._call = call0
+                oracle = replay_temporal_log(
+                    eng.dispatch_log[off:], model, params, s2, feat)
+                kq = float(np.float32(quantize_t(tq, QUANT)))
+                for node, row in zip(chk_nodes, rows):
+                    assert any(np.array_equal(row, c)
+                               for c in oracle.get((node, kq), [])), \
+                        f"LIFECYCLE PARITY VIOLATION at node {node}"
+                    parity_rows += 1
+        wall = time.perf_counter() - t_wall0
+
+        assert dropped == 0, f"{dropped} dropped requests under lifecycle"
+        assert cap_errors == 0
+        assert parity_rows > 0
+        # flat occupancy: once the window has filled (plus one compaction
+        # period for the first trim), reserve consumption stops trending —
+        # expired lanes are reused in place and compaction returns spill
+        # waste, so the band stays within 25% of its floor
+        warm = 2 * args.lifecycle_window_commits + args.lifecycle_compact_every
+        assert warm < COMMITS, "soak too short for a steady-state claim"
+        steady = occ[warm:]
+        band = max(steady) - min(steady)
+        # "flat" means BOUNDED AND NOT LINEARLY TRENDING, not
+        # saw-tooth-free: between compaction passes spills accumulate and
+        # each pass trims them back, and the per-cycle floor carries the
+        # one growth in-place expiry cannot reclaim — a hot node's
+        # high-water footprint (interior dead lanes under a live tail
+        # stay allocated; shifting live lanes would break the
+        # observe-only pin), a running max that creeps ~log(t). A LEAK
+        # is linear: appends permanently outrunning expiry+trim would
+        # add live_lanes/window rows per window. Pin the distinction
+        # three ways: the floor creep over the whole soak stays inside
+        # the high-water envelope (<= 50% over the first cycle's floor),
+        # occupancy never exceeds the provisioned live-set bound, and
+        # the projected reserve runway (from measured creep) is >= 20
+        # soaks long.
+        per = args.lifecycle_compact_every
+        floors = [min(steady[i:i + per]) for i in range(0, len(steady), per)]
+        trace = ",".join(str(x) for x in occ[::max(len(occ) // 50, 1)])
+        assert floors[-1] <= floors[0] + max(16, int(0.5 * floors[0])), \
+            f"LIFECYCLE: occupancy floor climbing {floors} (occ {trace})"
+        assert band <= max(32, 2 * per + int(0.5 * min(steady))), \
+            f"LIFECYCLE: occupancy not flat (band {band} rows over " \
+            f"[{min(steady)}, {max(steady)}]; floors {floors}; occ {trace})"
+        assert max(occ) <= want_rows, \
+            f"LIFECYCLE: occupancy {max(occ)} exceeded live-set bound " \
+            f"{want_rows}"
+        runway = stream_lc.reserve_report()["projected_commits_to_exhaustion"]
+        assert runway is None or runway >= 20 * COMMITS, \
+            f"LIFECYCLE: reserve runway {runway} commits < 20 soaks"
+
+        rep_end = stream_lc.reserve_report()
+        out = {
+            "metric": "serve_probe_lifecycle",
+            "git_revision": git_revision(),
+            "backend": jax.devices()[0].platform,
+            "config": {
+                "commits": COMMITS, "edges_per_commit": EPC,
+                "window_commits": args.lifecycle_window_commits,
+                "requests_per_commit": args.lifecycle_requests_per_commit,
+                "compact_every": args.lifecycle_compact_every,
+                "parity_every": args.lifecycle_parity_every,
+                "removals_per_commit": RM_PER, "alpha": 1.1,
+                "max_batch": args.max_batch, "sizes": SIZES, "nodes": n,
+                "recency": REC, "t_quantum": QUANT,
+            },
+            "note": (
+                "sequential deterministic soak (walls are 1-core loopback, "
+                "read the structure); zero-drop, zero StreamCapacityError "
+                "(no auto-provision backstop configured), bounded "
+                "non-trending occupancy (saw-tooth trimmed per compaction "
+                "cycle; floor creep inside the hot-node high-water "
+                "envelope; >=20-soak projected runway), and fresh-rebuild "
+                "oracle parity are asserted in-run — a written artifact "
+                "means they held"
+            ),
+            "edges_appended": int(eng.stats.delta_edges),
+            "edges_expired": int(eng.stats.edges_expired),
+            "edges_deleted": int(eng.stats.edges_deleted),
+            "commits": COMMITS,
+            "graph_version": eng.graph_version,
+            "compaction_passes": compact_passes,
+            "tile_rows_reclaimed": rows_reclaimed,
+            "parity_rows": parity_rows,
+            "dropped_requests": dropped,
+            "capacity_errors": cap_errors,
+            "occupancy_rows": {
+                "at_warmup": occ[warm - 1], "steady_min": min(steady),
+                "steady_max": max(steady), "end": occ[-1],
+                "band": band, "cycle_floors": floors,
+            },
+            "reserve_report": rep_end,
+            "edges_per_s": round(total_edges / wall, 1),
+            "wall_s": round(wall, 1),
         }
         line = json.dumps(out)
         print(line)

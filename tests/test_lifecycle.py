@@ -1,0 +1,731 @@
+"""Round-21 graph-lifecycle tests: deletes, TTL retention, tile
+compaction, and reserve re-provisioning (quiver_tpu/lifecycle.py +
+the stream/engine mechanisms they drive).
+
+The acceptance contract (ISSUE 17 / docs/api.md "Graph lifecycle"):
+
+- deletion parity: delete-then-replay is bit-identical to a graph built
+  WITHOUT the edge, at draw grain AND serving grain, single-host and
+  hosts=2 (removal is a lane-shift rewrite — survivors keep the
+  rebuild-parity edge order);
+- retention <-> masking duality: expiring at window ``W`` then querying
+  equals querying the UNEXPIRED stream through the ``cutoff < ts <= t``
+  band mask, bit for bit at draw grain, with the cutoff computed on the
+  f32 grid (`lifecycle.retention_cutoff`);
+- compaction is strictly observe-only on bits: logits and dispatch logs
+  are identical with compaction on/off, including a pass racing an
+  in-flight flush (plans build off-fence, the apply flips under the
+  fence like an r16 migration);
+- reserve re-provisioning grows the bank by whole tiles WITHOUT a
+  rebuild: sealed programs rebind via `BucketPrograms.reprovision`,
+  and a capacity-stalled commit retries once after an auto-provision
+  (`ServeConfig.stream_provision_tiles`);
+- every policy is deterministic and replayable: the seeded
+  append -> delete -> expire -> query loopback is bit-stable (the CI
+  smoke step).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.lifecycle import (
+    CompactionPolicy,
+    ProvisionPolicy,
+    RetentionPolicy,
+    retention_cutoff,
+)
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops.sample import (
+    build_tiled_host,
+    tiled_sample_layer,
+    tiled_temporal_sample_layer,
+)
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    DistServeConfig,
+    DistServeEngine,
+    ServeConfig,
+    ServeEngine,
+    zipfian_trace,
+)
+from quiver_tpu.stream import (
+    GraphDelta,
+    StreamCapacityError,
+    StreamingTiledGraph,
+)
+from quiver_tpu.workloads import (
+    TemporalServeEngine,
+    TemporalTiledGraph,
+    host_masked_oracle,
+    quantize_t,
+    replay_temporal_log,
+)
+
+N_NODES = 200
+DIM = 12
+SIZES = [3, 3]
+SEED = 5
+MAXD = 128
+EDGE_INDEX = make_random_graph(N_NODES, 1400, seed=0)
+
+
+def make_topo():
+    return CSRTopo(edge_index=EDGE_INDEX)
+
+
+TOPO = make_topo()
+BASE_TS = np.random.default_rng(11).uniform(
+    0.0, 50.0, TOPO.indices.shape[0]
+).astype(np.float32)
+
+
+def make_temporal_stream(**kw):
+    kw.setdefault("reserve_frac", 0.5)
+    return StreamingTiledGraph(make_topo(), edge_ts=BASE_TS.copy(), **kw)
+
+
+def make_temporal_sampler(stream):
+    s = GraphSageSampler(make_topo(), sizes=SIZES, mode="TPU", seed=SEED,
+                         dedup=False, max_deg=MAXD)
+    return s.bind_temporal(stream, recency=0.02)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    s0 = make_temporal_sampler(TemporalTiledGraph(make_topo(), BASE_TS))
+    ds0 = s0.sample_dense(np.arange(8, dtype=np.int64), t=100.0)
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_engine(setup, stream, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("buckets", (8,))
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    return TemporalServeEngine(model, params, make_temporal_sampler(stream),
+                               feat, ServeConfig(**cfg_kw), t_quantum=4.0)
+
+
+def temporal_draws(stream_triple, seeds, t, k=4, seed=99, cutoff=None):
+    """One temporal hop from a (bd, tiles, ttiles) triple, as host
+    arrays (nbrs zeroed outside valid so bit-compare is layout-exact)."""
+    bd, tiles, tt = stream_triple
+    B = len(seeds)
+    nb, vl = tiled_temporal_sample_layer(
+        jnp.asarray(bd), jnp.asarray(tiles), jnp.asarray(tt),
+        jnp.asarray(seeds), jnp.ones((B,), bool), k, jax.random.key(seed),
+        jnp.full((B,), t, jnp.float32), max_deg=MAXD, recency=0.02,
+        cutoff=None if cutoff is None else jnp.float32(cutoff),
+    )
+    nb, vl = np.asarray(nb), np.asarray(vl)
+    return np.where(vl, nb, 0), vl
+
+
+# -- delta staging: removals + updates ---------------------------------------
+
+def test_graphdelta_removal_update_staging_and_validation():
+    d = GraphDelta()
+    d.add_edge(1, 2, ts=60.0)
+    d.remove_edge(3, 4)
+    d.remove_edges([5, 6], [7, 8])
+    d.update_edge(1, 2, 61.0)
+    assert d.n_appends == 1 and len(d) == 5   # total staged OPERATIONS
+    rs, rd = d.removals()
+    assert rs.tolist() == [3, 5, 6] and rd.tolist() == [4, 7, 8]
+    us, ud, ut = d.updates()
+    assert us.tolist() == [1] and ud.tolist() == [2]
+    assert ut.dtype == np.float32 and ut[0] == np.float32(61.0)
+    assert d.max_ts() == np.float32(61.0)
+    # sources cover appends AND removals AND updates (invalidation seeds)
+    assert set(d.sources().tolist()) >= {1, 3, 5, 6}
+    with pytest.raises(ValueError):
+        d.remove_edges([1], [2, 3])          # arity
+    with pytest.raises(ValueError):
+        d.update_edges([1], [2], [np.inf])   # +inf is the expiry sentinel
+
+
+def test_remove_absent_edge_all_or_none():
+    """A batch with one absent removal rejects ATOMICALLY at preflight:
+    valid appends/removals in the same delta must not land."""
+    stream = make_temporal_stream()
+    u = 0 if TOPO.indptr[1] > TOPO.indptr[0] else 1
+    v = int(TOPO.indices[TOPO.indptr[u]])
+    before = stream.neighbors(u).tolist()
+    d = GraphDelta()
+    d.add_edge(u, (u + 9) % N_NODES, ts=60.0)
+    d.remove_edge(u, v)                       # exists
+    d.remove_edge(u, N_NODES - 1 - u)         # (very likely) a dup guard:
+    # make it CERTAINLY absent by removing it twice more than it exists
+    cnt = before.count(N_NODES - 1 - u)
+    for _ in range(cnt + 1):
+        d.remove_edge(u, N_NODES - 1 - u)
+    with pytest.raises(ValueError, match="absent"):
+        stream.apply(d)
+    assert stream.neighbors(u).tolist() == before   # nothing applied
+    assert stream.version == 0
+
+
+# -- deletion parity (draw grain) ---------------------------------------------
+
+def test_delete_then_replay_equals_never_added():
+    """THE deletion pin at draw grain: append {e1, x, e2}, delete x —
+    draws bit-match a stream that only ever appended {e1, e2}, AND a
+    tile table freshly built over the materialized CSR."""
+    def drw(stream, seed=3):
+        bd, tiles = stream.graph()
+        seeds = jnp.arange(48) % N_NODES
+        nb, vl = tiled_sample_layer(bd, tiles, seeds,
+                                    jnp.ones((48,), bool), 4,
+                                    jax.random.key(seed))
+        nb, vl = np.asarray(nb), np.asarray(vl)
+        return np.where(vl, nb, 0), vl
+
+    topo = make_topo()
+    a = StreamingTiledGraph(topo, reserve_frac=0.5)
+    d = GraphDelta()
+    d.add_edge(3, 60)
+    d.add_edge(3, 61)   # x — to be deleted
+    d.add_edge(3, 62)
+    d.add_edge(9, 11)
+    a.apply(d)
+    rm = GraphDelta()
+    rm.remove_edge(3, 61)
+    out = a.apply(rm)
+    assert out["edges_deleted"] == 1
+    b = StreamingTiledGraph(topo, reserve_frac=0.5)
+    d2 = GraphDelta()
+    d2.add_edge(3, 60)
+    d2.add_edge(3, 62)
+    d2.add_edge(9, 11)
+    b.apply(d2)
+    ra, rb = drw(a), drw(b)
+    assert np.array_equal(ra[0], rb[0]) and np.array_equal(ra[1], rb[1])
+    assert a.neighbors(3).tolist() == b.neighbors(3).tolist()
+    # base-edge deletion: == a build over the CSR without that edge
+    u = int(np.argmax(topo.degree))
+    v = int(TOPO.indices[TOPO.indptr[u]])
+    rm2 = GraphDelta()
+    rm2.remove_edge(u, v)
+    a.apply(rm2)
+    t2 = a.to_csr_topo()
+    bd_r, tiles_r = build_tiled_host(t2.indptr, t2.indices, a.tiles.dtype)
+    bd_a, tiles_a = a.graph()
+    seeds = jnp.arange(48) % N_NODES
+    na, va = tiled_sample_layer(bd_a, tiles_a, seeds,
+                                jnp.ones((48,), bool), 4, jax.random.key(3))
+    nr, vr = tiled_sample_layer(jnp.asarray(bd_r), jnp.asarray(tiles_r),
+                                seeds, jnp.ones((48,), bool), 4,
+                                jax.random.key(3))
+    na, va, nr, vr = map(np.asarray, (na, va, nr, vr))
+    assert np.array_equal(np.where(va, na, 0), np.where(vr, nr, 0))
+    assert np.array_equal(va, vr)
+
+
+# -- retention <-> masking duality --------------------------------------------
+
+def test_retention_expiry_masking_duality_bit_pin():
+    """THE satellite pin: expire at window W then query at t == querying
+    the UNEXPIRED stream through the ``cutoff < ts <= t`` band mask,
+    bit for bit at draw grain, cutoff on the f32 grid. Also bit-equal to
+    the host-masked oracle with the same cutoff."""
+    t_commit, W = np.float32(77.7), np.float32(30.3)
+    cut = retention_cutoff(t_commit, W)
+    assert np.float32(cut) == np.float32(t_commit - W)  # f32 arithmetic
+
+    d = GraphDelta()
+    rng = np.random.default_rng(21)
+    for i in range(64):
+        d.add_edge(int(rng.integers(0, N_NODES)),
+                   int(rng.integers(0, N_NODES)),
+                   ts=float(np.float32(rng.uniform(40.0, 77.0))))
+    frozen = make_temporal_stream()
+    frozen.apply(d)
+    live = make_temporal_stream()
+    live.apply(d)
+    exp = live.expire_edges(cut)
+    assert exp["edges_expired"] > 0 and exp["nodes"] > 0
+    seeds = rng.integers(0, N_NODES, 64)
+    for key_seed in (0, 7):
+        le = temporal_draws(live.temporal_graph(), seeds, float(t_commit),
+                            seed=key_seed)
+        fr = temporal_draws(frozen.temporal_graph(), seeds, float(t_commit),
+                            seed=key_seed, cutoff=cut)
+        assert np.array_equal(le[0], fr[0])
+        assert np.array_equal(le[1], fr[1])
+    # host-masked oracle through the same band mask
+    topo2, ts2 = frozen.adj.to_temporal()
+    B = len(seeds)
+    nb_o, vl_o = host_masked_oracle(
+        np.asarray(topo2.indptr), np.asarray(topo2.indices), ts2,
+        np.asarray(seeds), np.ones((B,), bool), 4, jax.random.key(0),
+        np.full((B,), t_commit, np.float32), max_deg=MAXD, recency=0.02,
+        cutoff=cut,
+    )
+    le = temporal_draws(live.temporal_graph(), seeds, float(t_commit),
+                        seed=0)
+    assert np.array_equal(le[0], np.where(np.asarray(vl_o),
+                                          np.asarray(nb_o), 0))
+    assert np.array_equal(le[1], np.asarray(vl_o))
+
+
+def test_retention_dead_lane_reuse_keeps_footprint_flat():
+    """Expired lanes are reused IN PLACE by later appends to the same
+    node — the steady-state flat-footprint mechanism: no free rows are
+    consumed and `lanes_reused` says so."""
+    stream = make_temporal_stream()
+    u = int(np.argmax(make_topo().degree))
+    deg0 = stream.degree(u)
+    assert stream.expire_edges(np.float32(60.0))["edges_expired"] > 0
+    rep = stream.reserve_report()
+    assert rep["dead_lane_frac"] > 0
+    free0 = stream.free_rows
+    d = GraphDelta()
+    for i in range(min(deg0, 8)):
+        d.add_edge(u, (u + 1 + i) % N_NODES, ts=float(61.0 + i))
+    out = stream.apply(d)
+    assert out["lanes_reused"] == min(deg0, 8)
+    assert stream.free_rows == free0                 # flat footprint
+    assert stream.degree(u) == deg0                  # masked, not grown
+    assert stream.reserve_report()["dead_lane_frac"] < rep["dead_lane_frac"]
+
+
+# -- compaction: observe-only + reclamation -----------------------------------
+
+def test_compaction_reclaims_and_is_observe_only_on_bits():
+    stream = make_temporal_stream(reserve_frac=2.0)
+    u = 7
+    d = GraphDelta()
+    rng = np.random.default_rng(6)
+    d.add_edges(np.full(300, u), rng.integers(0, N_NODES, 300),
+                ts=np.linspace(60, 90, 300).astype(np.float32))
+    stream.apply(d)       # spill chain -> retired ranges
+    rm = GraphDelta()
+    sel = np.arange(0, 300, 2)
+    rm.remove_edges(np.full(sel.size, u),
+                    np.asarray(d.edges()[1])[sel])
+    stream.apply(rm)      # trimmable tail waste
+    rep0 = stream.reserve_report()
+    assert rep0["reclaimable_tiles"] > 0
+    assert rep0["fragmented_lanes"] > 0
+    seeds = rng.integers(0, N_NODES, 48)
+    before = temporal_draws(stream.temporal_graph(), seeds, 95.0)
+    free0 = stream.free_rows
+    ver0 = stream.version
+    plan = stream.plan_compaction()
+    out = stream.apply_compaction(plan)
+    assert out["tiles_reclaimed"] > 0
+    assert stream.free_rows > free0
+    assert stream.version == ver0            # NO version bump
+    after = temporal_draws(stream.temporal_graph(), seeds, 95.0)
+    assert np.array_equal(before[0], after[0])      # observe-only on bits
+    assert np.array_equal(before[1], after[1])
+    assert stream.reserve_report()["reclaimable_tiles"] < (
+        rep0["reclaimable_tiles"]
+    )
+    # a second pass over a clean stream is a no-op
+    assert stream.compact()["tiles_reclaimed"] == 0
+
+
+def test_compaction_plan_stale_skip_after_mutation():
+    """Plans build OFF-FENCE and carry node_version stamps: entries for
+    a node mutated between plan and apply are skipped, never applied to
+    relocated rows."""
+    stream = make_temporal_stream(reserve_frac=2.0)
+    u = 7
+    d = GraphDelta()
+    rng = np.random.default_rng(6)
+    d.add_edges(np.full(200, u), rng.integers(0, N_NODES, 200),
+                ts=np.full(200, 60.0, np.float32))
+    stream.apply(d)
+    rm = GraphDelta()
+    rm.remove_edges(np.full(150, u), np.asarray(d.edges()[1])[:150])
+    stream.apply(rm)
+    plan = stream.plan_compaction()
+    assert plan["trims"] or plan["moves"]
+    # mutate u AFTER planning: its plan entries go stale
+    d2 = GraphDelta()
+    d2.add_edges(np.full(130, u), rng.integers(0, N_NODES, 130),
+                 ts=np.full(130, 61.0, np.float32))
+    stream.apply(d2)
+    seeds = rng.integers(0, N_NODES, 48)
+    before = temporal_draws(stream.temporal_graph(), seeds, 95.0)
+    stream.apply_compaction(plan)       # must not corrupt relocated rows
+    after = temporal_draws(stream.temporal_graph(), seeds, 95.0)
+    assert np.array_equal(before[0], after[0])
+    assert np.array_equal(before[1], after[1])
+
+
+# -- reserve re-provisioning --------------------------------------------------
+
+def test_provision_reserve_grows_without_rebuild():
+    stream = make_temporal_stream(reserve_tiles=2)
+    u = 9
+    big = GraphDelta()
+    for i in range(3 * 128):
+        big.add_edge(u, (u + 1 + i) % N_NODES, ts=61.0)
+    with pytest.raises(StreamCapacityError):
+        stream.apply(big)
+    assert stream.degree(u) == int(make_topo().degree[u])  # atomic reject
+    rep = stream.provision_reserve(8)
+    assert rep["reserve_free"] >= 8 * 1  # rows, post-growth
+    stream.apply(big)
+    assert stream.degree(u) == int(make_topo().degree[u]) + 3 * 128
+    # draw parity vs a fresh build over the materialized CSR still holds
+    t2, ts2 = stream.adj.to_temporal()
+    tg = TemporalTiledGraph(t2, ts2, id_dtype=stream.tiles.dtype)
+    seeds = np.arange(48) % N_NODES
+    a = temporal_draws(stream.temporal_graph(), seeds, 95.0)
+    b = temporal_draws(tg.temporal_graph(), seeds, 95.0)
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+
+
+# -- the policy layer ---------------------------------------------------------
+
+def test_lifecycle_policies_deterministic():
+    # retention: f32-grid cutoff, monotone clock, no-op gating
+    assert retention_cutoff(80.0, 30.0) == float(np.float32(50.0))
+    big = 3e7  # f32 grid is coarse here: f64 subtraction would differ
+    assert retention_cutoff(big + 1.0, 1.0) == float(
+        np.float32(np.float32(big + 1.0) - np.float32(1.0))
+    )
+    p = RetentionPolicy(window=30.0)
+    assert p.cutoff_for(None) is None        # no clock yet
+    cut = p.cutoff_for(80.0)
+    assert cut == retention_cutoff(80.0, 30.0)
+    p.mark_expired(cut)
+    assert p.cutoff_for(79.0) is None        # clock is monotone
+    assert p.cutoff_for(80.0) is None        # nothing new to expire
+    assert p.cutoff_for(90.0) == retention_cutoff(90.0, 30.0)
+    with pytest.raises(ValueError):
+        RetentionPolicy(window=0.0)
+    # compaction: pure threshold on the reserve report
+    c = CompactionPolicy(min_reclaimable=8)
+    assert not c.should_compact({"reclaimable_tiles": 7})
+    assert c.should_compact({"reclaimable_tiles": 8})
+    # provisioning: floor on free rows
+    pr = ProvisionPolicy(bank_tiles=64, min_free_tiles=4)
+    assert pr.should_provision({"reserve_free": 3})
+    assert not pr.should_provision({"reserve_free": 4})
+    with pytest.raises(ValueError):
+        ProvisionPolicy(bank_tiles=0)
+
+
+# -- engine level: retention at commit, serving parity, journal ---------------
+
+def test_engine_retention_commit_serving_and_journal(setup):
+    model, params, feat = setup
+    stream = make_temporal_stream()
+    eng = make_engine(setup, stream, stream_retention_window=30.0,
+                      journal_events=4096)
+    assert eng.retention is not None
+    eng.stage_edges([1, 2], [4, 5], ts=[60.0, 80.0])
+    out = eng.update_graph()
+    assert out["edges"] == 2 and eng.graph_version == 1
+    assert out["edges_expired"] > 0          # everything below 50 went
+    assert out["retention_cutoff"] == retention_cutoff(80.0, 30.0)
+    assert eng.stats.edges_expired == out["edges_expired"]
+    row = eng.predict([1], t=100.0)[0]
+    # ...and the served row bit-matches a fresh rebuild of the LIVE
+    # stream (expired lanes materialize as +inf) replayed at the same
+    # key index — serving-grain retention parity
+    topo2, ts2 = stream.adj.to_temporal()
+    s2 = GraphSageSampler(topo2, sizes=SIZES, mode="TPU", seed=SEED,
+                          dedup=False, max_deg=MAXD)
+    s2.bind_temporal(TemporalTiledGraph(topo2, ts2,
+                                        id_dtype=stream.tiles.dtype),
+                     recency=0.02)
+    oracle = replay_temporal_log(eng.dispatch_log, model, params, s2, feat)
+    kq = (1, float(np.float32(quantize_t(100.0, 4.0))))
+    assert any(np.array_equal(row, c) for c in oracle.get(kq, []))
+    # off-commit expiry API: no clock advance -> no-op; advance -> expiry
+    assert eng.expire_edges()["edges_expired"] == 0
+    out3 = eng.expire_edges(200.0)
+    assert out3["edges_expired"] > 0 and eng.graph_version == 2
+    kinds = {e[1] for e in eng.journal.snapshot()}
+    assert "retention_expire" in kinds
+    # lifecycle gauges + counters are real Prometheus families
+    text = eng.register_metrics().to_prometheus()
+    assert "quiver_serve_stream_dead_lane_frac" in text
+    assert "quiver_serve_stream_fragmented_lanes" in text
+    assert "quiver_serve_stream_reclaimable_tiles" in text
+    assert "# TYPE quiver_serve_edges_expired_total counter" in text
+    assert "# TYPE quiver_serve_edges_deleted_total counter" in text
+    assert "# TYPE quiver_serve_tiles_reclaimed_total counter" in text
+
+
+def test_engine_delete_expire_query_loopback_deterministic(setup):
+    """The seeded append -> delete -> expire -> query loopback, run
+    twice: bit-identical logits and dispatch logs (the CI smoke step)."""
+    def run():
+        stream = make_temporal_stream()
+        eng = make_engine(setup, stream, stream_retention_window=40.0)
+        rows = []
+        rows.append(eng.predict([3, 9], t=55.0))
+        eng.stage_edges([3, 3, 9], [60, 61, 62],
+                        ts=[56.0, 57.0, 58.0])          # append
+        eng.update_graph()
+        eng.stage_removals([3], [61])                   # delete
+        eng.update_graph()
+        eng.expire_edges(95.0)                          # expire (95-40)
+        rows.append(eng.predict([3, 9, 61], t=96.0))    # query
+        return np.concatenate(rows), eng
+
+    rows_a, eng_a = run()
+    rows_b, eng_b = run()
+    assert np.array_equal(rows_a, rows_b)
+    assert np.isfinite(rows_a).all()
+    assert eng_a.stats.edges_deleted == 1
+    assert eng_a.stats.edges_expired == eng_b.stats.edges_expired > 0
+    assert len(eng_a.dispatch_log) == len(eng_b.dispatch_log)
+    for (pa, na, ta), (pb, nb, tb) in zip(eng_a.dispatch_log,
+                                          eng_b.dispatch_log):
+        assert na == nb and np.array_equal(pa, pb)
+        assert np.array_equal(ta, tb)
+
+
+@pytest.mark.parametrize("mif", [1, 2])
+def test_engine_compaction_observe_only_serving(setup, mif):
+    """Acceptance: logits + dispatch logs identical with compaction
+    on/off at max_in_flight 1/2 — compaction never perturbs serving."""
+    def run(compact):
+        stream = make_temporal_stream(reserve_frac=2.0)
+        eng = make_engine(setup, stream, max_in_flight=mif,
+                          stream_compact_min_reclaim=1)
+        rows = []
+        rng = np.random.default_rng(13)
+        for step in range(3):
+            d = GraphDelta()
+            d.add_edges(np.full(150, 7 + step),
+                        rng.integers(0, N_NODES, 150),
+                        ts=np.full(150, 60.0 + step, np.float32))
+            eng.update_graph(d)
+            rm = GraphDelta()
+            rm.remove_edges(np.full(100, 7 + step),
+                            np.asarray(d.edges()[1])[:100])
+            eng.update_graph(rm)
+            if compact:
+                cs = eng.compact_graph()
+                assert cs["tiles_reclaimed"] >= 0
+            rows.append(eng.predict(
+                [7 + step, 3, 9, 11], t=70.0 + step))
+        return np.concatenate(rows), eng
+
+    rows_off, eng_off = run(False)
+    rows_on, eng_on = run(True)
+    assert np.array_equal(rows_off, rows_on)
+    assert eng_on.stats.compactions >= 1
+    assert len(eng_off.dispatch_log) == len(eng_on.dispatch_log)
+    for (pa, na, ta), (pb, nb, tb) in zip(eng_off.dispatch_log,
+                                          eng_on.dispatch_log):
+        assert na == nb and np.array_equal(pa, pb)
+        assert np.array_equal(ta, tb)
+
+
+def test_compaction_races_inflight_flush(setup):
+    """A compaction pass landing while a flush is in its dispatch stage
+    must fence (plan off-fence, apply drains in-flight) and stay
+    observe-only — the served row is bit-identical to a race-free run."""
+    from test_serve import _GateFeature
+
+    model, params, feat = setup
+
+    def run(race):
+        stream = StreamingTiledGraph(make_topo(), reserve_frac=2.0)
+        gate = _GateFeature(feat)
+        eng = ServeEngine(
+            model, params,
+            GraphSageSampler(make_topo(), sizes=SIZES, mode="TPU",
+                             seed=SEED).bind_stream(stream),
+            gate,
+            ServeConfig(max_batch=4, buckets=(4,), max_delay_ms=1e9,
+                        max_in_flight=2, record_dispatches=True),
+        )
+        eng.warmup()
+        d = GraphDelta()
+        rng = np.random.default_rng(3)
+        d.add_edges(np.full(300, 7), rng.integers(0, N_NODES, 300))
+        eng.update_graph(d)
+        rm = GraphDelta()
+        rm.remove_edges(np.full(200, 7), np.asarray(d.edges()[1])[:200])
+        eng.update_graph(rm)
+        if race:
+            gate.delays = [1.0]
+            gate.started.clear()
+            h = eng.submit(7)
+            t_fl = threading.Thread(target=eng.flush)
+            t_fl.start()
+            assert gate.started.wait(30)
+            cs = eng.compact_graph()        # races the in-flight flush
+            assert cs["tiles_reclaimed"] > 0
+            t_fl.join()
+            row = h.result(60)
+        else:
+            row = eng.predict([7])[0]
+            eng.compact_graph()
+        return row, eng
+
+    row_r, _ = run(True)
+    row_p, _ = run(False)
+    assert np.array_equal(row_r, row_p)
+
+
+def test_engine_auto_provision_retries_once(setup):
+    """A capacity-stalled commit auto-provisions
+    (`stream_provision_tiles`) and retries ONCE; sealed programs rebind
+    via `reprovision` — serving continues on the grown bank."""
+    stream = make_temporal_stream(reserve_tiles=2)
+    eng = make_engine(setup, stream, stream_provision_tiles=64)
+    d = GraphDelta()
+    for i in range(3 * 128):
+        d.add_edge(9, (9 + 1 + i) % N_NODES, ts=61.0)
+    cap0 = stream.m_cap
+    out = eng.update_graph(d)
+    assert out["provisioned"] is True
+    assert stream.m_cap > cap0
+    assert stream.degree(9) == int(make_topo().degree[9]) + 3 * 128
+    assert np.isfinite(eng.predict([9, 4], t=100.0)).all()
+    # with no provisioning budget the same commit is a loud typed error
+    stream2 = make_temporal_stream(reserve_tiles=2)
+    eng2 = make_engine(setup, stream2)
+    with pytest.raises(StreamCapacityError):
+        eng2.update_graph(d)
+
+
+# -- hosts=2: fleet deletion parity + structural-only guard -------------------
+
+def two_community_graph():
+    rng = np.random.default_rng(4)
+    half = N_NODES // 2
+    src_a = rng.integers(0, half, 600)
+    dst_a = rng.integers(0, half, 600)
+    src_b = rng.integers(half, N_NODES, 600)
+    dst_b = rng.integers(half, N_NODES, 600)
+    return CSRTopo(edge_index=np.stack([
+        np.concatenate([src_a, src_b]), np.concatenate([dst_a, dst_b])
+    ]), num_nodes=N_NODES)
+
+
+def test_dist_removal_all_or_none_and_fleet_parity(setup):
+    from quiver_tpu.serve import replay_fleet_oracle
+
+    model, params, feat = setup
+    topo = two_community_graph()
+    dist = DistServeEngine.build(
+        model, params, topo, feat, SIZES, hosts=2,
+        config=DistServeConfig(hosts=2, max_batch=8, max_delay_ms=1e9,
+                               record_dispatches=True, exchange="host",
+                               streaming=True),
+        sampler_seed=SEED,
+    )
+    dist.warmup()
+
+    def serve_all(trace):
+        handles = [dist.submit(int(x)) for x in trace]
+        while dist._drainable():
+            dist.flush()
+        return np.stack([h.result(timeout=60) for h in handles])
+
+    half = N_NODES // 2
+    u, v = 3, half + 5
+    d = GraphDelta()
+    d.add_edge(u, v)
+    dist.update_graph(d)
+    assert v in set(dist._stream_adj.neighbors(u).tolist())
+    trace = zipfian_trace(half, 12, alpha=1.0, seed=5)
+    rows1 = serve_all(trace)
+    assert np.isfinite(rows1).all()
+    # structural-only: timestamp updates are rejected loudly
+    du = GraphDelta()
+    du.update_edge(u, v, 99.0)
+    with pytest.raises(ValueError, match="structural-only"):
+        dist.update_graph(du)
+    # all-or-none: an absent removal rejects the whole batch
+    bad = GraphDelta()
+    bad.remove_edge(u, v)
+    bad.remove_edge(u, half + 7)     # never added
+    with pytest.raises(ValueError, match="all-or-none"):
+        dist.update_graph(bad)
+    assert v in set(dist._stream_adj.neighbors(u).tolist())
+    assert dist.graph_version == 1   # nothing applied
+    # the clean removal: fleet topology drops the edge everywhere
+    dist.stage_removals([u], [v])
+    out = dist.update_graph()
+    assert out["edges_deleted"] == 1
+    assert dist.stats.edges_deleted == 1
+    assert v not in set(dist._stream_adj.neighbors(u).tolist())
+    for h in range(2):
+        st = dist._owner_streams.get(h)
+        if st is not None and st.degree(u):
+            assert v not in set(st.neighbors(u).tolist())
+    rows2 = serve_all(trace)
+    # deletion parity at serving grain: post-delete rows bit-match the
+    # fleet replay over the topology WITHOUT the edge (the materialized
+    # post-removal adjacency == the graph that never had it)
+    t_new = dist._stream_adj.to_csr_topo()
+
+    def mk_without():
+        return GraphSageSampler(t_new, sizes=SIZES, mode="TPU", seed=SEED)
+
+    oracle_w = replay_fleet_oracle(dist, model, params, mk_without, feat)
+    for nid, row in zip(trace, rows2):
+        assert any(np.array_equal(row, c)
+                   for c in oracle_w.get(int(nid), [])), \
+            f"fleet deletion parity violation at {int(nid)}"
+    # fleet compaction: per-owner observe-only passes, aggregated
+    cs = dist.compact_graph()
+    assert "tiles_reclaimed" in cs
+    rows3 = serve_all(trace)
+    assert np.array_equal(rows2, rows3)
+
+
+# -- scaling model: lifecycle cost terms --------------------------------------
+
+def test_delta_table_lifecycle_terms():
+    from quiver_tpu.parallel.scaling import delta_table, format_delta_markdown
+
+    rows = delta_table(
+        [("lc", 1000.0)],
+        append_s_per_edge=1e-6, swap_s_per_commit=1e-3,
+        commit_period_s=1.0,
+        delete_frac=0.5, delete_s_per_edge=2e-6,
+        compact_s_per_pass=5e-3, compact_every_commits=10.0,
+    )
+    r = rows[0]
+    assert r.churn_s == pytest.approx(1000 * 0.5 * 2e-6)
+    assert r.compact_amort_s == pytest.approx(5e-4)
+    # churn is fence time; compaction amortizes into duty but NOT stall
+    assert r.commit_s == pytest.approx(1000 * 1e-6 + 1e-3 + r.churn_s)
+    assert r.fence_stall_s == pytest.approx(r.commit_s)
+    assert r.duty_frac == pytest.approx(
+        (r.commit_s + r.compact_amort_s) / 1.0
+    )
+    md = format_delta_markdown(rows)
+    assert "churn ms" in md and "compact ms" in md
+    # without lifecycle inputs the table is byte-stable (no new columns)
+    rows0 = delta_table(
+        [("lc", 1000.0)],
+        append_s_per_edge=1e-6, swap_s_per_commit=1e-3,
+        commit_period_s=1.0,
+    )
+    assert rows0[0].churn_s == 0.0 and rows0[0].compact_amort_s == 0.0
+    assert "churn ms" not in format_delta_markdown(rows0)
+    with pytest.raises(ValueError):
+        delta_table(
+            [("x", 1.0)],
+            append_s_per_edge=1e-6, swap_s_per_commit=1e-3,
+            delete_frac=-0.1,
+        )
