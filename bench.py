@@ -1230,6 +1230,52 @@ def bench_serve(context, indptr_np, indices_np, table, caps, n_requests=256):
         context["host_submit_error"] = repr(exc)
         log(f"host submit timing failed: {exc}")
 
+    # host drain path (round 22): the resolve/delivery half — dispatch
+    # mocked to canned read-only logits so the timed wall is host work
+    # only (assemble + seal + block resolve, then `results_many`). The
+    # bench.py counterpart of FRONTEND_r02.json's host_resolve_us /
+    # host_deliver_us keys, so a bench artifact alone carries both
+    # inputs of `scaling.serve_table(host_submit_us=, host_resolve_us=)`
+    try:
+        htrace = zipfian_trace(n_nodes, 4096, alpha=0.99, seed=23)
+        heng = ServeEngine(
+            model, params, make_sampler(), table,
+            ServeConfig(max_batch=1 << 13, max_delay_ms=1e9,
+                        cache_entries=0),
+        )
+        canned = np.zeros((1 << 13, model.out_dim), np.float32)
+        canned.setflags(write=False)
+
+        def _mock_dispatch(fl, _eng=heng, _c=canned):
+            with _eng._lock:
+                _eng.stats.dispatch_calls += 1
+                _eng.stats.execute_calls += 1
+            return _c
+
+        heng._dispatch = _mock_dispatch
+        handles = heng.submit_many(htrace)
+        t0 = time.time()
+        while heng._drainable():
+            heng.flush()
+        drain_wall = time.time() - t0
+        t0 = time.time()
+        heng.results_many(handles)
+        deliver_wall = time.time() - t0
+        context["host_resolve_us"] = round(
+            drain_wall / htrace.shape[0] * 1e6, 3
+        )
+        context["host_deliver_us"] = round(
+            deliver_wall / htrace.shape[0] * 1e6, 3
+        )
+        log(
+            f"host drain path @4096 (mocked dispatch): resolve "
+            f"{context['host_resolve_us']:.2f} us/req, deliver "
+            f"{context['host_deliver_us']:.2f} us/req"
+        )
+    except Exception as exc:
+        context["host_resolve_error"] = repr(exc)
+        log(f"host drain timing failed: {exc}")
+
     for alpha in (0.0, 0.99):
         for mif in (1, 2):
             eng = ServeEngine(

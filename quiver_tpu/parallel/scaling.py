@@ -506,7 +506,10 @@ class ServePrediction(NamedTuple):
     # -- host-path fields (round 20; default 0 = no host term, rows
     # byte-identical to the round-11 model) --
     host_submit_us: float = 0.0    # measured submit->seal host cost/request
-    host_qps_cap: float = math.inf # serial admission ceiling, 1e6/host_us
+    host_qps_cap: float = math.inf # serial host ceiling, 1e6/(submit+resolve)
+    # -- drain-side host field (round 22; default 0 keeps round-20 rows
+    # byte-identical: the cap reduces to 1e6/host_submit_us) --
+    host_resolve_us: float = 0.0   # measured drain (assemble→resolve)/request
 
 
 def serve_table(
@@ -524,6 +527,7 @@ def serve_table(
     dispatches_per_flush: int = 1,
     dispatch_overhead_s: float = 0.0,
     host_submit_us: float = 0.0,
+    host_resolve_us: float = 0.0,
 ) -> List[ServePrediction]:
     """Analytic QPS model for the online serving engine
     (`quiver_tpu.serve.ServeEngine`) from MEASURED per-batch costs.
@@ -593,6 +597,14 @@ def serve_table(
     exactly the regimes the vectorized `submit_many` path exists for —
     the scalar-path cost typically binds at high cache-hit rates, where
     one dispatch retires many requests.
+
+    ``host_resolve_us`` (round 22) is the drain-side twin: the
+    assemble→seal→resolve host work per request (block resolution,
+    `put_many` cache fill, batched delivery), measured as
+    FRONTEND_r02.json's ``host_resolve_us``. The two host phases run on
+    the same serial admission/drain path, so the cap becomes
+    ``1e6 / (host_submit_us + host_resolve_us)``; the default 0 keeps
+    every row byte-identical to the round-20 model.
     """
     bw = dict(DEFAULT_BANDWIDTHS)
     if bandwidths:
@@ -618,9 +630,8 @@ def serve_table(
             xbytes = 0.0
             x_s = 0.0
         t_routed = t_dispatch + x_s
-        host_cap = (
-            1e6 / host_submit_us if host_submit_us > 0 else math.inf
-        )
+        host_us = host_submit_us + host_resolve_us
+        host_cap = 1e6 / host_us if host_us > 0 else math.inf
         for h in hit_rates:
             miss = (1.0 - h) * unique_frac
             rpd = b / miss if miss > 0 else math.inf
@@ -645,6 +656,7 @@ def serve_table(
                     overhead_s=dispatch_overhead_s,
                     host_submit_us=host_submit_us,
                     host_qps_cap=host_cap,
+                    host_resolve_us=host_resolve_us,
                 )
             )
     return rows
@@ -695,15 +707,29 @@ def format_serve_markdown(rows: Sequence[ServePrediction]) -> str:
             "(row-count-bound regime, PERF_NOTES.md); the serving engine's "
             "measured counterpart is scripts/serve_probe.py / bench.py serve."
         )
-    hosted = [r for r in rows if getattr(r, "host_submit_us", 0.0) > 0]
+    hosted = [
+        r for r in rows
+        if getattr(r, "host_submit_us", 0.0) > 0
+        or getattr(r, "host_resolve_us", 0.0) > 0
+    ]
     if hosted:
         hs = hosted[0].host_submit_us
-        lines.append(
-            f"Host submit path (round 20): {hs:.2f} us/request "
-            f"(submit→seal, scripts/bench_frontend.py) caps QPS at "
-            f"{1e6 / hs:.0f}/s per admission path; rows at that value "
-            "are host-bound, not device-bound."
-        )
+        hr = getattr(hosted[0], "host_resolve_us", 0.0)
+        if hr > 0:
+            lines.append(
+                f"Host path (round 22): {hs:.2f} us/request submit + "
+                f"{hr:.2f} us/request drain (assemble→resolve, scripts/"
+                f"bench_frontend.py) cap QPS at {1e6 / (hs + hr):.0f}/s "
+                "per admission path; rows at that value are host-bound, "
+                "not device-bound."
+            )
+        else:
+            lines.append(
+                f"Host submit path (round 20): {hs:.2f} us/request "
+                f"(submit→seal, scripts/bench_frontend.py) caps QPS at "
+                f"{1e6 / hs:.0f}/s per admission path; rows at that value "
+                "are host-bound, not device-bound."
+            )
     return "\n".join(lines)
 
 

@@ -143,6 +143,37 @@ class EmbeddingCache:
                 self._entries.popitem(last=False)
                 self.counters.evict()
 
+    def put_many(self, node_ids, version: int, values) -> None:
+        """Batch :meth:`put` (round 22) — `get_many`'s writeback twin:
+        ONE lock hold and ONE version for the whole batch (the resolve
+        path's update_params fence guarantees every row in a flush was
+        computed under the live version, so the version check happens
+        once per batch, not per key), with eviction counters moved in
+        bulk after the lock drops. The per-key mechanics — delete-then-
+        insert LRU placement and the eviction loop INSIDE the per-key
+        pass — are exactly N scalar puts in order, so resident entries,
+        LRU order AND eviction counts are bit-identical (an early key
+        evicted by a later one and then re-inserted must count both
+        evictions, which a deferred one-shot trim would miss)."""
+        if self.capacity == 0 or not len(node_ids):
+            return
+        version = int(version)
+        evictions = 0
+        with self._lock:
+            d = self._entries
+            cap = self.capacity
+            for k, v in zip(node_ids, values):
+                if isinstance(k, tuple):
+                    self._tuple_keys = True
+                if k in d:
+                    del d[k]
+                d[k] = (version, v)
+                while len(d) > cap:
+                    d.popitem(last=False)
+                    evictions += 1
+        if evictions:
+            self.counters.evict(evictions)
+
     def entry_version(self, node_id: Hashable) -> Optional[int]:
         """The params version a node's entry was computed under, or None
         when the node has no entry — an INSPECTION helper (no LRU touch,

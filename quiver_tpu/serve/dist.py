@@ -106,6 +106,7 @@ from .cache import EmbeddingCache
 from .faults import OwnerFault
 from .engine import (
     DEFAULT_TENANT,
+    ResultBatch,
     ServeConfig,
     ServeEngine,
     ServeResult,
@@ -113,7 +114,10 @@ from .engine import (
     ShedError,
     _PendingStripes,
     _Slot,
+    _admit_batch_vector,
     _admit_chunk_fast,
+    _batch_uniq,
+    _resolve_block,
     abandon_undrained,
     register_tenant_latency,
     resolve_tenants,
@@ -1205,6 +1209,9 @@ class DistServeEngine:
         self._seq = threading.Lock()
         self._window = threading.BoundedSemaphore(self.config.max_in_flight)
         self._inflight_flushes = 0
+        # parity escape hatch (round 22): True forces the per-slot
+        # resolve loop the block resolution is pinned against
+        self._scalar_resolve = False
         self._threads: List[threading.Thread] = []
         self._running = False
         if mode == "collective":
@@ -1516,14 +1523,26 @@ class DistServeEngine:
                 f"node id {int(ids[bad][0])} outside [0, {n_ids})"
             )
         keys = ids.tolist()
-        return self._submit_keyed_many(keys, keys, tenant)
+        return self._submit_keyed_many(keys, keys, tenant, uniq_arr=ids)
 
     def _submit_keyed_many(self, keys: List, nodes: List[int],
-                           tenant) -> List[ServeResult]:
+                           tenant, uniq_arr=None) -> ResultBatch:
         """KEEP IN LOCKSTEP with `ServeEngine._submit_keyed_many` (the
         router has no submit-time prefetch leg; its per-owner prefetch
-        runs at seal off the routed split)."""
+        runs at seal off the routed split) — including the round-22
+        whole-batch vectorized admission gate: `_admit_batch_vector`
+        stripes per owner through ``pend.stripe_of`` exactly as the
+        scalar inserts would."""
         n = len(keys)
+        if n and uniq_arr is not None and self._vector_admissible(tenant):
+            pre = _batch_uniq(uniq_arr)
+            if pre is not None:
+                ten = DEFAULT_TENANT if tenant is None else str(tenant)
+                now = self._clock()
+                with self._pending.all_locks():
+                    rb = _admit_batch_vector(self, keys, ten, now, *pre)
+                if rb is not None:
+                    return rb
         tenants = resolve_tenants(tenant, n)
         results: List[Optional[ServeResult]] = [None] * n
         max_batch = self.config.max_batch
@@ -1556,7 +1575,12 @@ class DistServeEngine:
             jr.record_many(events)
             if need_flush:
                 self.flush()
-        return results
+        return ResultBatch(items=results)
+
+    # the engine-shape gates are identical on both front ends (the
+    # router's extra state — owner split, exchange — only matters after
+    # assembly, never at admission)
+    _vector_admissible = ServeEngine._vector_admissible
 
     def _submit_keyed(self, key, node: int,
                       tenant: Optional[str]) -> ServeResult:
@@ -1648,9 +1672,14 @@ class DistServeEngine:
         if not handles:
             return np.zeros((0, self.out_dim), np.float32)
         if not self._running:
-            while any(not h.done() for h in handles) and self._drainable():
+            while not handles.done() and self._drainable():
                 self.flush()
-        return np.stack([h.result(timeout) for h in handles])
+        return self.results_many(handles, timeout)
+
+    # batch consumption surface (round 22), identical on both front ends:
+    # a ResultBatch gathers per unique slot + one inverse-map expansion,
+    # anything else degrades to the per-handle result() stack
+    results_many = ServeEngine.results_many
 
     # -- flush policy ------------------------------------------------------
 
@@ -2109,24 +2138,35 @@ class DistServeEngine:
         resolves normally. An errored slot is never cached."""
         with self._lock:
             now = t_res0 = self._clock()
-            for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
-                self._inflight.pop(k, None)
-                if slot.resolved:
-                    # abandoned by a bounded stop() drain (resolve-once
-                    # rule — see ServeEngine._resolve)
-                    continue
-                err = fl.error or fl.slot_errors.get(i)
-                if err is None:
-                    if slot.version == self.params_version:
-                        self.cache.put(k, slot.version, rows[i])
-                    slot.resolve(rows[i])
-                else:
-                    slot.resolve(None, error=err)
-                    self.stats.request_errors += 1
-                for t0, tenant in slot.waiters:
-                    ms = (now - t0) * 1e3
-                    self.stats.latency.record_ms(ms)
-                    self.stats.tenant_hist(tenant).record_ms(ms)
+            slots = fl.slots
+            if (fl.error is None and not fl.slot_errors and slots
+                    and not slots[0].resolved
+                    and slots[0].version == self.params_version
+                    and not self._scalar_resolve):
+                # round-22 block resolution, shared with ServeEngine —
+                # the extra dist gate is ``slot_errors``: any per-owner
+                # sub-batch failure sends the flush down the per-slot
+                # loop that knows how to split error from value rows
+                _resolve_block(self, fl, rows, now)
+            else:
+                for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
+                    self._inflight.pop(k, None)
+                    if slot.resolved:
+                        # abandoned by a bounded stop() drain (resolve-
+                        # once rule — see ServeEngine._resolve)
+                        continue
+                    err = fl.error or fl.slot_errors.get(i)
+                    if err is None:
+                        if slot.version == self.params_version:
+                            self.cache.put(k, slot.version, rows[i])
+                        slot.resolve(rows[i])
+                    else:
+                        slot.resolve(None, error=err)
+                        self.stats.request_errors += 1
+                    for t0, tenant in slot.waiters:
+                        ms = (now - t0) * 1e3
+                        self.stats.latency.record_ms(ms)
+                        self.stats.tenant_hist(tenant).record_ms(ms)
             if fl.error is None:
                 self.stats.router_dispatches += 1
                 self.stats.routed_seeds += len(fl.keys)
@@ -2138,7 +2178,8 @@ class DistServeEngine:
             self._inflight_flushes -= 1
             self._fence.notify_all()
             self.stats.spans.record("resolve", t_res0, self._clock())
-            self.journal.emit("resolve", -1, fl.fid, len(fl.keys))
+            self.journal.record_many((("resolve", -1, fl.fid,
+                                       len(fl.keys), 0),))
 
     def flush(self) -> int:
         """Route up to ``max_batch`` pending unique seeds NOW. Synchronous

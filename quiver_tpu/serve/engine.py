@@ -88,8 +88,10 @@ compile.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import itertools
+import operator
 import threading
 import time
 from dataclasses import dataclass, field
@@ -829,6 +831,129 @@ class ServeResult:
         return self._slot.value
 
 
+def _slot_row(slot: "_Slot", timeout: Optional[float]) -> np.ndarray:
+    """`ServeResult.result` against a bare slot (the lazy batch path
+    skips the handle object entirely) — same wait/raise/return
+    sequence, same timeout message."""
+    if not slot.wait(timeout):
+        raise TimeoutError("serve request not resolved in time")
+    if slot.error is not None:
+        raise slot.error
+    return slot.value
+
+
+class ResultBatch(collections.abc.Sequence):
+    """The handle sequence ``submit_many`` returns (round 22): admission
+    keeps the RAW per-request outcome — a `_Slot`, or a ready
+    `ServeResult` (cache hit / shed / per-request error) — and builds a
+    `ServeResult` only when a caller actually indexes or iterates, so
+    per-request handle construction moves off the submit path onto the
+    consumer that wants handles. Fully list-compatible for existing
+    callers (``len``/index/slice/iterate/truthiness); the batch
+    consumers (`ServeEngine.results_many`, ``predict``) read the raw
+    entries array-at-a-time and never materialize handles at all.
+
+    The whole-batch vectorized admission path stores one slot per
+    UNIQUE key plus the batch's coalesce map (``inv[i]`` = the unique
+    index serving request ``i``), so delivery is a per-unique gather
+    expanded by ONE fancy-index instead of N per-request reads."""
+
+    __slots__ = ("_items", "_uniq", "_inv")
+
+    def __init__(self, items: Optional[List] = None,
+                 uniq: Optional[List] = None,
+                 inv: Optional[np.ndarray] = None):
+        self._items = items
+        self._uniq = uniq
+        self._inv = inv
+
+    def __len__(self) -> int:
+        if self._items is not None:
+            return len(self._items)
+        return len(self._inv)
+
+    def _raw(self, i: int):
+        if self._items is not None:
+            return self._items[i]
+        return self._uniq[self._inv[i]]
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        it = self._raw(i)
+        return it if isinstance(it, ServeResult) else ServeResult(slot=it)
+
+    def __iter__(self):
+        if self._items is not None:
+            raws = self._items
+        else:
+            uniq = self._uniq
+            raws = [uniq[j] for j in self._inv.tolist()]
+        for it in raws:
+            yield it if isinstance(it, ServeResult) else ServeResult(slot=it)
+
+    def __eq__(self, other):
+        # list-compatibility: handle wrappers materialize per access, so
+        # equality is positional identity of the RAW outcomes (two views
+        # of the same admission compare equal; `submit_many([]) == []`
+        # stays true)
+        if isinstance(other, (list, tuple, ResultBatch)):
+            if len(self) != len(other):
+                return False
+            return all(
+                a is b or (isinstance(a, ServeResult)
+                           and isinstance(b, ServeResult)
+                           and a._slot is not None
+                           and a._slot is b._slot)
+                for a, b in zip(self, other)
+            )
+        return NotImplemented
+
+    __hash__ = None  # mutable-sequence convention, like list
+
+    def done(self) -> bool:
+        """True when every request's handle would report ``done()`` —
+        checked per UNIQUE slot on the vectorized path."""
+        raws = self._items if self._items is not None else self._uniq
+        for it in raws:
+            if isinstance(it, ServeResult):
+                if not it.done():
+                    return False
+            elif not it.resolved:
+                return False
+        return True
+
+    def gather(self, timeout: Optional[float] = None) -> np.ndarray:
+        """All rows as one ``[N, C]`` array in request order — the batch
+        twin of ``np.stack([h.result(timeout) for h in handles])``,
+        including its error order: the first REQUEST whose handle would
+        raise is the one raised here."""
+        n = len(self)
+        if n == 0:
+            return np.zeros((0, 0), np.float32)
+        if self._items is None:
+            uniq = self._uniq
+            errs = None
+            for j, slot in enumerate(uniq):
+                if not slot.wait(timeout):
+                    raise TimeoutError("serve request not resolved in time")
+                if slot.error is not None:
+                    if errs is None:
+                        errs = {}
+                    errs[j] = slot.error
+            if errs is not None:
+                for j in self._inv.tolist():  # request order
+                    if j in errs:
+                        raise errs[j]
+            rows = np.stack([slot.value for slot in uniq])
+            return rows[self._inv]
+        return np.stack([
+            it.result(timeout) if isinstance(it, ServeResult)
+            else _slot_row(it, timeout)
+            for it in self._items
+        ])
+
+
 @dataclass
 class ServeStats:
     """Engine counters. ``requests`` counts every submit; ``coalesced``
@@ -1132,7 +1257,7 @@ def _admit_chunk_fast(eng, keys, nodes, tenants, i, now, events,
                         ev_append(("submit", r, -1, node, 0))
                     plen += 1
                 slot.waiters.append((now, ten))
-                results[i] = ServeResult(slot=slot)
+                results[i] = slot  # handle built lazily by ResultBatch
                 i += 1
                 if plen >= max_batch:
                     eng._next_rid = rid
@@ -1143,6 +1268,164 @@ def _admit_chunk_fast(eng, keys, nodes, tenants, i, now, events,
     stats.requests += requests
     stats.coalesced += coalesced
     return i, False
+
+
+def _batch_uniq(arr: np.ndarray):
+    """First-occurrence unique decomposition of a submit batch:
+    ``(uniq_ix, inv, counts)`` where ``uniq_ix`` indexes the batch's
+    unique keys in ARRIVAL (first-occurrence) order, ``inv[i]`` is the
+    unique index serving request ``i``, and ``counts`` the per-unique
+    request multiplicity. Works on int id arrays and on structured
+    (node, t) arrays alike. Returns None when the array holds NaNs —
+    ``np.unique`` collapses equal NaNs while dict keys built from
+    distinct float objects do not, so those batches take the
+    per-request path."""
+    if arr.dtype.kind == "f" and np.isnan(arr).any():
+        return None
+    if arr.dtype.names is not None:
+        for name in arr.dtype.names:
+            f = arr[name]
+            if f.dtype.kind == "f" and np.isnan(f).any():
+                return None
+    _, first, inv, counts = np.unique(
+        arr, return_index=True, return_inverse=True, return_counts=True
+    )
+    order = np.argsort(first)  # sorted-unique -> arrival order
+    rank = np.empty(order.shape[0], np.int64)
+    rank[order] = np.arange(order.shape[0])
+    return first[order], rank[inv], counts[order]
+
+
+def _admit_batch_vector(eng, keys, tenant: str, now: float, uniq_ix,
+                        inv, counts) -> Optional[ResultBatch]:
+    """WHOLE-batch vectorized admission (round 22) — the per-UNIQUE-key
+    admission body behind `submit_many` when nothing per-request can
+    happen: the journal is off (no rid draws, no per-request events),
+    the cache is empty-by-config (no hit can short-circuit), one tenant
+    covers the batch, and the whole batch fits the pending queue without
+    an inline fill-flush. Under those gates the scalar decision sequence
+    collapses to "coalesce or insert, per unique key": duplicates inside
+    the batch attach to the first occurrence's slot exactly as the
+    per-request loop would attach them, so slots, arrival stamps,
+    waiter lists and counters are bit-identical to N scalar submits —
+    while the per-REQUEST work drops to one np.unique.
+
+    Caller holds ALL stripe locks and has checked the engine-shape
+    gates; this checks the state gates (open window, room) under
+    ``_lock`` and returns None to fall back. Shared by the single-host
+    engine and the router (stripe mapping via ``pend.stripe_of`` keeps
+    it owner-partition-correct there)."""
+    pend = eng._pending
+    maps = pend.maps
+    tmaps = pend.tenants
+    stripe_of = pend.stripe_of
+    infl_get = eng._inflight.get
+    n_uniq = uniq_ix.shape[0]
+    with eng._lock:
+        if eng._open is not None:
+            return None
+        if len(pend) + n_uniq >= eng.config.max_batch:
+            # an inline fill-flush could land mid-batch; the per-request
+            # path owns that interleaving
+            return None
+        ver = eng.params_version
+        arrival = pend._arrival
+        w = (now, tenant)
+        uniq_slots = [None] * n_uniq
+        new = 0
+        ux = uniq_ix.tolist()
+        cts = counts.tolist()
+        for j in range(n_uniq):
+            k = keys[ux[j]]
+            s = stripe_of(k)
+            slot = maps[s].get(k) or infl_get(k)
+            if slot is None or slot.version != ver:
+                slot = _Slot(k, ver, now, rid=-1, tenant=tenant)
+                slot.seq = next(arrival)
+                maps[s][k] = slot
+                t = tmaps[s]
+                t[tenant] = t.get(tenant, 0) + 1
+                new += 1
+            c = cts[j]
+            if c == 1:
+                slot.waiters.append(w)
+            else:
+                slot.waiters.extend([w] * c)
+            uniq_slots[j] = slot
+    n = len(keys)
+    stats = eng.stats
+    stats.requests += n
+    stats.coalesced += n - new
+    # the scalar path probes the (empty, untapped) cache per request and
+    # counts a miss each time — same evidence, one bulk move
+    eng.cache.counters.miss(n)
+    return ResultBatch(uniq=uniq_slots, inv=inv)
+
+
+_REPEAT_NONE = itertools.repeat(None)
+_WAITER_T0 = operator.itemgetter(0)
+_WAITER_TENANT = operator.itemgetter(1)
+
+
+def _pop_inflight_many(eng, keys) -> None:
+    """C-level batched ``_inflight.pop(k, None)`` over a flush's keys
+    (the deque(maxlen=0) idiom consumes the map object without a
+    Python-level loop)."""
+    if eng._inflight:
+        collections.deque(
+            map(eng._inflight.pop, keys, _REPEAT_NONE), maxlen=0
+        )
+
+
+def _record_waiter_latency(eng, slots, now: float) -> None:
+    """The per-waiter latency recording of `_resolve`, vectorized: one
+    flatten of the flush's waiter lists, one ``(now - t0) * 1e3`` vector
+    (element-for-element the scalar expression), one bulk histogram
+    fold for the global histogram and one per tenant. Bucket counts are
+    bit-identical to the scalar loop (`LatencyHistogram.record_ms_many`);
+    only ``sum_ms`` accumulates in vector order."""
+    ws = list(itertools.chain.from_iterable([s.waiters for s in slots]))
+    if not ws:
+        return
+    t0s = np.fromiter(map(_WAITER_T0, ws), np.float64, len(ws))
+    ms = (now - t0s) * 1e3
+    eng.stats.latency.record_ms_many(ms)
+    tenants = set(map(_WAITER_TENANT, ws))
+    if len(tenants) == 1:
+        eng.stats.tenant_hist(tenants.pop()).record_ms_many(ms)
+    else:
+        by: Dict[str, List[int]] = {}
+        for ix, wt in enumerate(ws):
+            by.setdefault(wt[1], []).append(ix)
+        for ten, ixs in by.items():
+            eng.stats.tenant_hist(ten).record_ms_many(ms[ixs])
+
+
+def _resolve_block(eng, fl, logits: np.ndarray, now: float) -> None:
+    """Stage-3 fast path (round 22 tentpole), caller holds ``_lock`` and
+    has checked the guards: no flush/slot errors, no slot already
+    resolved (abandonment by a bounded stop() resolves a flush's slots
+    all-or-nothing, so ``slots[0]`` answers for the flush), versions
+    uniform at the live ``params_version`` (the update_params fence).
+    The scalar loop then collapses to: one batched inflight pop, ONE
+    contiguous logits slice handed out as per-slot row views (the same
+    row object goes to the slot AND the cache, as in the scalar path),
+    one `EmbeddingCache.put_many`, one per-slot publication pass with
+    the lazy-Event wake, and one vectorized waiter-latency fold. Shared
+    by `ServeEngine._resolve` and `DistServeEngine._resolve`."""
+    slots = fl.slots
+    n = len(slots)
+    _pop_inflight_many(eng, fl.keys)
+    rows = list(logits[:n])  # n row views, made at C speed
+    if eng.cache.capacity != 0:
+        eng.cache.put_many(fl.keys, eng.params_version, rows)
+    for slot, row in zip(slots, rows):
+        slot.value = row
+        slot.resolved = True
+        ev = slot._event
+        if ev is not None:
+            ev.set()
+    _record_waiter_latency(eng, slots, now)
 
 
 class ServeEngine:
@@ -1325,6 +1608,11 @@ class ServeEngine:
         self._window = threading.BoundedSemaphore(self.config.max_in_flight)
         self._inflight_flushes = 0             # guarded by _lock
         self._dispatch_index = 0               # guarded by _seq
+        # parity escape hatch: True forces the pre-round-22 per-slot
+        # resolve loop — the reference the bit-parity tests (and
+        # bench_frontend's in-run parity legs) compare the block
+        # resolution against. Never set on a serving path.
+        self._scalar_resolve = False
         self._seed_bufs: Dict[Tuple[int, object], np.ndarray] = {}
         self._threads: List[threading.Thread] = []
         self._running = False
@@ -1358,11 +1646,15 @@ class ServeEngine:
 
     def submit_many(self, node_ids, t=None,
                     tenant: Union[None, str, Sequence[str]] = None,
-                    ) -> List[ServeResult]:
+                    ) -> ResultBatch:
         """Vectorized batch submit (round 20): admit N requests array-at-
         a-time — one stripe-lock acquisition per admission chunk, one
         clock read, one batched journal append (`EventJournal.
-        record_many`), per-request handles back in request order. The
+        record_many`), a list-compatible `ResultBatch` of handles back
+        in request order (round 22: handle objects materialize lazily;
+        `results_many` consumes the batch without them, and on the
+        production-shaped config — journal off, cache 0, no shedding —
+        the whole batch admits per UNIQUE key in one np.unique). The
         admission DECISIONS (cache probe order, coalescing, shedding,
         late admission, flush-at-fill) are made per request in request
         order — by the vectorized `_admit_chunk_fast` body in the
@@ -1386,17 +1678,47 @@ class ServeEngine:
             )
         ids = np.asarray(node_ids, dtype=np.int64).reshape(-1)
         keys = ids.tolist()  # python ints: dict keys + journal payloads
-        return self._submit_keyed_many(keys, keys, tenant)
+        return self._submit_keyed_many(keys, keys, tenant, uniq_arr=ids)
+
+    def _vector_admissible(self, tenant) -> bool:
+        """Engine-shape gates for the whole-batch vectorized admission
+        (`_admit_batch_vector`): nothing configured that makes admission
+        inherently per-request — no workload tap, no shedding, no
+        journal (rid draws + per-request events), no cache that could
+        hit, one tenant name. State gates (open late-admission window,
+        queue room) are checked under the locks."""
+        return (self.workload is None
+                and self.config.max_queue_depth == 0
+                and not self.journal.enabled
+                and self.cache.capacity == 0
+                and self.cache.workload is None
+                and (tenant is None or isinstance(tenant, str)))
 
     def _submit_keyed_many(self, keys: List, nodes: List[int],
-                           tenant) -> List[ServeResult]:
+                           tenant, uniq_arr: Optional[np.ndarray] = None,
+                           ) -> ResultBatch:
         """The batch admission loop behind `submit_many` (and, at N=1,
         `submit`/`_submit_keyed`): chunked single-lock holds over the
         striped pending store, per-request decisions in request order,
         one journal append per chunk, inline flush at every fill — the
         scalar admission sequence, amortized. KEEP IN LOCKSTEP with
-        `DistServeEngine._submit_keyed_many`."""
+        `DistServeEngine._submit_keyed_many`.
+
+        When the caller supplies ``uniq_arr`` (the batch's keys as one
+        np array) and the `_vector_admissible` gates pass, the whole
+        batch is admitted per UNIQUE key by `_admit_batch_vector` —
+        one np.unique, no per-request Python work — falling back here
+        whenever a per-request decision could arise."""
         n = len(keys)
+        if n and uniq_arr is not None and self._vector_admissible(tenant):
+            pre = _batch_uniq(uniq_arr)
+            if pre is not None:
+                ten = DEFAULT_TENANT if tenant is None else str(tenant)
+                now = self._clock()
+                with self._pending.all_locks():
+                    rb = _admit_batch_vector(self, keys, ten, now, *pre)
+                if rb is not None:
+                    return rb
         tenants = resolve_tenants(tenant, n)
         results: List[Optional[ServeResult]] = [None] * n
         max_batch = self.config.max_batch
@@ -1442,7 +1764,7 @@ class ServeEngine:
                         and self.config.tier_prefetch_at == "submit"):
                     self._prefetch_pending()
                 self.flush()
-        return results
+        return ResultBatch(items=results)
 
     def _submit_keyed(self, key, node: int,
                       tenant: Optional[str]) -> ServeResult:
@@ -1575,8 +1897,25 @@ class ServeEngine:
         if not handles:  # empty batch is a valid no-op (np.stack would raise)
             return np.zeros((0, 0), np.float32)
         if not self._running:
-            while any(not h.done() for h in handles) and self._drainable():
+            while not handles.done() and self._drainable():
                 self.flush()
+        return self.results_many(handles, timeout)
+
+    def results_many(self, handles, timeout: Optional[float] = None,
+                     ) -> np.ndarray:
+        """Batch consumption surface (round 22): gather a `submit_many`
+        batch's rows as ONE ``[len(handles), C]`` array — the delivery
+        half of the array-at-a-time host path. On a `ResultBatch` this
+        waits per UNIQUE slot and broadcasts rows through the batch's
+        stored inverse map (coalesced requests never re-wait, rows are
+        views into the flush's logits block); any other sequence of
+        handles degrades to the per-handle `result()` stack `predict`
+        always did. Errors surface exactly as the scalar path would:
+        the first failed request in REQUEST order raises its error."""
+        if isinstance(handles, ResultBatch):
+            return handles.gather(timeout)
+        if not len(handles):
+            return np.zeros((0, 0), np.float32)
         return np.stack([h.result(timeout) for h in handles])
 
     # -- flush policy -----------------------------------------------------
@@ -1617,9 +1956,22 @@ class ServeEngine:
         with self._pending.all_locks(), self._lock:
             if not self._pending:
                 return None
-            keys = self._drain_keys_locked()
-            slots = [self._pending.pop_unlocked(k) for k in keys]
-            self._inflight.update(zip(keys, slots))
+            if len(self._pending) <= self.config.max_batch:
+                # whole-queue drain (round 22): when everything pending
+                # fits the batch, `weighted_drain_keys` is the identity
+                # on the arrival-ordered queue (weights only bite on
+                # overflow) and every pop's tenant bookkeeping nets to
+                # empty — so one sorted merge + wholesale clear replaces
+                # the per-key pop loop, bit-identically
+                items = self._pending.ordered_items_unlocked()
+                keys = [kv[0] for kv in items]
+                slots = [kv[1] for kv in items]
+                self._pending.clear_unlocked()
+                self._inflight.update(items)
+            else:
+                keys = self._drain_keys_locked()
+                slots = [self._pending.pop_unlocked(k) for k in keys]
+                self._inflight.update(zip(keys, slots))
             # params snapshot: the fence in update_params guarantees no
             # swap lands while this flush is in flight, so the snapshot and
             # every drained slot's version agree
@@ -1667,14 +2019,28 @@ class ServeEngine:
             # array-native slot views (round 20): sealed composition as
             # int arrays — late admits included, addressed by slot index
             fl.ids = fl.seeds
-            fl.rids = np.fromiter(
-                (s.rid for s in fl.slots), np.int64, len(fl.slots)
-            )
+            n_slots = len(fl.slots)
+            if self.journal.enabled:
+                fl.rids = np.fromiter(
+                    (s.rid for s in fl.slots), np.int64, n_slots
+                )
+            else:
+                # no journal, no rid draws: every slot carries -1
+                fl.rids = np.full(n_slots, -1, np.int64)
             tix = self._tenant_ids
-            fl.tenant_ix = np.fromiter(
-                (tix.setdefault(s.tenant, len(tix)) for s in fl.slots),
-                np.int32, len(fl.slots),
-            )
+            tens = [s.tenant for s in fl.slots]
+            uniq_tens = set(tens)
+            if len(uniq_tens) == 1:
+                fl.tenant_ix = np.full(
+                    n_slots, tix.setdefault(uniq_tens.pop(), len(tix)),
+                    np.int32,
+                )
+            else:
+                # id assignment order == slot order, as the scalar pass
+                fl.tenant_ix = np.fromiter(
+                    (tix.setdefault(t, len(tix)) for t in tens),
+                    np.int32, n_slots,
+                )
             if self.config.max_in_flight == 1 and not extras:
                 # serial mode: reuse one pad buffer per bucket (round-8
                 # behavior); with in-flight > 1 each flush owns its buffer
@@ -1753,25 +2119,39 @@ class ServeEngine:
             # as the latency endpoint it keeps lock-wait IN each waiter's
             # recorded latency (their events are set after this point)
             now = t_res0 = self._clock()
-            for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
-                self._inflight.pop(k, None)
-                if slot.resolved:
-                    # abandoned by a bounded stop() drain: the error was
-                    # delivered and the waiters counted — a late
-                    # completion must not overwrite it or double-count
-                    continue
-                if fl.error is None:
-                    row = logits[i]
-                    if slot.version == self.params_version:
-                        self.cache.put(k, slot.version, row)
-                    slot.resolve(row)
-                else:
-                    slot.resolve(None, error=fl.error)
-                    self.stats.request_errors += 1
-                for t0, tenant in slot.waiters:
-                    ms = (now - t0) * 1e3
-                    self.stats.latency.record_ms(ms)
-                    self.stats.tenant_hist(tenant).record_ms(ms)
+            slots = fl.slots
+            if (fl.error is None and slots and not slots[0].resolved
+                    and slots[0].version == self.params_version
+                    and not self._scalar_resolve):
+                # the round-22 tentpole: whole-flush block resolution.
+                # The guard is per-FLUSH, not per-slot, because both of
+                # its disqualifiers are all-or-nothing: a bounded stop()
+                # abandon resolves EVERY slot of the flush or none
+                # (abandon_undrained clears pending+inflight under all
+                # locks), and the update_params fence re-stamps versions
+                # only while no flush is in flight — so slot[0] answers
+                # for the batch.
+                _resolve_block(self, fl, logits, now)
+            else:
+                for i, (k, slot) in enumerate(zip(fl.keys, fl.slots)):
+                    self._inflight.pop(k, None)
+                    if slot.resolved:
+                        # abandoned by a bounded stop() drain: the error
+                        # was delivered and the waiters counted — a late
+                        # completion must not overwrite it or double-count
+                        continue
+                    if fl.error is None:
+                        row = logits[i]
+                        if slot.version == self.params_version:
+                            self.cache.put(k, slot.version, row)
+                        slot.resolve(row)
+                    else:
+                        slot.resolve(None, error=fl.error)
+                        self.stats.request_errors += 1
+                    for t0, tenant in slot.waiters:
+                        ms = (now - t0) * 1e3
+                        self.stats.latency.record_ms(ms)
+                        self.stats.tenant_hist(tenant).record_ms(ms)
             if fl.error is None:
                 self.stats.dispatches += 1
                 self.stats.dispatched_seeds += len(fl.keys)
@@ -1782,7 +2162,8 @@ class ServeEngine:
             self._inflight_flushes -= 1
             self._fence.notify_all()
             self.stats.spans.record("resolve", t_res0, self._clock())
-            self.journal.emit("resolve", -1, fl.fid, len(fl.keys))
+            self.journal.record_many((("resolve", -1, fl.fid,
+                                       len(fl.keys), 0),))
 
     def flush(self) -> int:
         """Dispatch up to ``max_batch`` pending unique seeds as one bucket-

@@ -241,7 +241,9 @@ class TemporalServeEngine(_PairServing, ServeEngine):
         tq = quantize_t_many(_aligned_t(t, ids.shape[0]), self.t_quantum)
         nodes = ids.tolist()
         keys = list(zip(nodes, tq.tolist()))
-        return self._submit_keyed_many(keys, nodes, tenant)
+        return self._submit_keyed_many(
+            keys, nodes, tenant, uniq_arr=_composite_uniq_arr(ids, tq)
+        )
 
     def predict(self, node_ids, t=None, timeout: Optional[float] = None,
                 tenants: Optional[Sequence[str]] = None) -> np.ndarray:
@@ -257,9 +259,9 @@ class TemporalServeEngine(_PairServing, ServeEngine):
         if not handles:
             return np.zeros((0, 0), np.float32)
         if not self._running:
-            while any(not h.done() for h in handles) and self._drainable():
+            while not handles.done() and self._drainable():
                 self.flush()
-        return np.stack([h.result(timeout) for h in handles])
+        return self.results_many(handles, timeout)
 
     # -- flush hooks (the (node, t) key -> dispatch-array split) -----------
 
@@ -299,6 +301,25 @@ def _aligned_t(t, n: int) -> np.ndarray:
     if tv.shape[0] != n:
         raise ValueError(f"t has {tv.shape[0]} entries for {n} requests")
     return tv
+
+
+# structured dtype mirroring the composite (node, t_bucket) key: np.unique
+# over it compares lexicographically by (node, t), which matches tuple-key
+# dict equality exactly (the one divergence — NaN — is gated inside
+# `_batch_uniq`), so the round-22 whole-batch vectorized admission works
+# per unique COMPOSITE key on the temporal engines
+_COMPOSITE_KEY_DTYPE = np.dtype([("n", np.int64), ("t", np.float64)])
+
+
+def _composite_uniq_arr(ids: np.ndarray, tq: np.ndarray) -> np.ndarray:
+    """The batch's ``(node, t_bucket)`` keys as ONE structured array —
+    the `uniq_arr` the base `_submit_keyed_many` feeds `_batch_uniq`.
+    ``tq`` is `quantize_t_many`'s float64 output, whose values are
+    exactly the python floats ``tq.tolist()`` puts in the tuple keys."""
+    uq = np.empty(ids.shape[0], dtype=_COMPOSITE_KEY_DTYPE)
+    uq["n"] = ids
+    uq["t"] = tq
+    return uq
 
 
 class TemporalDistServeEngine(_PairServing, DistServeEngine):
@@ -528,7 +549,9 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
         tq = quantize_t_many(_aligned_t(t, ids.shape[0]), self.t_quantum)
         nodes = ids.tolist()
         keys = list(zip(nodes, tq.tolist()))
-        return self._submit_keyed_many(keys, nodes, tenant)
+        return self._submit_keyed_many(
+            keys, nodes, tenant, uniq_arr=_composite_uniq_arr(ids, tq)
+        )
 
     def predict(self, node_ids, t=None, timeout: Optional[float] = None,
                 tenants: Optional[Sequence[str]] = None) -> np.ndarray:
@@ -542,9 +565,9 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
         if not handles:
             return np.zeros((0, self.out_dim), np.float32)
         if not self._running:
-            while any(not h.done() for h in handles) and self._drainable():
+            while not handles.done() and self._drainable():
                 self.flush()
-        return np.stack([h.result(timeout) for h in handles])
+        return self.results_many(handles, timeout)
 
     # -- routed flush stages ----------------------------------------------
 

@@ -1,35 +1,43 @@
-"""Host-path frontend benchmark, round 20: scalar vs batch submit on a
-MOCKED device.
+"""Host-path frontend benchmark, round 22: the whole submit→drained→
+delivered path on a MOCKED device, phase by phase.
 
-Measures the submit→seal host cost of the serving engines with the
-device leg stubbed out: the engine's `_dispatch` is overridden to return
-canned read-only logits, so wall time IS host time (the TIER_r02
-discipline: the thing being priced is isolated in-run, and scalar/batch
-repeats are interleaved so machine drift hits both alike).
+Round 20 priced admission (scalar vs batch submit); round 22 vectorized
+the drain/delivery half (`_resolve` block resolution, `put_many`,
+`ResultBatch`/`results_many`), so this benchmark now times FOUR phases
+per leg: SUBMIT (admission), FLUSH-ASSEMBLE (drain + seal busy time,
+from the engine's span recorder), RESOLVE (stage-3 busy time), and
+DELIVER (`results_many` over the returned handles). The device leg is
+stubbed out — the engine's `_dispatch` returns canned read-only logits —
+so wall time IS host time (the TIER_r02 discipline: the thing being
+priced is isolated in-run, and scalar/batch repeats are interleaved so
+machine drift hits both alike).
 
-Two phases are timed per leg. The SUBMIT phase (admission: coalesce
-probe, striped queue insert, rid draw, stats) is what the scalar-vs-
-batch ratio and the canonical ``host_submit_us`` come from — flushes
-are deferred past it (``max_batch`` larger than the trace, infinite
-delay) so both paths pay identical seal cost outside the measured
-window, and the cache is DISABLED so a hit cannot short-circuit the
-path being priced. The DRAIN phase (assemble → seal → mocked dispatch →
-resolve) is reported alongside as ``total``: the submit→seal cost of
-the whole trace.
+``total`` keeps its r01 meaning — submit→drained wall — so the
+trajectory stays comparable: FRONTEND_r01.json's node x1 batch leg is
+read at run time and the r02 total-path throughput must be >= 3x it
+(asserted in-run, non-smoke). DELIVER is timed separately, after the
+drain, exactly as r01 left it untimed.
+
+Resolve-path BIT-PARITY is asserted in-run on node and temporal
+traffic: each parity pair drives the same trace through a block-resolve
+engine and a ``_scalar_resolve=True`` twin (the pre-round-22 per-slot
+loop, kept as the reference) and requires byte-identical logits,
+byte-identical dispatch logs, identical cache contents/LRU order, and —
+on the journal-on pair — identical journal event sequences.
 
 Legs: {node, temporal, pair} traffic x {scalar submit loop, one
-`submit_many`} x {1, 4} submit threads. The pair leg drives LP endpoint
-traffic (u,v interleaved) through the shared admission path — the
-scoring head is device work and is mocked away with the rest.
+`submit_many`} x {1, 4} submit threads (the r01 leg names).
 
-Artifact: FRONTEND_r01.json with per-leg submit-phase requests/s +
-ratio and the canonical ``host_submit_us`` (batch path, node traffic,
-1 thread) that prices `scaling.serve_table(host_submit_us=)` via
+Artifact: FRONTEND_r02.json with per-leg, per-phase seconds and us/req,
+the canonical ``host_submit_us`` AND the new ``host_resolve_us`` /
+``host_deliver_us`` (batch path, node traffic, 1 thread) that price
+`scaling.serve_table(host_submit_us=, host_resolve_us=)` via
 ``scripts/scaling_model.py --frontend``. Asserted in-run: every leg's
-batch submit path >= its scalar path, and the best batch-vs-scalar
-submit-throughput ratio >= 10x (the round-20 `_admit_chunk_fast`
-vectorized admission carries it; --smoke runs a tiny trace and only
-asserts batch >= scalar).
+batch path beats its scalar path on BOTH the submit phase and the
+total (submit→drained) wall; best batch-vs-scalar submit ratio >= 10x
+(non-smoke); node x1 batch total >= 3x FRONTEND_r01's (non-smoke);
+resolve bit-parity on node + temporal traffic (always, --smoke
+included).
 """
 
 import argparse
@@ -79,9 +87,13 @@ def mocked(engine_cls):
     """Subclass an engine with the device leg stubbed: `_dispatch`
     returns canned read-only logits sized to the flush bucket. Seal
     still pads, draws the sampler key, and writes the dispatch log —
-    the full host path runs; only the execute call is gone."""
+    the full host path runs; only the execute call is gone. The canned
+    rows are DISTINCT (row i != row j), so the resolve-parity asserts
+    catch a row mis-mapping, not just a wholesale swap."""
 
-    canned = np.zeros((MAX_BATCH, OUT_DIM), np.float32)
+    canned = np.arange(
+        MAX_BATCH * OUT_DIM, dtype=np.float32
+    ).reshape(MAX_BATCH, OUT_DIM)
     canned.setflags(write=False)
 
     class Mocked(engine_cls):
@@ -100,33 +112,47 @@ def drain(eng):
         eng.flush()
 
 
+def stage_busy(eng) -> dict:
+    """Per-stage busy seconds summed from the engine's span recorder at
+    full precision (`overlap_summary` rounds to 0.1 ms — too coarse for
+    sub-ms phases)."""
+    busy = {}
+    for stage, t0, t1 in eng.stats.spans:
+        busy[stage] = busy.get(stage, 0.0) + (t1 - t0)
+    return busy
+
+
 def drive(eng, ids, ts, n_threads, batched):
     """Submit the whole trace (scalar loop or one submit_many per
-    thread-chunk), then drain. Returns (submit_wall_s, total_wall_s):
-    the submit phase is the admission cost the ratio assert prices;
-    the drain (assemble → seal → mocked dispatch → resolve) is deferred
-    past it by the flush-deferral config and identical for both
-    paths."""
+    thread-chunk), drain, then deliver every handle. Returns a dict of
+    phase walls: ``submit`` (admission), ``total`` (submit→drained, the
+    r01 meaning — DELIVER is outside it), ``deliver`` (results_many
+    over the handles), plus the span-recorded ``assemble``/``resolve``
+    busy seconds of the drain."""
     chunk_ix = np.array_split(np.arange(ids.shape[0]), n_threads)
+    handles = [None] * n_threads
     errs = []
 
-    def run(ix):
+    def run(slot, ix):
         try:
             if batched:
                 if ts is None:
-                    eng.submit_many(ids[ix])
+                    handles[slot] = eng.submit_many(ids[ix])
                 else:
-                    eng.submit_many(ids[ix], t=ts[ix])
+                    handles[slot] = eng.submit_many(ids[ix], t=ts[ix])
             elif ts is None:
-                for i in ix:
-                    eng.submit(int(ids[i]))
+                handles[slot] = [eng.submit(int(ids[i])) for i in ix]
             else:
-                for i in ix:
-                    eng.submit(int(ids[i]), t=float(ts[i]))
+                handles[slot] = [
+                    eng.submit(int(ids[i]), t=float(ts[i])) for i in ix
+                ]
         except Exception as exc:  # a failed leg must not record a time
             errs.append(exc)
 
-    threads = [threading.Thread(target=run, args=(ix,)) for ix in chunk_ix]
+    threads = [
+        threading.Thread(target=run, args=(slot, ix))
+        for slot, ix in enumerate(chunk_ix)
+    ]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -137,7 +163,59 @@ def drive(eng, ids, ts, n_threads, batched):
     total_wall = time.perf_counter() - t0
     if errs:
         raise errs[0]
-    return submit_wall, total_wall
+    t_d0 = time.perf_counter()
+    rows = [eng.results_many(h) for h in handles]
+    deliver_wall = time.perf_counter() - t_d0
+    busy = stage_busy(eng)
+    n_rows = sum(r.shape[0] for r in rows)
+    assert n_rows == ids.shape[0], (n_rows, ids.shape[0])
+    return {
+        "submit": submit_wall,
+        "total": total_wall,
+        "deliver": deliver_wall,
+        "assemble": busy.get("assemble", 0.0),
+        "resolve": busy.get("resolve", 0.0),
+    }
+
+
+def assert_resolve_parity(make_pair, ids, ts, label, journal_on):
+    """Drive the same trace through a block-resolve engine and its
+    ``_scalar_resolve=True`` twin; require byte-identical delivered
+    logits, byte-identical dispatch logs, identical cache contents in
+    LRU order, and (journal-on) identical event sequences."""
+    a = make_pair()
+    b = make_pair()
+    b._scalar_resolve = True
+    ha = a.submit_many(ids) if ts is None else a.submit_many(ids, t=ts)
+    hb = b.submit_many(ids) if ts is None else b.submit_many(ids, t=ts)
+    drain(a)
+    drain(b)
+    ra = a.results_many(ha)
+    rb = b.results_many(hb)
+    assert ra.tobytes() == rb.tobytes(), (
+        f"{label}: block-resolve logits differ from scalar resolve"
+    )
+    la, lb = a.dispatch_log, b.dispatch_log
+    assert len(la) == len(lb) and len(la) > 0, (label, len(la), len(lb))
+    for ea, eb in zip(la, lb):
+        assert len(ea) == len(eb), (label, ea, eb)
+        for xa, xb in zip(ea, eb):
+            if isinstance(xa, np.ndarray):
+                assert xa.tobytes() == xb.tobytes(), (
+                    f"{label}: dispatch log arrays differ"
+                )
+            else:
+                assert xa == xb, (f"{label}: dispatch log fields differ",
+                                  xa, xb)
+    assert a.cache.keys() == b.cache.keys(), (
+        f"{label}: cache contents / LRU order differ "
+        f"(put_many vs scalar put)"
+    )
+    if journal_on:
+        sa = [e[1:] for e in a.journal.snapshot() if e[1] != "window_wait"]
+        sb = [e[1:] for e in b.journal.snapshot() if e[1] != "window_wait"]
+        assert sa == sb, f"{label}: journal event sequences differ"
+    return ra
 
 
 def main():
@@ -148,10 +226,11 @@ def main():
                     help="interleaved scalar/batch repeats; best-of wins")
     ap.add_argument("--threads", default="1,4")
     ap.add_argument("--out", default=None,
-                    help="artifact path (default FRONTEND_r01.json at the "
+                    help="artifact path (default FRONTEND_r02.json at the "
                          "repo root; --smoke writes nothing unless given)")
     ap.add_argument("--smoke", action="store_true",
-                    help="tiny trace for CI: asserts batch >= scalar only")
+                    help="tiny trace for CI: asserts batch >= scalar "
+                         "(submit AND total) + resolve parity only")
     args = ap.parse_args()
     if args.smoke:
         args.requests = min(args.requests, 600)
@@ -178,25 +257,28 @@ def main():
     MockedEngine = mocked(ServeEngine)
     MockedTemporal = mocked(TemporalServeEngine)
 
-    def cfg():
-        # cache DISABLED: a hit would short-circuit admission and the
-        # leg would price the cache, not the submit path; max_batch /
-        # max_delay defer every flush past the measured submit window
-        return ServeConfig(max_batch=MAX_BATCH, max_delay_ms=1e9,
-                           cache_entries=0)
+    def cfg(**kw):
+        # cache DISABLED in the timed legs: a hit would short-circuit
+        # admission and the leg would price the cache, not the submit
+        # path; max_batch / max_delay defer every flush past the
+        # measured submit window. Parity legs re-enable pieces via kw.
+        base = dict(max_batch=MAX_BATCH, max_delay_ms=1e9, cache_entries=0)
+        base.update(kw)
+        return ServeConfig(**base)
 
-    def node_engine():
+    def node_engine(**kw):
         s = GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED)
-        eng = MockedEngine(model, params, s, feat, cfg())
+        eng = MockedEngine(model, params, s, feat, cfg(**kw))
         assert eng._programs is not None, "fused path required: a split " \
             "seal would run real sampling inside the measured window"
         return eng
 
-    def temporal_engine():
+    def temporal_engine(**kw):
         s = GraphSageSampler(topo, sizes=SIZES, mode="TPU", seed=SEED,
                              dedup=False, max_deg=128)
         ts = s.bind_temporal(TemporalTiledGraph(topo, base_ts), recency=0.02)
-        eng = MockedTemporal(model, params, ts, feat, cfg(), t_quantum=4.0)
+        eng = MockedTemporal(model, params, ts, feat, cfg(**kw),
+                             t_quantum=4.0)
         assert eng._programs is not None
         return eng
 
@@ -207,6 +289,30 @@ def main():
     pair_ids = np.empty(2 * (n // 2), np.int64)
     pair_ids[0::2] = ltr.u
     pair_ids[1::2] = ltr.v
+
+    # -- resolve bit-parity (always, --smoke included): node + temporal,
+    # production shape (vector admission + indexed delivery), cache-fill
+    # shape (put_many vs scalar put), and journal-on shape ---------------
+    parity_pairs = [
+        ("node/vector-admit", lambda: node_engine(record_dispatches=True),
+         node_ids, None, False),
+        ("node/cache-fill",
+         lambda: node_engine(record_dispatches=True, cache_entries=512),
+         node_ids, None, False),
+        ("node/journal-on",
+         lambda: node_engine(record_dispatches=True, journal_events=65536),
+         node_ids, None, True),
+        ("temporal/vector-admit",
+         lambda: temporal_engine(record_dispatches=True),
+         ttr.requests, ttr.t_query, False),
+        ("temporal/cache-fill",
+         lambda: temporal_engine(record_dispatches=True, cache_entries=512),
+         ttr.requests, ttr.t_query, False),
+    ]
+    for label, make_pair, ids, ts, journal_on in parity_pairs:
+        assert_resolve_parity(make_pair, ids, ts, label, journal_on)
+    print(f"resolve bit-parity: {len(parity_pairs)} pairs OK "
+          f"(logits + dispatch logs + cache + journal)", file=sys.stderr)
 
     traffic = {
         "node": (node_engine, node_ids, None),
@@ -219,17 +325,22 @@ def main():
         for n_threads in (int(x) for x in args.threads.split(",")):
             best = {True: float("inf"), False: float("inf")}
             best_total = {True: float("inf"), False: float("inf")}
+            phases = {True: None, False: None}
             for _ in range(args.repeats):
                 # interleave scalar/batch so drift hits both paths alike
                 for batched in (False, True):
                     eng = make_eng()
-                    submit_wall, total_wall = drive(
-                        eng, ids, ts, n_threads, batched
-                    )
+                    ph = drive(eng, ids, ts, n_threads, batched)
                     assert eng.stats.dispatches > 0
-                    best[batched] = min(best[batched], submit_wall)
-                    best_total[batched] = min(best_total[batched], total_wall)
+                    best[batched] = min(best[batched], ph["submit"])
+                    if ph["total"] < best_total[batched]:
+                        best_total[batched] = ph["total"]
+                        phases[batched] = ph
             n_req = int(ids.shape[0])
+
+            def us(x):
+                return round(x / n_req * 1e6, 3)
+
             leg = {
                 "traffic": name,
                 "threads": n_threads,
@@ -240,25 +351,56 @@ def main():
                 "total_s_batch": round(best_total[True], 6),
                 "requests_per_s_scalar": round(n_req / best[False], 1),
                 "requests_per_s_batch": round(n_req / best[True], 1),
-                "scalar_us_per_request": round(best[False] / n_req * 1e6, 3),
-                "batch_us_per_request": round(best[True] / n_req * 1e6, 3),
+                "scalar_us_per_request": us(best[False]),
+                "batch_us_per_request": us(best[True]),
                 "batch_over_scalar": round(best[False] / best[True], 2),
+                "total_requests_per_s_batch": round(
+                    n_req / best_total[True], 1
+                ),
+                # per-phase split of the best-total repeat (round 22):
+                # submit + drain walls, assemble/resolve busy from the
+                # span recorder, deliver = results_many over the handles
+                "phases_batch_us_per_request": {
+                    "submit": us(phases[True]["submit"]),
+                    "flush_assemble": us(phases[True]["assemble"]),
+                    "resolve": us(phases[True]["resolve"]),
+                    "deliver": us(phases[True]["deliver"]),
+                    "drain_wall": us(
+                        phases[True]["total"] - phases[True]["submit"]
+                    ),
+                },
+                "phases_scalar_us_per_request": {
+                    "submit": us(phases[False]["submit"]),
+                    "flush_assemble": us(phases[False]["assemble"]),
+                    "resolve": us(phases[False]["resolve"]),
+                    "deliver": us(phases[False]["deliver"]),
+                    "drain_wall": us(
+                        phases[False]["total"] - phases[False]["submit"]
+                    ),
+                },
             }
             legs.append(leg)
+            pb = leg["phases_batch_us_per_request"]
             print(
                 f"{name} x{n_threads}: scalar "
-                f"{leg['requests_per_s_scalar']:.0f}/s "
-                f"({leg['scalar_us_per_request']:.1f} us/req), batch "
-                f"{leg['requests_per_s_batch']:.0f}/s "
-                f"({leg['batch_us_per_request']:.1f} us/req) -> "
-                f"{leg['batch_over_scalar']:.1f}x submit-path",
+                f"{leg['requests_per_s_scalar']:.0f}/s, batch "
+                f"{leg['requests_per_s_batch']:.0f}/s submit "
+                f"({leg['batch_over_scalar']:.1f}x) | batch total "
+                f"{leg['total_requests_per_s_batch']:.0f}/s "
+                f"[submit {pb['submit']:.2f} + assemble "
+                f"{pb['flush_assemble']:.2f} + resolve {pb['resolve']:.2f} "
+                f"+ deliver {pb['deliver']:.2f} us/req]",
                 file=sys.stderr,
             )
 
     for leg in legs:
         assert leg["requests_per_s_batch"] >= leg["requests_per_s_scalar"], (
-            f"batch path slower than scalar on {leg['traffic']} "
+            f"batch submit slower than scalar on {leg['traffic']} "
             f"x{leg['threads']}: {leg}"
+        )
+        assert leg["total_s_batch"] <= leg["total_s_scalar"], (
+            f"batch total (submit→drained) slower than scalar on "
+            f"{leg['traffic']} x{leg['threads']}: {leg}"
         )
     best_ratio = max(leg["batch_over_scalar"] for leg in legs)
     if not args.smoke:
@@ -268,6 +410,31 @@ def main():
     host_leg = next(
         leg for leg in legs if leg["traffic"] == "node" and leg["threads"] == 1
     )
+
+    # -- total-path trajectory vs round 20 (non-smoke): the r02 bar ------
+    r01_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "FRONTEND_r01.json",
+    )
+    total_vs_r01 = None
+    if not args.smoke:
+        with open(r01_path) as fh:
+            r01 = json.load(fh)
+        r01_leg = next(
+            leg for leg in r01["legs"]
+            if leg["traffic"] == "node" and leg["threads"] == 1
+        )
+        r01_us = r01_leg["total_s_batch"] / r01_leg["requests"] * 1e6
+        r02_us = host_leg["total_s_batch"] / host_leg["requests"] * 1e6
+        total_vs_r01 = round(r01_us / r02_us, 2)
+        assert total_vs_r01 >= 3.0, (
+            f"total-path (submit→drained) speedup vs FRONTEND_r01 is "
+            f"{total_vs_r01:.2f}x < 3x ({r01_us:.3f} -> {r02_us:.3f} us/req)"
+        )
+        print(f"total-path vs r01 (node x1, batch): {total_vs_r01:.2f}x "
+              f"({r01_us:.3f} -> {r02_us:.3f} us/req)", file=sys.stderr)
+
+    pb = host_leg["phases_batch_us_per_request"]
     out = {
         "metric": "bench_frontend",
         "git_revision": git_revision(),
@@ -281,24 +448,36 @@ def main():
             "methodology": (
                 "mocked _dispatch (canned read-only logits), cache "
                 "disabled, flushes deferred past the timed submit phase "
-                "(drain reported as total), interleaved scalar/batch "
-                "repeats, best-of-repeats per path"
+                "(submit→drained reported as total, results_many timed "
+                "separately as deliver), interleaved scalar/batch "
+                "repeats, best-of-repeats per path; resolve bit-parity "
+                "(logits + dispatch logs + cache + journal) asserted "
+                "in-run against a _scalar_resolve twin on node and "
+                "temporal traffic"
             ),
         },
         "legs": legs,
         "host_submit_us": host_leg["batch_us_per_request"],
         "host_submit_us_scalar": host_leg["scalar_us_per_request"],
+        # drain wall per request (assemble+seal+mock-dispatch+resolve):
+        # what scaling.serve_table(host_resolve_us=) prices
+        "host_resolve_us": pb["drain_wall"],
+        "host_deliver_us": pb["deliver"],
         "best_batch_over_scalar": best_ratio,
+        "total_path_vs_r01": total_vs_r01,
         "asserts": {
             "batch_ge_scalar_all_legs": True,
+            "batch_total_ge_scalar_total_all_legs": True,
+            "resolve_bit_parity_node_and_temporal": True,
             "best_ratio_ge_10x": None if args.smoke else True,
+            "total_path_ge_3x_r01": None if args.smoke else True,
         },
     }
     path = args.out
     if path is None and not args.smoke:
         path = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "FRONTEND_r01.json",
+            "FRONTEND_r02.json",
         )
     if path:
         with open(path, "w") as fh:
@@ -306,7 +485,9 @@ def main():
             fh.write("\n")
         print(f"wrote {path}", file=sys.stderr)
     print(json.dumps({k: out[k] for k in
-                      ("host_submit_us", "best_batch_over_scalar")}))
+                      ("host_submit_us", "host_resolve_us",
+                       "host_deliver_us", "best_batch_over_scalar",
+                       "total_path_vs_r01")}))
 
 
 if __name__ == "__main__":

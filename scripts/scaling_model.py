@@ -104,14 +104,18 @@ def main():
                          "lp_head_s)")
     # round 20: host-side admission cost. serve_table caps every QPS row
     # at the serial submit-path rate 1e6/host_submit_us when > 0.
+    # round 22: FRONTEND_r02.json also carries host_resolve_us (the drain
+    # half); the cap becomes 1e6/(host_submit_us + host_resolve_us).
     ap.add_argument("--frontend", default=None,
                     help="host submit cost: a float (us/request) or a "
-                         "FRONTEND_r01.json path (reads host_submit_us, "
-                         "measured by scripts/bench_frontend.py)")
+                         "FRONTEND_r02.json path (reads host_submit_us and "
+                         "host_resolve_us, measured by "
+                         "scripts/bench_frontend.py)")
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
 
     host_submit_us = 0.0
+    host_resolve_us = 0.0
     host_submit_source = (
         "none (analytic: no host admission cap — pass --frontend)"
     )
@@ -123,10 +127,16 @@ def main():
             with open(args.frontend) as fh:
                 fr = json.load(fh)
             host_submit_us = float(fr["host_submit_us"])
+            host_resolve_us = float(fr.get("host_resolve_us", 0.0))
             host_submit_source = (
                 f"{args.frontend} host_submit_us (measured, "
                 "scripts/bench_frontend.py)"
             )
+            if host_resolve_us:
+                host_submit_source = (
+                    f"{args.frontend} host_submit_us+host_resolve_us "
+                    "(measured, scripts/bench_frontend.py)"
+                )
 
     step_s = (args.step_ms or 0) / 1e3
     source = f"--step-ms {args.step_ms}"
@@ -170,6 +180,7 @@ def main():
             args.lp_head_us = ctx["lp_head_s"] * 1e6
         if not host_submit_us and ctx.get("host_submit_us"):
             host_submit_us = float(ctx["host_submit_us"])
+            host_resolve_us = float(ctx.get("host_resolve_us", 0.0))
             host_submit_source = (
                 f"{args.bench} context host_submit_us (measured, "
                 "bench.py serve)"
@@ -235,6 +246,7 @@ def main():
             buckets=(64, 256, 1024), hit_rates=(0.0, 0.5, 0.9),
             unique_frac=0.8, max_delay_ms=2.0,
             host_submit_us=host_submit_us,
+            host_resolve_us=host_resolve_us,
         )
         serve_cost_note = (
             "Device cost per dispatch is the MEASURED eval-shaped split "
@@ -249,6 +261,7 @@ def main():
             step_s, 0.0, 0.0, ref_batch=1024, buckets=(64, 256, 1024),
             hit_rates=(0.0, 0.5, 0.9), unique_frac=0.8, max_delay_ms=2.0,
             host_submit_us=host_submit_us,
+            host_resolve_us=host_resolve_us,
         )
         serve_cost_note = (
             "Device cost per dispatch is the measured TRAIN step at batch "
@@ -310,6 +323,7 @@ def main():
             max_delay_ms=2.0, hosts=hosts, out_dim=args.serve_out_dim,
             bandwidths={"dcn_bytes_per_s": args.dcn_gbps * 1e9},
             host_submit_us=host_submit_us,
+            host_resolve_us=host_resolve_us,
         )
     serve_dist_md = (
         "## Distributed serving: predicted aggregate QPS vs host count "
@@ -603,6 +617,7 @@ def main():
         "serve_forward_s": serve_forward_s,
         "serve_overhead_s": serve_overhead_s,
         "host_submit_us": host_submit_us,
+        "host_resolve_us": host_resolve_us,
         "host_submit_source": host_submit_source,
         "rows": [r._asdict() for r in rows],
         "sharded_fetch": [r._asdict() for r in fetch_rows],

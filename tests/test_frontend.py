@@ -20,6 +20,16 @@ The contract under test, per docs/api.md "Batch submission & host path":
   counts overflow, and `request_breakdown()` still accounts for every
   request driven through `submit_many`;
 - `request_bursts()` flattens to the exact `events()` schedule.
+
+Round 22 vectorizes the OTHER half — resolve/cache-fill/journal/
+delivery — and pins it the same way (the "round 22" section at the
+bottom): block resolve vs the `_scalar_resolve=True` per-slot loop
+(rows, dispatch log, journal stream, cache contents + LRU order) at
+mif 1/2, hosts 1/2, temporal composite keys, and across a mid-drain
+`update_params` fence; `EmbeddingCache.put_many` == N in-order puts;
+`LatencyHistogram.record_ms_many` == N `record_ms`; the all-numpy
+vector admission path == the scalar loop; and `results_many` /
+`ResultBatch` delivery semantics.
 """
 
 import threading
@@ -495,3 +505,226 @@ def test_temporal_request_bursts_match_events():
         else:
             ref.append(("request", ev[1], int(ev[2]), float(ev[3])))
     assert flat == ref
+
+# -- round 22: vectorized resolve / delivery ----------------------------------
+#
+# The drain half's contract, per docs/api.md "Online serving": block
+# resolve (contiguous logits slicing + `put_many` cache fill +
+# `record_many` journal tail + per-flush slot publication) is
+# BIT-IDENTICAL to the pre-round-22 per-slot loop, which survives as
+# the `_scalar_resolve=True` escape hatch and is the reference twin in
+# every parity test below.
+
+from quiver_tpu.serve import EmbeddingCache
+from quiver_tpu.serve.engine import ResultBatch
+from quiver_tpu.trace import LatencyHistogram
+
+
+def _cache_state(c):
+    """Resident (key, version, value-bytes) in LRU order plus counter
+    movement — everything `put_many` could have perturbed."""
+    with c._lock:
+        items = [(k, v, val.tobytes()) for k, (v, val) in c._entries.items()]
+    return items, c.counters.evictions, c._tuple_keys
+
+
+def test_put_many_equals_scalar_puts():
+    """put_many == N in-order puts: resident entries, LRU order, AND
+    eviction counts — including the cap=1 A,B,A double-evict a deferred
+    trim would miss, and composite tuple keys."""
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 8, 40).tolist()          # repeats force re-inserts
+    vals = [rng.standard_normal(3).astype(np.float32) for _ in keys]
+    a, b = EmbeddingCache(capacity=5), EmbeddingCache(capacity=5)
+    for k, v in zip(keys, vals):
+        a.put(k, 1, v)
+    b.put_many(keys, 1, vals)
+    assert _cache_state(a) == _cache_state(b)
+    # cap=1, A,B,A: the middle insert evicts A, the last evicts B — two
+    # evictions, countable only with the eviction loop inside the pass
+    a1, b1 = EmbeddingCache(capacity=1), EmbeddingCache(capacity=1)
+    seq = [(0, vals[0]), (1, vals[1]), (0, vals[2])]
+    for k, v in seq:
+        a1.put(k, 2, v)
+    b1.put_many([k for k, _ in seq], 2, [v for _, v in seq])
+    assert a1.counters.evictions == b1.counters.evictions == 2
+    assert _cache_state(a1) == _cache_state(b1)
+    # composite (node, t_bucket) keys flip the tuple-key flag like put
+    ct = EmbeddingCache(capacity=4)
+    ct.put_many([(3, 1.0), (3, 2.0)], 1, vals[:2])
+    assert ct._tuple_keys and len(ct) == 2
+    # no-ops: capacity 0 and the empty batch
+    z = EmbeddingCache(0)
+    z.put_many([1], 1, vals[:1])
+    assert len(z) == 0
+    b.put_many([], 1, [])
+    assert _cache_state(a) == _cache_state(b)
+
+
+def test_record_ms_many_equals_scalar():
+    """The bulk histogram path (one searchsorted + bincount) lands every
+    sample in the bisect bucket: counts, count, min, max exact."""
+    rng = np.random.default_rng(3)
+    samples = np.concatenate([
+        rng.uniform(0.0, 5.0, 200),
+        np.array([0.0, 1e-3, 6e4, 7e4, 1e-9]),   # edges + overflow + under
+        np.asarray(rng.uniform(0.0, 10.0, 50), np.float32),  # f32 inputs
+    ])
+    h1, h2 = LatencyHistogram(), LatencyHistogram()
+    for s in samples:
+        h1.record_ms(float(s))
+    h2.record_ms_many(samples)
+    assert h1._counts == h2._counts
+    assert h1.count == h2.count
+    assert h1.min_ms == h2.min_ms and h1.max_ms == h2.max_ms
+    assert np.isclose(h1.sum_ms, h2.sum_ms, rtol=1e-12)
+    s1, s2 = h1.snapshot(), h2.snapshot()
+    assert all(s1[k] == s2[k] for k in s1 if k != "mean_ms")
+    h2.record_ms_many(np.array([]))              # empty batch is a no-op
+    assert h2.count == h1.count
+
+
+def _journal_stream(eng):
+    return [e[1:] for e in eng.journal.snapshot() if e[1] != "window_wait"]
+
+
+@pytest.mark.parametrize("mif", [1, 2])
+def test_block_resolve_bit_parity(setup, mif):
+    """Block resolve vs the `_scalar_resolve=True` per-slot loop: served
+    rows, dispatch log, journal event stream, cache contents AND LRU
+    order all bit-match — at in-flight windows 1 and 2."""
+    kw = dict(max_in_flight=mif, cache_entries=16, journal_events=8192)
+    a = make_engine(setup, **kw)
+    b = make_engine(setup, **kw)
+    b._scalar_resolve = True
+    trace = zipfian_trace(N_NODES, 64, alpha=0.9, seed=17)
+    tenants = [None if i % 2 else "T" for i in range(len(trace))]
+    ha = a.submit_many(trace, tenant=tenants)
+    hb = b.submit_many(trace, tenant=tenants)
+    drain(a)
+    drain(b)
+    assert rows_of(ha).tobytes() == rows_of(hb).tobytes()
+    assert_same_dispatch_log(a, b)
+    assert _journal_stream(a) == _journal_stream(b)
+    assert _cache_state(a.cache) == _cache_state(b.cache)
+    assert a.stats.cache.hits == b.stats.cache.hits
+    assert a.stats.requests == b.stats.requests
+
+
+@pytest.mark.parametrize("hosts", [1, 2])
+def test_dist_block_resolve_bit_parity(setup, hosts):
+    """The routed engine's block resolve (additionally fenced on
+    slot_errors) against its scalar twin, hosts 1 and 2."""
+    a = make_dist(setup, hosts=hosts, journal_events=8192)
+    b = make_dist(setup, hosts=hosts, journal_events=8192)
+    b._scalar_resolve = True
+    trace = zipfian_trace(N_NODES, 56, alpha=0.9, seed=19)
+    ha = a.submit_many(trace)
+    hb = b.submit_many(trace)
+    drain(a)
+    drain(b)
+    assert rows_of(ha).tobytes() == rows_of(hb).tobytes()
+    assert _journal_stream(a) == _journal_stream(b)
+    assert _cache_state(a.cache) == _cache_state(b.cache)
+    for h in range(hosts):
+        assert_same_dispatch_log(a.engines[h], b.engines[h])
+
+
+def test_temporal_block_resolve_bit_parity(tsetup):
+    """Temporal engines fill the cache under composite (node, t_bucket)
+    keys: the batched fill must reproduce the scalar fill's keys,
+    versions, and LRU order exactly."""
+    a = make_tengine(tsetup, cache_entries=32)
+    b = make_tengine(tsetup, cache_entries=32)
+    b._scalar_resolve = True
+    tr = temporal_trace(N_NODES, 40, seed=23, qps=50.0, t0=60.0)
+    ha = a.submit_many(tr.requests, t=tr.t_query)
+    hb = b.submit_many(tr.requests, t=tr.t_query)
+    drain(a)
+    drain(b)
+    assert rows_of(ha).tobytes() == rows_of(hb).tobytes()
+    assert_same_dispatch_log(a, b)
+    assert _cache_state(a.cache) == _cache_state(b.cache)
+
+
+def test_block_resolve_under_update_params_fence(setup):
+    """A mid-drain update_params: flushes resolved before the fence keep
+    old-version results, pending slots re-stamp to the new version, and
+    the block path does exactly what the scalar loop does on both sides
+    of the bump (the version fence is what makes slots[0] answer for
+    the whole flush)."""
+    model, params, _ = setup
+    kw = dict(cache_entries=32, journal_events=8192)
+    a = make_engine(setup, **kw)
+    b = make_engine(setup, **kw)
+    b._scalar_resolve = True
+    trace = zipfian_trace(N_NODES, 24, alpha=0.9, seed=29)
+    results = []
+    for eng in (a, b):
+        h = eng.submit_many(trace)
+        eng.flush()                   # first flush resolves pre-bump
+        eng.update_params(params)     # fence + cache invalidation + re-stamp
+        h2 = eng.submit_many(trace)   # post-bump traffic re-fills the cache
+        drain(eng)
+        results.append((rows_of(h), rows_of(h2)))
+    (ra, ra2), (rb, rb2) = results
+    assert ra.tobytes() == rb.tobytes() and ra2.tobytes() == rb2.tobytes()
+    assert _journal_stream(a) == _journal_stream(b)
+    assert _cache_state(a.cache) == _cache_state(b.cache)
+    assert a.params_version == b.params_version == 1
+    # every resident entry was computed under the post-bump version
+    assert all(v == 1 for _, (v, _) in a.cache._entries.items())
+
+
+def test_vector_admission_parity(setup):
+    """The all-numpy admission fast path (journal off, cache off, no
+    queue bound) admits EXACTLY what the scalar loop admits: same
+    dispatch log, same rows, same requests/coalesced counters — and the
+    fast path actually engaged (indexed ResultBatch)."""
+    kw = dict(cache_entries=0, max_batch=256)  # batch fits: no fill-flush
+    a = make_engine(setup, **kw)
+    b = make_engine(setup, **kw)
+    trace = zipfian_trace(N_NODES, 64, alpha=1.1, seed=31)  # heavy repeats
+    hb = a.submit_many(trace)
+    assert isinstance(hb, ResultBatch) and hb._inv is not None
+    ha = [b.submit(int(n)) for n in trace]
+    drain(a)
+    drain(b)
+    assert np.array_equal(a.results_many(hb), rows_of(ha))
+    assert_same_dispatch_log(a, b)
+    assert a.stats.requests == b.stats.requests == len(trace)
+    assert a.stats.coalesced == b.stats.coalesced > 0
+
+
+def test_results_many_and_resultbatch_semantics(setup):
+    """results_many == per-handle gather; lazy handles wrap on touch;
+    done() flips only when every unique resolves; errors raise in
+    REQUEST order; the empty batch stays empty."""
+    eng = make_engine(setup, cache_entries=0)
+    ids = np.array([5, 3, 5, 7, 3, 5], np.int64)    # duplicates coalesce
+    batch = eng.submit_many(ids)
+    assert isinstance(batch, ResultBatch) and len(batch) == len(ids)
+    assert not batch.done()
+    drain(eng)
+    assert batch.done()
+    out = eng.results_many(batch)
+    ref = rows_of(list(batch))                       # per-handle path
+    assert out.shape == (len(ids), 5)
+    assert np.array_equal(out, ref)
+    # duplicate requests deliver the identical row
+    assert np.array_equal(out[0], out[2]) and np.array_equal(out[0], out[5])
+    # a plain list of handles works too (mixed engines / hand-collected)
+    assert np.array_equal(eng.results_many(list(batch)), out)
+    # empty batch: zero rows, and == [] keeps the round-20 contract
+    empty = eng.submit_many([])
+    assert empty == [] and eng.results_many(empty).shape[0] == 0
+    # errors surface in request order through gather()
+    shed = make_engine(setup, max_batch=4, max_queue_depth=4, cache_entries=0)
+    real_flush = shed.flush
+    shed.flush = lambda: 0        # let the queue hit the depth bound
+    hs = shed.submit_many(np.arange(6))
+    shed.flush = real_flush
+    drain(shed)                   # admitted requests resolve; 4 and 5 shed
+    assert isinstance(hs[4].error(), ShedError)
+    with pytest.raises(ShedError):
+        hs.gather(timeout=5)
