@@ -510,6 +510,10 @@ class ServePrediction(NamedTuple):
     # -- drain-side host field (round 22; default 0 keeps round-20 rows
     # byte-identical: the cap reduces to 1e6/host_submit_us) --
     host_resolve_us: float = 0.0   # measured drain (assemble→resolve)/request
+    # -- routed fan-out fields (round 23; default 0 = collective pricing,
+    # rows byte-identical to the round-22 model) --
+    owner_fanout: int = 0          # host-mode legs running concurrently (F)
+    leg_merge_us: float = 0.0      # per-flush join/merge host cost (us)
 
 
 def serve_table(
@@ -528,6 +532,8 @@ def serve_table(
     dispatch_overhead_s: float = 0.0,
     host_submit_us: float = 0.0,
     host_resolve_us: float = 0.0,
+    owner_fanout: Optional[int] = None,
+    leg_merge_us: float = 0.0,
 ) -> List[ServePrediction]:
     """Analytic QPS model for the online serving engine
     (`quiver_tpu.serve.ServeEngine`) from MEASURED per-batch costs.
@@ -605,6 +611,19 @@ def serve_table(
     the same serial admission/drain path, so the cap becomes
     ``1e6 / (host_submit_us + host_resolve_us)``; the default 0 keeps
     every row byte-identical to the round-20 model.
+
+    ``owner_fanout`` (round 23) prices the HOST-mode router instead of
+    the collective: direct owner legs over loopback (no DCN collective
+    payload — exchange bytes drop to zero) with ``F = owner_fanout``
+    legs running concurrently, so the routed dispatch term is
+    ``ceil(H / F) * t_dispatch + leg_merge_us`` — ``F=1`` is the
+    pre-round-23 SEQUENTIAL router (the implicit Σ(legs) =
+    ``H * t_dispatch`` this model silently assumed away), ``F >= H``
+    the concurrent fan-out's max(legs) + merge. ``leg_merge_us`` is the
+    measured per-FLUSH join/merge host cost (FRONTEND_r03.json's
+    ``leg_merge_us``; via ``scripts/scaling_model.py --frontend``). The
+    default ``owner_fanout=None`` keeps every row byte-identical to
+    the round-22 collective pricing.
     """
     bw = dict(DEFAULT_BANDWIDTHS)
     if bandwidths:
@@ -620,16 +639,26 @@ def serve_table(
         t_dispatch = (
             per_seed * shard_b + dispatches_per_flush * dispatch_overhead_s
         )
-        if hosts > 1:
+        if owner_fanout is not None and hosts > 1:
+            # host-mode routed dispatch (round 23): F legs at a time,
+            # direct owner calls — no collective payload to price
+            fan = max(1, int(owner_fanout))
+            xbytes = 0.0
+            x_s = 0.0
+            t_routed = (
+                -(-hosts // fan) * t_dispatch + leg_merge_us * 1e-6
+            )
+        elif hosts > 1:
             from ..comm import round_up_pow2
 
             lanes = round_up_pow2(b)  # the engine's default static budget
             xbytes = hosts * hosts * lanes * (4 + 4 * out_dim)
             x_s = xbytes / bw["dcn_bytes_per_s"]
+            t_routed = t_dispatch + x_s
         else:
             xbytes = 0.0
             x_s = 0.0
-        t_routed = t_dispatch + x_s
+            t_routed = t_dispatch + x_s
         host_us = host_submit_us + host_resolve_us
         host_cap = 1e6 / host_us if host_us > 0 else math.inf
         for h in hit_rates:
@@ -657,6 +686,15 @@ def serve_table(
                     host_submit_us=host_submit_us,
                     host_qps_cap=host_cap,
                     host_resolve_us=host_resolve_us,
+                    owner_fanout=(
+                        0 if owner_fanout is None or hosts <= 1
+                        else max(1, int(owner_fanout))
+                    ),
+                    leg_merge_us=(
+                        leg_merge_us
+                        if owner_fanout is not None and hosts > 1
+                        else 0.0
+                    ),
                 )
             )
     return rows
@@ -699,6 +737,17 @@ def format_serve_markdown(rows: Sequence[ServePrediction]) -> str:
             "back over DCN (comm.exchange_serve payloads). Measured "
             "counterpart: scripts/serve_probe.py --hosts."
         )
+        fanned = [r for r in rows if getattr(r, "owner_fanout", 0) > 0]
+        if fanned:
+            f0 = fanned[0]
+            lines.append(
+                f"Host-mode routed dispatch (round 23): legs priced at "
+                f"ceil(H/{f0.owner_fanout}) shard dispatches + "
+                f"{f0.leg_merge_us:.2f} us join/merge per flush, no "
+                "collective payload — owner_fanout=1 is the sequential "
+                "router's Σ(legs); fan-out >= H is max(legs) + merge "
+                "(scripts/bench_frontend.py --r03, FRONTEND_r03.json)."
+            )
     else:
         lines.append(
             "QPS = bucket / ((1-hit)*unique_frac) / dispatch_s — device-bound "

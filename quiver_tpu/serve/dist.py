@@ -804,6 +804,26 @@ class DistServeConfig:
     # right after the fence, False leaves replication off until the
     # next manual/drift refresh.
     stream_replica_rebuild: bool = True
+    # -- round-23 concurrent owner fan-out (docs/api.md "Concurrent owner
+    # fan-out") ------------------------------------------------------------
+    # sequential_legs: run host-mode dispatch legs one after another on
+    # the flushing thread — the pre-round-23 router, kept verbatim as
+    # the bit-parity twin of the concurrent fan-out (exactly like
+    # `_scalar_resolve`). False = fan the legs out on per-flush worker
+    # threads (owner `predict` blocks in XLA with the GIL released, so
+    # the overlap is real even on one core) and JOIN IN SPLIT ORDER,
+    # applying every leg's side effects at join — logits, dispatch
+    # logs, `hedge_events()`, owner health, and the journal stay
+    # bit-identical to the sequential pass; only wall time changes
+    # (max(legs) + merge instead of sum(legs)). Collective mode is
+    # untouched either way: one launch under the collective lock —
+    # concurrent collective launches deadlock XLA's rendezvous.
+    sequential_legs: bool = False
+    # leg_fanout: bound on CONCURRENTLY RUNNING legs per routed flush
+    # (0 = all at once). Legs start in split order and join in split
+    # order regardless, so the bound changes scheduling, never results
+    # — leg_fanout=1 is the sequential pass on a worker thread.
+    leg_fanout: int = 0
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -993,6 +1013,48 @@ class _RoutedFlush:
         # the temporal router's query-time vector); None on the plain
         # router
         self.extra = None
+
+
+class _LegRun:
+    """One host-mode dispatch leg in flight (round 23). The worker half
+    fills ``box`` only — {"rows", "err", "dt"}; never ``out``, never
+    stats — so an abandoned (timed-out) worker can finish whenever it
+    likes without touching anything the joiner already settled. The
+    joiner half applies every side effect in split order."""
+
+    __slots__ = ("h", "ids", "pos", "tenants", "ejected", "thread",
+                 "t_start", "box")
+
+    def __init__(self, h, ids, pos, tenants):
+        self.h = h
+        self.ids = ids
+        self.pos = pos
+        self.tenants = tenants
+        self.ejected = False
+        self.thread: Optional[threading.Thread] = None
+        self.t_start = 0.0
+        self.box: Dict[str, object] = {}
+
+
+def _bounded_leg_schedule(runs, cap, start_leg):
+    """Start fan-out legs STRICTLY IN SPLIT ORDER with at most ``cap``
+    running at once, yielding each run in order for its join — the
+    joiner runs between yields, so starts interleave with joins and the
+    pipeline stays full up to the bound. ``start_leg(run)`` returns
+    True when it spawned a thread (ejected/wedged legs never spawn and
+    never count). The bound changes scheduling, never results: joins
+    happen in split order regardless."""
+    started = 0
+    active = 0
+    for r in runs:
+        while started < len(runs) and active < cap:
+            nxt = runs[started]
+            started += 1
+            if start_leg(nxt):
+                active += 1
+        yield r
+        if r.thread is not None:
+            active -= 1
 
 
 class _HotReplica:
@@ -1810,19 +1872,30 @@ class DistServeEngine:
         owner failure lands in ``fl.slot_errors`` (that sub-batch's slots
         only), never in ``fl.error``. Replica legs (host `REPLICA_HOST`)
         are answered locally in BOTH modes and never touch the
-        exchange."""
+        exchange.
+
+        Round 23: host-mode legs (replica included) FAN OUT onto
+        per-flush worker threads and join in split order, so a routed
+        flush's wall is max(leg latencies) + merge instead of their sum
+        — `sequential_legs=True` keeps the sequential pass as the
+        bit-parity twin, and a single-leg flush short-circuits to it
+        (one leg has nothing to overlap, so no thread is spawned).
+        Collective mode stays one launch either way."""
         # a = bucket per the EVENT_KINDS vocabulary; the router's "bucket"
         # is its admission cap (it pads nothing)
         self.journal.emit("dispatch", -1, fl.fid, fl.bucket)
         wl = self.workload
         out = np.zeros((len(fl.keys), self.out_dim), np.float32)
         owner_split = []
+        replica_split = []
         for h, ids, pos in fl.split:
             if h == REPLICA_HOST:
-                self._replica_leg(fl, ids, pos, out)
+                replica_split.append((h, ids, pos))
             else:
                 owner_split.append((h, ids, pos))
         if self.exchange_mode == "collective":
+            for _h, ids, pos in replica_split:
+                self._replica_leg(fl, ids, pos, out)
             by_host = {h: (ids, pos) for h, ids, pos in owner_split}
             if by_host:  # an all-replica flush skips the collective whole
                 host2ids = [
@@ -1880,9 +1953,13 @@ class DistServeEngine:
                 # and a past ejection never latches in collective mode
                 for h, _, _ in owner_split:
                     self._owner_ok(h)
-        else:
+        elif self.config.sequential_legs or len(fl.split) <= 1:
+            for _h, ids, pos in replica_split:
+                self._replica_leg(fl, ids, pos, out)
             for h, ids, pos in owner_split:
                 self._owner_leg(fl, h, ids, pos, out)
+        else:
+            self._fanout_legs(fl, replica_split + owner_split, out)
         out.setflags(write=False)
         # one routed round-trip = one "execute" at the router grain
         self.journal.emit("execute_done", -1, fl.fid, len(fl.split))
@@ -1920,12 +1997,15 @@ class DistServeEngine:
             )
         except BaseException as exc:
             self._failover(fl, REPLICA_HOST, ids, pos, out, "error", exc)
+            self.journal.emit("leg_done", -1, fl.fid, REPLICA_HOST,
+                              len(ids))
             return
         if wl is not None:
             wl.observe_flush(REPLICA_HOST, len(ids), self._clock() - t0)
         out[pos] = rows
         with self._lock:
             self.stats.replica_hits += len(ids)
+        self.journal.emit("leg_done", -1, fl.fid, REPLICA_HOST, len(ids))
 
     def _owner_leg(self, fl: _RoutedFlush, h: int, ids, pos, out) -> None:
         """One host-mode owner sub-batch: fault-injection hook, optional
@@ -1968,12 +2048,14 @@ class DistServeEngine:
             except BaseException as exc:
                 err = exc
             if wl is not None:
-                # host mode calls owners sequentially, so each owner's
-                # leg is individually timed — TRUE per-owner straggler
-                # evidence. A timed-out leg is CENSORED at the deadline
-                # (the owner did NOT answer in the measured wall; the
-                # wedged-owner fast path would otherwise record ~0 ms
-                # and rank the slowest owner fastest)
+                # each leg individually timed — TRUE per-owner straggler
+                # evidence (the fan-out path times INSIDE the leg body
+                # for the same reason, so the evidence survives
+                # concurrency — round 23). A timed-out leg is CENSORED
+                # at the deadline (the owner did NOT answer in the
+                # measured wall; the wedged-owner fast path would
+                # otherwise record ~0 ms and rank the slowest owner
+                # fastest)
                 dt = self._clock() - t0
                 if timed_out:
                     dt = max(dt, deadline_s)
@@ -1981,12 +2063,14 @@ class DistServeEngine:
         if rows is not None and err is None:
             self._owner_ok(h)
             out[pos] = rows
+            self.journal.emit("leg_done", -1, fl.fid, h, len(ids))
             return
         if not ejected:
             self._owner_failed(h, fl.fid)
         reason = ("ejected" if ejected
                   else "timeout" if timed_out else "error")
         self._failover(fl, h, ids, pos, out, reason, err)
+        self.journal.emit("leg_done", -1, fl.fid, h, len(ids))
 
     def _call_with_deadline(self, h: int, ids, deadline_s: float,
                             fid: int, tenants: Optional[List[str]] = None):
@@ -2027,6 +2111,172 @@ class DistServeEngine:
         if "err" in box:
             raise box["err"]
         return box["rows"], False
+
+    # -- round-23 concurrent fan-out: max(legs) + merge --------------------
+
+    def _fanout_legs(self, fl: _RoutedFlush, split, out) -> None:
+        """Run host-mode dispatch legs CONCURRENTLY and join them in
+        split order, so a routed flush's wall is max(leg latencies) +
+        merge instead of the sequential pass's sum — owner ``predict``
+        blocks in XLA with the GIL released (and the fault hook's stall
+        sleeps release it too), so the overlap is real even on one
+        core.
+
+        Determinism contract (the bit-parity twin is
+        ``sequential_legs=True``; docs/api.md "Concurrent owner
+        fan-out" tabulates it): leg workers fill ONLY their private
+        `_LegRun.box`, and the joiner applies every side effect in
+        fl.split order — replica leg first, owners ascending, exactly
+        the sequential order: workload `observe_flush` sample (the
+        leg's own internal duration, censored at the deadline),
+        health/ejection transition, ``out[pos]`` rows, failover
+        re-route (failover predicts are thereby serialized in
+        deterministic order on the joining thread — one key stream on
+        the fallback/replica engines), hedge log + stats, journal tail.
+        So logits, dispatch logs, `hedge_events()`, owner health, and
+        the journal are bit-identical to the sequential pass.
+
+        A ``hedge_deadline_ms`` deadline becomes a BOUNDED JOIN on the
+        leg's thread (`_call_with_deadline` folded into the fan-out):
+        timeout abandons the worker into ``_abandoned_legs`` and
+        hedges; while any abandoned leg to an owner is alive, further
+        legs to it are born timed out instead of spawning — the
+        wedged-owner fast path, decided HERE in split order before any
+        leg starts. The ejection honor decision is prechecked the same
+        way; both are bit-equivalent to the sequential pass deciding at
+        leg start because each owner appears at most once per split, so
+        no leg's health transition can change another leg's decision
+        within one flush."""
+        deadline_s = self.config.hedge_deadline_ms / 1e3
+        runs = []
+        for h, ids, pos in split:
+            r = _LegRun(h, ids, pos, self._leg_tenants(fl, pos))
+            if h != REPLICA_HOST:
+                r.ejected = (self._has_failover(h, ids)
+                             and self._owner_ejected(h, fl.fid))
+                if not r.ejected and deadline_s > 0:
+                    with self._lock:
+                        legs = self._abandoned_legs.get(h, [])
+                        legs[:] = [t for t in legs if t.is_alive()]
+                        if legs:
+                            r.box["wedged"] = True
+            runs.append(r)
+        cap = (self.config.leg_fanout if self.config.leg_fanout > 0
+               else len(runs))
+
+        def start_leg(r: _LegRun) -> bool:
+            if r.ejected or r.box:  # ejected / wedged: never spawns
+                return False
+            r.t_start = self._clock()
+            r.thread = threading.Thread(
+                target=self._leg_body, args=(fl, r), daemon=True,
+                name=f"quiver-owner-leg-{r.h}",
+            )
+            r.thread.start()
+            return True
+
+        for r in _bounded_leg_schedule(runs, cap, start_leg):
+            self._join_leg(fl, r, deadline_s, out)
+
+    def _leg_body(self, fl: _RoutedFlush, r: _LegRun) -> None:
+        """A fan-out leg's WORKER half: fault hook + predict into the
+        leg's private box. Deliberately effect-free — no stats, no
+        journal, no ``out`` writes — so an abandoned (timed-out) worker
+        finishing late touches nothing the joiner already settled (the
+        `_call_with_deadline` abandonment contract, kept)."""
+        box = r.box
+        t0 = self._clock()
+        try:
+            engine = (self.replica.engine if r.h == REPLICA_HOST
+                      else self.engines[r.h])
+            if r.h != REPLICA_HOST and self.faults is not None:
+                # the fault hook fires INSIDE the leg at the same
+                # (owner, dispatch-index) point as the sequential pass
+                self.faults.check(r.h, fl.fid)
+            box["rows"] = np.asarray(
+                self._predict_leg(engine, r.ids, r.tenants)
+            )
+        except BaseException as exc:
+            box["err"] = exc
+        finally:
+            # leg-INTERNAL duration: true per-owner straggler evidence
+            # even though legs overlap (the round-23 fix for the
+            # sequential-timing caveat `_owner_leg` documents)
+            box["dt"] = self._clock() - t0
+
+    def _join_leg(self, fl: _RoutedFlush, r: _LegRun, deadline_s: float,
+                  out) -> None:
+        """A fan-out leg's JOINER half, run in split order on the
+        flushing thread: bounded join (the hedge deadline), then apply
+        the leg's side effects exactly as the sequential pass would."""
+        wl = self.workload
+        h, ids, pos, box = r.h, r.ids, r.pos, r.box
+        if h == REPLICA_HOST:
+            r.thread.join()
+            err = box.get("err")
+            if err is not None:
+                self._failover(fl, REPLICA_HOST, ids, pos, out, "error",
+                               err)
+            else:
+                if wl is not None:
+                    wl.observe_flush(REPLICA_HOST, len(ids), box["dt"])
+                out[pos] = box["rows"]
+                with self._lock:
+                    self.stats.replica_hits += len(ids)
+            self.journal.emit("leg_done", -1, fl.fid, h, len(ids))
+            return
+        rows, err, timed_out = None, None, False
+        if not r.ejected:
+            if r.thread is not None:
+                if deadline_s > 0:
+                    r.thread.join(
+                        max(r.t_start + deadline_s - self._clock(), 0.0)
+                    )
+                    if r.thread.is_alive():
+                        with self._lock:
+                            self._abandoned_legs.setdefault(
+                                h, []).append(r.thread)
+                        timed_out = True
+                else:
+                    r.thread.join()
+            if box.get("wedged"):
+                timed_out = True
+            if not timed_out:
+                if "err" in box:
+                    err = box["err"]
+                else:
+                    rows = box.get("rows")
+            if timed_out:
+                err = OwnerTimeout(
+                    f"owner {h} missed the "
+                    f"{self.config.hedge_deadline_ms} ms hedge "
+                    f"deadline at dispatch index {fl.fid}"
+                )
+            if wl is not None:
+                # the leg's OWN duration (never the join wait), censored
+                # at the deadline when it missed it — a wedged leg never
+                # ran, so it records the deadline, like the sequential
+                # fast path
+                if "dt" in box:
+                    dt = box["dt"]
+                elif r.thread is not None:
+                    dt = self._clock() - r.t_start
+                else:
+                    dt = 0.0
+                if timed_out:
+                    dt = max(dt, deadline_s)
+                wl.observe_flush(h, len(ids), dt)
+        if rows is not None and err is None:
+            self._owner_ok(h)
+            out[pos] = rows
+            self.journal.emit("leg_done", -1, fl.fid, h, len(ids))
+            return
+        if not r.ejected:
+            self._owner_failed(h, fl.fid)
+        reason = ("ejected" if r.ejected
+                  else "timeout" if timed_out else "error")
+        self._failover(fl, h, ids, pos, out, reason, err)
+        self.journal.emit("leg_done", -1, fl.fid, h, len(ids))
 
     def _pick_failover(self, h: int, ids
                        ) -> Tuple[Optional[ServeEngine], str]:

@@ -704,6 +704,23 @@ class ServeConfig:
     # (one sealed-program rebuild via BucketPrograms.reprovision) and
     # retries once. 0 = capacity stays a planned hard error (r17).
     stream_provision_tiles: int = 0
+    # round-23 wall-clock TTL daemon (the round-21 leftover): >0 = a
+    # timer thread runs `expire_edges` every this-many seconds BETWEEN
+    # commits, so a quiet stream's sliding window keeps expiring without
+    # waiting for the next delta. Each pass is exactly a manual
+    # `expire_edges` call — same update_graph fence, same version bumps,
+    # same closure-exact cache invalidation. Off by default; start()
+    # leaves it off unless retention is configured on a temporal
+    # stream-bound sampler.
+    stream_retention_every_s: float = 0.0
+    # injectable wall-clock -> event-time map for the daemon: each pass
+    # expires at ``cutoff_for(stream_retention_clock())``. None (the
+    # default) keeps the deterministic commit-driven retention clock
+    # where the last commit left it — a daemon pass then only re-applies
+    # the last commit's cutoff (a catch-up, usually a no-op). Tests
+    # inject a deterministic sequence here; production maps wall time to
+    # stream event time.
+    stream_retention_clock: Optional[Callable[[], float]] = None
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -1537,6 +1554,8 @@ class ServeEngine:
         self.placement_version = 0
         self.tier_adapt_errors = 0  # failed background adapt passes
         self.compact_errors = 0     # failed background compaction passes
+        self.retention_errors = 0   # failed wall-clock TTL passes (r23)
+        self.retention_passes = 0   # completed wall-clock TTL passes
         # round-18 flush-ahead prefetch: bind the tier store's staging
         # buffer when the config asks for it AND the feature can serve it
         # (adaptive store + read pool); inert otherwise — a prefetch-on
@@ -2424,6 +2443,12 @@ class ServeEngine:
         reg.gauge_fn(f"{prefix}_compact_errors",
                      lambda: self.compact_errors,
                      "failed background compaction passes", labels)
+        reg.gauge_fn(f"{prefix}_retention_errors",
+                     lambda: self.retention_errors,
+                     "failed wall-clock TTL retention passes", labels)
+        reg.gauge_fn(f"{prefix}_retention_passes",
+                     lambda: self.retention_passes,
+                     "completed wall-clock TTL retention passes", labels)
         reg.gauge_fn(
             f"{prefix}_tier_prefetch_hit_rate",
             lambda: (self.stats.tier_prefetch_hit
@@ -3052,6 +3077,36 @@ class ServeEngine:
             except Exception:
                 self.compact_errors += 1
 
+    def _retention_loop(self) -> None:
+        """The round-23 wall-clock TTL daemon body: on a
+        ``stream_retention_every_s`` timer, run one `expire_edges` pass
+        — the fenced round-21 entry point, so a daemon pass IS a manual
+        expiry call (fenced like update_graph; deterministic given the
+        injected clock's readings, which is what the deterministic-clock
+        test replays). A failing pass counts in ``retention_errors`` —
+        never fatal to serving (the `_compact_loop` discipline)."""
+        while self._running:
+            time.sleep(self.config.stream_retention_every_s)
+            if not self._running:
+                return
+            try:
+                self._retention_pass()
+            except Exception:
+                self.retention_errors += 1
+
+    def _retention_pass(self) -> Dict[str, object]:
+        """One daemon pass, callable directly (tests drive it with a
+        deterministic clock instead of sleeping): advance event time to
+        ``stream_retention_clock()`` when a clock is configured (None =
+        re-check the commit-driven retention clock's standing cutoff)
+        and expire behind the fence."""
+        clk = self.config.stream_retention_clock
+        exp = self.expire_edges(
+            t_commit=clk() if clk is not None else None
+        )
+        self.retention_passes += 1
+        return exp
+
     # -- adaptive tier placement (round 14) --------------------------------
 
     def apply_placement(self, plan) -> Dict[str, object]:
@@ -3224,6 +3279,22 @@ class ServeEngine:
                 threading.Thread(
                     target=self._compact_loop,
                     name="quiver-serve-compactor",
+                    daemon=True,
+                )
+            )
+        if (
+            self.config.stream_retention_every_s > 0
+            and self.retention is not None
+            and getattr(self._sampler, "stream", None) is not None
+            and getattr(self._sampler.stream, "temporal", False)
+        ):
+            # the round-23 wall-clock TTL daemon: keeps a QUIET temporal
+            # stream's sliding window expiring between commits (see
+            # _retention_loop); fenced like update_graph, off by default
+            self._threads.append(
+                threading.Thread(
+                    target=self._retention_loop,
+                    name="quiver-serve-retention",
                     daemon=True,
                 )
             )

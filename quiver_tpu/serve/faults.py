@@ -98,6 +98,16 @@ class FaultInjector:
     flushes may append out of dispatch order). Keyed purely by
     (owner, dispatch index): no wall time, no randomness at check time,
     so a replayed run fires identically.
+
+    Round 23: the router fans owner legs out onto per-flush worker
+    threads, so `check` now fires CONCURRENTLY across the legs of one
+    flush — at exactly the same (owner, fid) points as the sequential
+    pass (each leg carries its own hook; the plan lookup is read-only
+    and log appends are locked). Only the raw ``log`` APPEND ORDER can
+    differ between the two schedulers; `events()` is the comparison
+    view either way, and a "stall" sleep on a leg thread releases the
+    GIL — a stalled owner overlaps the other legs instead of stalling
+    the flush, which is what the fan-out exists to buy.
     """
 
     def __init__(self, faults: Sequence[FaultSpec] = ()):

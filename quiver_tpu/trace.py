@@ -566,6 +566,14 @@ EVENT_KINDS = (
     "shed",          # -,   -, a=node            refused at tenant admission
     "hedge",         # -, fid, a=owner           sub-batch re-routed to a target
     "eject",         # -, fid, a=owner           owner entered backoff
+    # round-23 concurrent owner fan-out (policy marker like the three
+    # above — the flush fold ignores it): one event per HOST-MODE
+    # dispatch leg at its JOIN, emitted in split order by both the
+    # fan-out and the `sequential_legs=True` parity twin, so the journal
+    # streams stay bit-comparable across the two schedulers. a is the
+    # owner host (REPLICA_HOST = -2 for the replica leg), b the
+    # sub-batch width.
+    "leg_done",      # -, fid, a=owner, b=seeds   dispatch leg joined/applied
     # round-16 migration journal (policy markers like the three above;
     # fid carries the MIGRATION batch index, not a flush id — the fold
     # below ignores these kinds entirely, so the collision is harmless)
@@ -614,7 +622,7 @@ def _fold_flush_events(events) -> Dict[int, Dict[str, float]]:
     for (t, kind, rid, fid, a, b) in events:
         if fid < 0 or kind in (
             "submit", "cache_hit", "coalesce", "late_admit", "assemble",
-            "shed", "hedge", "eject",
+            "shed", "hedge", "eject", "leg_done",
             "migrate", "migrate_commit", "migrate_rollback",
             "graph_delta", "delta_commit",
             "prefetch_issue", "prefetch_hit",

@@ -52,11 +52,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..comm import TpuComm
+import threading
+
 from ..serve.dist import (
     ClosureFeature,
     DistServeConfig,
     DistServeEngine,
+    _LegRun,
     _RoutedFlush,
+    _bounded_leg_schedule,
     closure_masks,
     contiguous_partition,
     shard_from_mask,
@@ -678,7 +682,7 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
                     )
                 for h, (ids, pos) in by_host.items():
                     out[pos] = res[h]
-        else:
+        elif self.config.sequential_legs or len(fl.split) <= 1:
             for h, ids, pos in fl.split:
                 t0 = self._clock()
                 rows = np.asarray(
@@ -690,9 +694,65 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
                 if wl is not None:
                     wl.observe_flush(h, len(ids), self._clock() - t0)
                 out[pos] = rows
+                self.journal.emit("leg_done", -1, fl.fid, h, len(ids))
+        else:
+            self._fanout_temporal_legs(fl, tvec, out)
         out.setflags(write=False)
         self.journal.emit("execute_done", -1, fl.fid, len(fl.split))
         return out
+
+    def _fanout_temporal_legs(self, fl: _RoutedFlush, tvec, out) -> None:
+        """Round-23 fan-out for the PLAIN temporal legs: the base
+        router's start-in-order / join-in-split-order machinery
+        (`_bounded_leg_schedule`, honoring ``leg_fanout``), minus the
+        fleet policies temporal v1 doesn't have — no fault hook, no
+        deadline, no failover. A leg error still poisons the whole
+        flush, raised at ITS join so every earlier leg's effects land
+        exactly as the sequential pass's would; later legs may already
+        have run on their workers by then, but their effects are never
+        applied — the flush is poisoned either way, and temporal owner
+        engines are stateless per leg (predict-only), so the extra
+        worker-side work is observable only in wall time."""
+        wl = self.workload
+
+        def body(r: _LegRun) -> None:
+            box = r.box
+            t0 = self._clock()
+            try:
+                box["rows"] = np.asarray(
+                    self.engines[r.h].predict(
+                        r.ids, t=tvec[r.pos], tenants=r.tenants,
+                    )
+                )
+            except BaseException as exc:
+                box["err"] = exc
+            finally:
+                box["dt"] = self._clock() - t0
+
+        runs = [
+            _LegRun(h, ids, pos, self._leg_tenants(fl, pos))
+            for h, ids, pos in fl.split
+        ]
+        cap = (self.config.leg_fanout if self.config.leg_fanout > 0
+               else len(runs))
+
+        def start_leg(r: _LegRun) -> bool:
+            r.t_start = self._clock()
+            r.thread = threading.Thread(
+                target=body, args=(r,), daemon=True,
+                name=f"quiver-temporal-leg-{r.h}",
+            )
+            r.thread.start()
+            return True
+
+        for r in _bounded_leg_schedule(runs, cap, start_leg):
+            r.thread.join()
+            if "err" in r.box:
+                raise r.box["err"]
+            if wl is not None:
+                wl.observe_flush(r.h, len(r.ids), r.box["dt"])
+            out[r.pos] = r.box["rows"]
+            self.journal.emit("leg_done", -1, fl.fid, r.h, len(r.ids))
 
 
 # -- temporal replay oracles --------------------------------------------

@@ -218,6 +218,302 @@ def assert_resolve_parity(make_pair, ids, ts, label, journal_on):
     return ra
 
 
+# -- round-23 r03 leg: routed-dispatch wall, sequential vs fan-out -----------
+
+R03_MAX_BATCH = 64
+
+
+class StallOwner:
+    """Stall-shaped mocked owner for the r03 leg: ``predict`` sleeps
+    (`time.sleep` releases the GIL, the same shape as XLA's blocking
+    execute) then returns rows derived from the ids — distinct per id
+    and deterministic, so the parity asserts catch a row mis-mapping
+    between schedulers, not just a wholesale swap."""
+
+    def __init__(self, stall_s: float, out_dim: int):
+        self.stall_s = stall_s
+        self.out_dim = out_dim
+        self.calls = 0
+
+    def predict(self, ids, t=None, tenants=None):
+        self.calls += 1
+        if self.stall_s > 0:
+            time.sleep(self.stall_s)
+        ids = np.asarray(ids, np.int64).astype(np.float32)
+        cols = np.arange(self.out_dim, dtype=np.float32)
+        return ids[:, None] * 10.0 + cols[None, :]
+
+
+def _deep_eq(xa, xb) -> bool:
+    if isinstance(xa, np.ndarray) or isinstance(xb, np.ndarray):
+        return (isinstance(xa, np.ndarray) and isinstance(xb, np.ndarray)
+                and xa.dtype == xb.dtype and xa.shape == xb.shape
+                and xa.tobytes() == xb.tobytes())
+    if isinstance(xa, (list, tuple)):
+        return (isinstance(xb, (list, tuple)) and len(xa) == len(xb)
+                and all(_deep_eq(a, b) for a, b in zip(xa, xb)))
+    return xa == xb
+
+
+def _routed(hosts, temporal, stall_s, sequential, faults=None, **cfg_kw):
+    from quiver_tpu.serve import DistServeConfig, DistServeEngine
+    from quiver_tpu.workloads import TemporalDistServeEngine
+
+    g2h = (np.arange(N_NODES) % hosts).astype(np.int32)
+    owners = {h: StallOwner(stall_s, OUT_DIM) for h in range(hosts)}
+    base = dict(
+        hosts=hosts, max_batch=R03_MAX_BATCH, max_delay_ms=1e9,
+        max_in_flight=1, exchange="host", record_dispatches=True,
+        cache_entries=0, journal_events=1 << 15,
+        sequential_legs=sequential, fault_injector=faults,
+    )
+    base.update(cfg_kw)
+    cfg = DistServeConfig(**base)
+    if temporal:
+        return TemporalDistServeEngine(owners, g2h, OUT_DIM, config=cfg,
+                                       t_quantum=4.0)
+    return DistServeEngine(owners, g2h, OUT_DIM, config=cfg)
+
+
+def _drive_routed(eng, ids, ts):
+    """Time submit→drained: the trace is larger than ``max_batch`` so
+    fill-flushes dispatch inside ``submit_many`` too — with stall-shaped
+    owners the submit+drain wall IS the routed-dispatch wall (the host
+    bookkeeping share is priced by the r01/r02 legs and is microseconds
+    against the injected stalls)."""
+    t0 = time.perf_counter()
+    handles = (eng.submit_many(ids) if ts is None
+               else eng.submit_many(ids, t=ts))
+    while eng._drainable():
+        eng.flush()
+    wall = time.perf_counter() - t0
+    rows = eng.results_many(handles)
+    return wall, rows
+
+
+def _collect_routed(eng, ids, ts):
+    """Scalar-collect drive for the parity/fault legs: per-request
+    (row bytes | error string) outcomes — slot errors stay per-request
+    (the round-15 isolation contract), so a faulted run still yields a
+    complete, comparable outcome vector."""
+    handles = [
+        eng.submit(int(n)) if ts is None
+        else eng.submit(int(n), t=float(t))
+        for n, t in zip(ids, ts if ts is not None else ids)
+    ]
+    while eng._drainable():
+        eng.flush()
+    out = []
+    for h in handles:
+        try:
+            out.append(h.result(timeout=60).tobytes())
+        except Exception as exc:
+            out.append(f"{type(exc).__name__}: {exc}")
+    return out
+
+
+def _journal_stream(eng):
+    return [e[1:] for e in eng.journal.snapshot() if e[1] != "window_wait"]
+
+
+def _r03_parity(hosts, temporal, stall_s, ids, ts, label, fault_seed=None):
+    """Drive the same trace through a ``sequential_legs=True`` router and
+    the concurrent fan-out; require bit-identical per-request outcomes
+    (logits bytes / error strings), dispatch logs, journal streams,
+    owner-health state, hedge events — and, with a seeded `FaultSpec`
+    plan active, identical fault firings (`events()`, the sorted view —
+    the raw log's APPEND order is the one thing concurrency may
+    reorder)."""
+    from quiver_tpu.serve import FaultInjector
+
+    views = []
+    for sequential in (True, False):
+        inj = (FaultInjector.seeded(
+                   owners=range(hosts), n_faults=6, seed=fault_seed,
+                   fid_range=(1, 6), kinds=("error", "stall", "kill"),
+                   stall_s=stall_s,
+               ) if fault_seed is not None else None)
+        eng = _routed(hosts, temporal, stall_s, sequential, faults=inj)
+        out = _collect_routed(eng, ids, ts)
+        views.append({
+            "out": out,
+            "dispatch_log": eng.dispatch_log,
+            "journal": _journal_stream(eng),
+            "owner_health": eng.owner_health(),
+            "hedge_events": eng.hedge_events(),
+            "faults": inj.events() if inj is not None else None,
+        })
+    seq, fan = views
+    assert seq["out"] == fan["out"], (
+        f"{label}: per-request outcomes differ between sequential and "
+        f"fan-out legs"
+    )
+    assert _deep_eq(seq["dispatch_log"], fan["dispatch_log"]), (
+        f"{label}: dispatch logs differ"
+    )
+    assert seq["journal"] == fan["journal"], (
+        f"{label}: journal event streams differ"
+    )
+    assert seq["owner_health"] == fan["owner_health"], (
+        f"{label}: owner-health state differs"
+    )
+    assert seq["hedge_events"] == fan["hedge_events"], (
+        f"{label}: hedge events differ"
+    )
+    assert seq["faults"] == fan["faults"], (
+        f"{label}: fault firings differ"
+    )
+    return seq
+
+
+def run_r03(args) -> None:
+    """The round-23 routed-dispatch benchmark: H stall-shaped mocked
+    owners under the REAL router, sequential vs fan-out back to back
+    (interleaved repeats, best-of), bit-parity asserted in-run at hosts
+    2/4 on node and temporal traffic plus a seeded fault plan on node
+    traffic, and the r03 scaling keys (``owner_fanout`` /
+    ``leg_merge_us``) written to FRONTEND_r03.json."""
+    n = min(args.requests, N_NODES)
+    stall_s = 0.002 if args.smoke else 0.02
+    parity_stall_s = 0.001 if args.smoke else 0.005
+    if args.smoke:
+        n = min(n, 128)
+    rng = np.random.default_rng(SEED)
+    ids = rng.permutation(N_NODES)[:n].astype(np.int64)
+    ts = rng.uniform(60.0, 90.0, n).astype(np.float32)
+    n_flushes = -(-n // R03_MAX_BATCH)
+
+    # -- bit-parity: sequential twin vs fan-out, all surfaces ------------
+    parity_legs = []
+    for hosts in (2, 4):
+        for temporal in (False, True):
+            label = (f"r03/{'temporal' if temporal else 'node'}"
+                     f"/hosts{hosts}")
+            _r03_parity(hosts, temporal, parity_stall_s, ids,
+                        ts if temporal else None, label)
+            parity_legs.append(label)
+        flabel = f"r03/node/hosts{hosts}/faults"
+        _r03_parity(hosts, False, parity_stall_s, ids, None, flabel,
+                    fault_seed=23)
+        parity_legs.append(flabel)
+    print(f"r03 bit-parity: {len(parity_legs)} legs OK (outcomes + "
+          f"dispatch logs + journal + owner-health + hedge + fault "
+          f"events)", file=sys.stderr)
+
+    # -- routed-dispatch wall: sequential vs fan-out ---------------------
+    legs = []
+    speedups = {}
+    for hosts in (2, 4):
+        for temporal in (False, True):
+            name = "temporal" if temporal else "node"
+            best = {True: float("inf"), False: float("inf")}
+            for _ in range(args.repeats):
+                # interleave so machine drift hits both schedulers alike
+                for sequential in (True, False):
+                    eng = _routed(hosts, temporal, stall_s, sequential,
+                                  journal_events=0)
+                    wall, rows = _drive_routed(
+                        eng, ids, ts if temporal else None
+                    )
+                    assert rows.shape == (n, OUT_DIM)
+                    best[sequential] = min(best[sequential], wall)
+            speedup = round(best[True] / best[False], 2)
+            leg = {
+                "traffic": name,
+                "hosts": hosts,
+                "requests": n,
+                "flushes": n_flushes,
+                "owner_stall_ms": stall_s * 1e3,
+                "routed_wall_s_sequential": round(best[True], 6),
+                "routed_wall_s_fanout": round(best[False], 6),
+                "fanout_speedup": speedup,
+                "wall_per_flush_ms_sequential": round(
+                    best[True] / n_flushes * 1e3, 3
+                ),
+                "wall_per_flush_ms_fanout": round(
+                    best[False] / n_flushes * 1e3, 3
+                ),
+            }
+            legs.append(leg)
+            speedups[(name, hosts)] = speedup
+            print(
+                f"r03 {name} hosts={hosts}: sequential "
+                f"{best[True]*1e3:.1f} ms, fan-out {best[False]*1e3:.1f} "
+                f"ms over {n_flushes} flushes ({speedup:.2f}x)",
+                file=sys.stderr,
+            )
+
+    if not args.smoke:
+        for (name, hosts), s in speedups.items():
+            bar = 3.0 if hosts >= 4 else 1.7
+            assert s >= bar, (
+                f"r03 {name} hosts={hosts} fan-out speedup {s:.2f}x < "
+                f"{bar}x with stall-shaped owners"
+            )
+
+    # the r03 scaling keys: the headline hosts=4 node leg. merge =
+    # fan-out wall per flush minus one stall (the leg floor) — the
+    # join/apply host cost serve_table(leg_merge_us=) prices
+    head = next(l for l in legs if l["traffic"] == "node"
+                and l["hosts"] == 4)
+    leg_merge_us = max(
+        0.0,
+        round((head["routed_wall_s_fanout"] / n_flushes - stall_s) * 1e6,
+              3),
+    )
+    out = {
+        "metric": "bench_frontend_r03",
+        "git_revision": git_revision(),
+        "config": {
+            "n_nodes": N_NODES,
+            "requests": n,
+            "repeats": args.repeats,
+            "max_batch": R03_MAX_BATCH,
+            "owner_stall_ms": stall_s * 1e3,
+            "mocked_owners": True,
+            "smoke": bool(args.smoke),
+            "methodology": (
+                "real DistServeEngine/TemporalDistServeEngine routers "
+                "(exchange='host') over H stall-shaped mocked owners "
+                "(sleep-in-predict, GIL-releasing); drain wall timed as "
+                "the routed-dispatch wall; sequential_legs=True vs the "
+                "concurrent fan-out interleaved, best-of-repeats; "
+                "bit-parity (per-request outcomes + dispatch logs + "
+                "journal + owner-health + hedge + fault events) "
+                "asserted in-run at hosts 2/4 on node and temporal "
+                "traffic and under a seeded FaultSpec plan (node)"
+            ),
+        },
+        "legs": legs,
+        "parity_legs": parity_legs,
+        # the serve_table(owner_fanout=, leg_merge_us=) inputs
+        "owner_fanout": 4,
+        "leg_merge_us": leg_merge_us,
+        "routed_speedup_hosts4": speedups[("node", 4)],
+        "routed_speedup_hosts2": speedups[("node", 2)],
+        "asserts": {
+            "bit_parity_all_legs": True,
+            "speedup_ge_3x_hosts4": None if args.smoke else True,
+            "speedup_ge_1p7x_hosts2": None if args.smoke else True,
+        },
+    }
+    path = args.out
+    if path is None and not args.smoke:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "FRONTEND_r03.json",
+        )
+    if path:
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {path}", file=sys.stderr)
+    print(json.dumps({k: out[k] for k in
+                      ("owner_fanout", "leg_merge_us",
+                       "routed_speedup_hosts4",
+                       "routed_speedup_hosts2")}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=4000,
@@ -231,7 +527,16 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="tiny trace for CI: asserts batch >= scalar "
                          "(submit AND total) + resolve parity only")
+    ap.add_argument("--r03", action="store_true",
+                    help="run the round-23 routed-dispatch leg instead: "
+                         "sequential vs fan-out over stall-shaped mocked "
+                         "owners -> FRONTEND_r03.json")
     args = ap.parse_args()
+    if args.r03:
+        if args.requests == 4000:  # the r02 default is too long here
+            args.requests = 512
+        run_r03(args)
+        return
     if args.smoke:
         args.requests = min(args.requests, 600)
         args.repeats = min(args.repeats, 2)

@@ -106,36 +106,61 @@ def main():
     # at the serial submit-path rate 1e6/host_submit_us when > 0.
     # round 22: FRONTEND_r02.json also carries host_resolve_us (the drain
     # half); the cap becomes 1e6/(host_submit_us + host_resolve_us).
+    # round 23: FRONTEND_r03.json carries owner_fanout / leg_merge_us —
+    # the host-mode routed-dispatch pricing inputs (concurrent owner
+    # fan-out: max(legs) + merge instead of sum(legs)). --frontend takes
+    # a comma-separated list so r02 (admission/drain) and r03 (fan-out)
+    # artifacts can both feed one table.
     ap.add_argument("--frontend", default=None,
-                    help="host submit cost: a float (us/request) or a "
-                         "FRONTEND_r02.json path (reads host_submit_us and "
-                         "host_resolve_us, measured by "
+                    help="host submit cost: a float (us/request) or "
+                         "comma-separated FRONTEND_r0*.json paths — "
+                         "FRONTEND_r02.json contributes host_submit_us/"
+                         "host_resolve_us, FRONTEND_r03.json contributes "
+                         "owner_fanout/leg_merge_us (all measured by "
                          "scripts/bench_frontend.py)")
     ap.add_argument("--out", default=None, help="write a markdown table here")
     args = ap.parse_args()
 
     host_submit_us = 0.0
     host_resolve_us = 0.0
+    owner_fanout = None
+    leg_merge_us = 0.0
+    fanout_source = None
     host_submit_source = (
         "none (analytic: no host admission cap — pass --frontend)"
     )
     if args.frontend:
-        try:
-            host_submit_us = float(args.frontend)
-            host_submit_source = f"--frontend {host_submit_us}"
-        except ValueError:
-            with open(args.frontend) as fh:
+        for token in args.frontend.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            try:
+                host_submit_us = float(token)
+                host_submit_source = f"--frontend {host_submit_us}"
+                continue
+            except ValueError:
+                pass
+            with open(token) as fh:
                 fr = json.load(fh)
-            host_submit_us = float(fr["host_submit_us"])
-            host_resolve_us = float(fr.get("host_resolve_us", 0.0))
-            host_submit_source = (
-                f"{args.frontend} host_submit_us (measured, "
-                "scripts/bench_frontend.py)"
-            )
-            if host_resolve_us:
+            if "host_submit_us" in fr:
+                host_submit_us = float(fr["host_submit_us"])
+                host_resolve_us = float(fr.get("host_resolve_us", 0.0))
                 host_submit_source = (
-                    f"{args.frontend} host_submit_us+host_resolve_us "
-                    "(measured, scripts/bench_frontend.py)"
+                    f"{token} host_submit_us (measured, "
+                    "scripts/bench_frontend.py)"
+                )
+                if host_resolve_us:
+                    host_submit_source = (
+                        f"{token} host_submit_us+host_resolve_us "
+                        "(measured, scripts/bench_frontend.py)"
+                    )
+            # round-23 r03 keys: routed-dispatch fan-out pricing
+            if "owner_fanout" in fr:
+                owner_fanout = int(fr["owner_fanout"])
+                leg_merge_us = float(fr.get("leg_merge_us", 0.0))
+                fanout_source = (
+                    f"{token} owner_fanout/leg_merge_us (measured, "
+                    "scripts/bench_frontend.py --r03)"
                 )
 
     step_s = (args.step_ms or 0) / 1e3
@@ -339,6 +364,33 @@ def main():
         "core).\n\n"
         + format_serve_markdown(dist_rows)
     )
+    # round-23 host-mode fan-out rows: same cost inputs, routed dispatch
+    # priced at ceil(H/F) * leg + merge instead of the collective
+    # exchange — measured counterpart is bench_frontend.py --r03
+    dist_fanout_rows = []
+    if owner_fanout is not None:
+        for hosts in (int(h) for h in args.serve_hosts.split(",")):
+            dist_fanout_rows += serve_table(
+                serve_cost[0], 0.0, serve_cost[1], ref_batch=serve_cost[2],
+                buckets=(256,), hit_rates=(0.0, 0.5), unique_frac=0.8,
+                max_delay_ms=2.0, hosts=hosts, out_dim=args.serve_out_dim,
+                bandwidths={"dcn_bytes_per_s": args.dcn_gbps * 1e9},
+                host_submit_us=host_submit_us,
+                host_resolve_us=host_resolve_us,
+                owner_fanout=owner_fanout, leg_merge_us=leg_merge_us,
+            )
+        serve_dist_md += (
+            "\n\n### Host-mode concurrent owner fan-out (round 23)\n\n"
+            f"Fan-out inputs: {fanout_source} — routed dispatch priced "
+            f"at ceil(H/{owner_fanout}) legs\nplus a "
+            f"{leg_merge_us:.0f} us join/apply merge, zero exchange "
+            "bytes (direct owner legs on\nworker threads; "
+            "`DistServeEngine` exchange='host'). Measured counterpart:\n"
+            "scripts/bench_frontend.py --r03 -> FRONTEND_r03.json "
+            "(sequential-vs-fan-out wall\nwith stall-shaped owners, "
+            "bit-parity asserted in-run).\n\n"
+            + format_serve_markdown(dist_fanout_rows)
+        )
     # hot-shard replication table (round 13, ROADMAP item 3a): predicted
     # wire-side benefit of replicating the measured hot head on every
     # host, from the frequency sketch's head-concentration curve
@@ -625,6 +677,10 @@ def main():
         "serve": [r._asdict() for r in serve_rows],
         "serve_one_vs_two_dispatch": [r._asdict() for r in serve_dispatch_rows],
         "serve_dist": [r._asdict() for r in dist_rows],
+        "owner_fanout": owner_fanout,
+        "leg_merge_us": leg_merge_us,
+        "fanout_source": fanout_source,
+        "serve_dist_fanout": [r._asdict() for r in dist_fanout_rows],
         "skew_source": skew_source,
         "skew_replication": [r._asdict() for r in skew_rows],
         "delta_source": delta_source,

@@ -1,0 +1,461 @@
+"""Round-23 concurrent owner fan-out tests: the routed host-mode
+dispatch runs its owner legs on worker threads (wall = max(legs) + merge
+instead of Σ legs) and must stay BIT-IDENTICAL to the sequential pass,
+which survives as the ``sequential_legs=True`` parity twin.
+
+The acceptance contract (ISSUE 19 / docs/api.md "Concurrent owner
+fan-out"):
+
+- fan-out vs sequential bit-parity across max_in_flight 1/2 × hosts
+  1/2/4 × node and temporal traffic × faults on/off × hedge deadline
+  on/off: logits bytes, dispatch logs, journal event streams (the
+  "leg_done" policy marker included), owner-health state, hedge events
+  and fired faults all equal;
+- ``leg_fanout=1`` (one leg in flight at a time, still on worker
+  threads) is bit-equal to the thread-free sequential scheduler;
+- leg threads are JOINED per flush: the thread count stays flat across
+  100 flushes, and after ``stop(drain=True)`` no ``quiver-owner-leg-*``
+  thread survives;
+- a seeded owner-kill + ejection run replays bit-identically (faults
+  ride the dispatch index, never the leg interleaving);
+- per-owner latency telemetry stays truthful under fan-out: each leg is
+  timed INSIDE its body, so `OwnerLoadStats.straggler()` names a
+  stalled owner even while its stall overlaps the other legs;
+- the round-23 wall-clock TTL daemon (`stream_retention_every_s`)
+  expires a quiet temporal stream deterministically under an injected
+  clock, and its pass is the fenced round-21 `expire_edges` entry
+  point.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.obs import WorkloadConfig
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    DistServeConfig,
+    DistServeEngine,
+    FaultInjector,
+    FaultSpec,
+    ServeConfig,
+    ServeEngine,
+)
+from quiver_tpu.stream import StreamingTiledGraph
+from quiver_tpu.workloads import (
+    TemporalDistServeEngine,
+    TemporalServeEngine,
+    TemporalTiledGraph,
+)
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+OUT_DIM = 5
+EDGE_INDEX = make_random_graph(N_NODES, 2000, seed=0)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=OUT_DIM, num_layers=2,
+                      dropout=0.0)
+    sampler = GraphSageSampler(
+        CSRTopo(edge_index=EDGE_INDEX), sizes=SIZES, mode="TPU",
+        seed=SAMPLER_SEED,
+    )
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_dist(setup, hosts=2, **cfg_kw):
+    model, params, feat = setup
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    cfg_kw.setdefault("record_dispatches", True)
+    cfg_kw.setdefault("cache_entries", 512)
+    cfg_kw.setdefault("exchange", "host")
+    cfg_kw.setdefault("journal_events", 4096)
+    return DistServeEngine.build(
+        model, params, CSRTopo(edge_index=EDGE_INDEX), feat, SIZES,
+        hosts=hosts, config=DistServeConfig(hosts=hosts, **cfg_kw),
+        sampler_seed=SAMPLER_SEED,
+    )
+
+
+def serve_view(dist, trace):
+    """Drive the trace and collect every surface the parity contract
+    pins: per-request (logit bytes | error string), dispatch log,
+    journal stream (timestamps stripped, window_wait — the one
+    wall-clock-count event — excluded), owner health, hedge events."""
+    handles = [dist.submit(int(n)) for n in trace]
+    while dist._drainable():
+        dist.flush()
+    out = []
+    for h in handles:
+        try:
+            out.append(h.result(timeout=60).tobytes())
+        except Exception as exc:
+            out.append(f"{type(exc).__name__}: {exc}")
+    return {
+        "out": out,
+        "dispatch_log": [
+            (ids.tobytes(), [(h, sub.tobytes()) for h, sub in split])
+            for ids, split in dist.dispatch_log
+        ],
+        "journal": [e[1:] for e in dist.journal.snapshot()
+                    if e[1] != "window_wait"],
+        "owner_health": dist.owner_health(),
+        "hedge_events": dist.hedge_events(),
+    }
+
+
+def fault_plan():
+    # one transient error, one stall, one permanent kill — every fault
+    # kind crossing the fan-out path in one run
+    return FaultInjector([
+        FaultSpec(owner=0, fid=2, kind="error"),
+        FaultSpec(owner=1, fid=3, kind="stall", stall_s=0.01),
+        FaultSpec(owner=0, fid=5, kind="kill"),
+    ])
+
+
+# -- the tentpole pin: fan-out == sequential, bit for bit ---------------------
+
+NODE_MATRIX = [
+    # (max_in_flight, hosts, faults, hedge_deadline)
+    (1, 1, False, False),
+    (1, 2, False, False),
+    (2, 2, False, False),
+    (1, 4, False, False),
+    (2, 4, False, False),
+    (1, 2, True, False),
+    (2, 2, True, True),
+    (1, 4, True, True),
+    (2, 4, False, True),
+    (1, 2, False, True),
+]
+
+
+@pytest.mark.parametrize("mif,hosts,faults,hedge", NODE_MATRIX)
+def test_fanout_sequential_bit_parity_node(setup, mif, hosts, faults,
+                                           hedge):
+    rng = np.random.default_rng(17)
+    trace = rng.integers(0, N_NODES, 40)
+    views = []
+    for sequential in (True, False):
+        cfg = dict(max_in_flight=mif, sequential_legs=sequential)
+        if faults:
+            cfg["fault_injector"] = fault_plan()
+        if hedge:
+            # generous deadline: the bounded-join PATH is exercised on
+            # every leg without any wall-clock-dependent firing
+            cfg["hedge_deadline_ms"] = 5000.0
+        dist = make_dist(setup, hosts=hosts, **cfg)
+        view = serve_view(dist, trace)
+        if faults:
+            view["faults"] = dist.config.fault_injector.events()
+        views.append(view)
+        dist.stop(drain=True)
+    assert views[0] == views[1], (
+        f"fan-out diverged from the sequential twin at mif={mif} "
+        f"hosts={hosts} faults={faults} hedge={hedge}"
+    )
+    if faults:
+        assert views[0]["faults"], "fault plan never fired"
+
+
+# -- temporal traffic (plain fan-out: no faults/hedge in temporal v1) --------
+
+T_SIZES = [3, 3]
+T_DIM = 12
+T_MAXD = 128
+T_EDGE_INDEX = make_random_graph(N_NODES, 1400, seed=0)
+T_TOPO = CSRTopo(edge_index=T_EDGE_INDEX)
+T_BASE_TS = np.random.default_rng(11).uniform(
+    0.0, 50.0, T_TOPO.indices.shape[0]
+).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def tsetup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, T_DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=OUT_DIM, num_layers=2,
+                      dropout=0.0)
+    s0 = GraphSageSampler(T_TOPO, sizes=T_SIZES, mode="TPU", seed=5,
+                          dedup=False, max_deg=T_MAXD)
+    s0.bind_temporal(TemporalTiledGraph(T_TOPO, T_BASE_TS), recency=0.02)
+    ds0 = s0.sample_dense(np.arange(8, dtype=np.int64), t=100.0)
+    x0 = jnp.zeros((ds0.n_id.shape[0], T_DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_tdist(tsetup, hosts, sequential, mif=1):
+    model, params, feat = tsetup
+    return TemporalDistServeEngine.build(
+        model, params, T_TOPO, T_BASE_TS, feat, T_SIZES, hosts=hosts,
+        config=DistServeConfig(
+            hosts=hosts, max_batch=8, max_delay_ms=1e9, exchange="host",
+            record_dispatches=True, max_in_flight=mif,
+            sequential_legs=sequential, journal_events=4096,
+            shard_config=ServeConfig(max_batch=8, buckets=(4, 8),
+                                     max_delay_ms=1e9,
+                                     record_dispatches=True),
+        ),
+        sampler_seed=5, recency=0.02, max_deg=T_MAXD, t_quantum=4.0,
+    )
+
+
+@pytest.mark.parametrize("mif,hosts", [(1, 1), (1, 2), (2, 2), (1, 4),
+                                       (2, 4)])
+def test_fanout_sequential_bit_parity_temporal(tsetup, mif, hosts):
+    rng = np.random.default_rng(23)
+    nodes = rng.integers(0, N_NODES, 30)
+    tq = rng.uniform(0.0, 55.0, 30)
+    views = []
+    for sequential in (True, False):
+        dist = make_tdist(tsetup, hosts, sequential, mif=mif)
+        handles = [dist.submit(int(n), t=float(t))
+                   for n, t in zip(nodes, tq)]
+        while dist._drainable():
+            dist.flush()
+        rows = [h.result(timeout=60).tobytes() for h in handles]
+        views.append({
+            "rows": rows,
+            "journal": [e[1:] for e in dist.journal.snapshot()
+                        if e[1] != "window_wait"],
+        })
+        dist.stop(drain=True)
+    assert views[0] == views[1], (
+        f"temporal fan-out diverged from sequential at mif={mif} "
+        f"hosts={hosts}"
+    )
+
+
+# -- mocked stall-shaped owners: scheduling, threads, telemetry ---------------
+
+class StallOwner:
+    """Duck-typed owner whose ``predict`` sleeps (GIL-releasing) then
+    returns deterministic id-derived rows — the r03 bench's shape."""
+
+    def __init__(self, stall_s=0.0):
+        self.stall_s = stall_s
+
+    def predict(self, ids, t=None, tenants=None):
+        if self.stall_s > 0:
+            time.sleep(self.stall_s)
+        ids = np.asarray(ids, np.int64).astype(np.float32)
+        return ids[:, None] * 10.0 + np.arange(OUT_DIM, dtype=np.float32)
+
+    def _cancel_prefetch(self):  # stop() quiesces every owner engine
+        pass
+
+
+def make_mock_dist(hosts=4, stalls=None, **cfg_kw):
+    g2h = (np.arange(N_NODES) % hosts).astype(np.int32)
+    owners = {h: StallOwner((stalls or {}).get(h, 0.0))
+              for h in range(hosts)}
+    base = dict(hosts=hosts, max_batch=16, max_delay_ms=1e9,
+                max_in_flight=1, exchange="host", record_dispatches=True,
+                cache_entries=0, journal_events=4096)
+    base.update(cfg_kw)
+    return DistServeEngine(owners, g2h, OUT_DIM,
+                           config=DistServeConfig(**base))
+
+
+def mock_view(dist, trace):
+    handles = [dist.submit(int(n)) for n in trace]
+    while dist._drainable():
+        dist.flush()
+    return {
+        "rows": [h.result(timeout=60).tobytes() for h in handles],
+        "journal": [e[1:] for e in dist.journal.snapshot()
+                    if e[1] != "window_wait"],
+    }
+
+
+def test_leg_fanout_one_equals_sequential():
+    """``leg_fanout=1`` serializes the worker threads (one leg in
+    flight); results must be bit-equal to the thread-free sequential
+    scheduler — the bound changes SCHEDULING, never results."""
+    rng = np.random.default_rng(31)
+    trace = rng.integers(0, N_NODES, 48)
+    views = []
+    for cfg in (dict(sequential_legs=True), dict(leg_fanout=1),
+                dict(leg_fanout=2), dict()):
+        dist = make_mock_dist(hosts=4, **cfg)
+        views.append(mock_view(dist, trace))
+        dist.stop(drain=True)
+    assert views[0] == views[1] == views[2] == views[3]
+
+
+def test_thread_count_flat_across_100_flushes():
+    """Leg threads are joined inside the flush that spawned them: the
+    process thread count must not grow across 100 fan-out flushes."""
+    dist = make_mock_dist(hosts=4)
+    rng = np.random.default_rng(7)
+    # prime one flush so any lazily-created machinery exists
+    for n in rng.integers(0, N_NODES, 8):
+        dist.submit(int(n))
+    while dist._drainable():
+        dist.flush()
+    before = threading.active_count()
+    for _ in range(100):
+        for n in rng.integers(0, N_NODES, 8):
+            dist.submit(int(n))
+        while dist._drainable():
+            dist.flush()
+    assert threading.active_count() <= before, (
+        "leg threads leaked across flushes"
+    )
+    dist.stop(drain=True)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("quiver-owner-leg")], (
+        "owner leg threads survived stop(drain=True)"
+    )
+
+
+def test_stop_drain_joins_inflight_legs():
+    """stop(drain=True) during an in-flight fan-out flush joins the
+    legs and retires their slots — no DrainTimeout, no live leg
+    threads after."""
+    dist = make_mock_dist(hosts=4, stalls={h: 0.15 for h in range(4)},
+                          drain_deadline_s=10.0)
+    handles = [dist.submit(int(n)) for n in range(8)]
+    t = threading.Thread(target=dist.flush, daemon=True)
+    t.start()
+    time.sleep(0.05)  # let the flush spawn its owner legs
+    dist.stop(drain=True)
+    t.join(timeout=30)
+    for h in handles:
+        assert h.result(timeout=1).shape == (OUT_DIM,)
+    assert not [th for th in threading.enumerate()
+                if th.name.startswith("quiver-owner-leg")]
+
+
+def test_straggler_telemetry_names_stalled_owner_under_fanout():
+    """Each leg is timed INSIDE its body, so a stalled owner's latency
+    is attributed to IT even while the stall overlaps the other legs —
+    the round-23 fix for the straggler-telemetry caveat."""
+    dist = make_mock_dist(hosts=4, stalls={2: 0.03},
+                          workload=WorkloadConfig(topk=16))
+    rng = np.random.default_rng(13)
+    for _ in range(10):
+        for n in rng.integers(0, N_NODES, 16):
+            dist.submit(int(n))
+        while dist._drainable():
+            dist.flush()
+    s = dist.workload.owners.straggler()
+    assert s["owner"] == 2, f"straggler misattributed: {s}"
+    assert s["vs_median"] > 2.0, s
+    dist.stop(drain=True)
+
+
+def test_owner_kill_ejection_replay_bit_identical(setup):
+    """A seeded kill + ejection run under fan-out replays bit-
+    identically: faults ride the dispatch index, ejection/wedged
+    prechecks happen in the parent in split order, so leg interleaving
+    never reaches any replayed byte."""
+    rng = np.random.default_rng(41)
+    trace = rng.integers(0, N_NODES, 40)
+    views = []
+    for _ in range(2):
+        inj = FaultInjector.seeded(
+            owners=range(2), n_faults=4, seed=19, fid_range=(1, 5),
+            kinds=("error", "kill"),
+        )
+        dist = make_dist(setup, hosts=2, fault_injector=inj,
+                         eject_after=1)
+        view = serve_view(dist, trace)
+        view["faults"] = inj.events()
+        view["ejections"] = dist.stats.owner_ejections
+        views.append(view)
+        dist.stop(drain=True)
+    assert views[0] == views[1], "seeded faulty run failed to replay"
+    assert views[0]["faults"], "seeded plan never fired"
+
+
+# -- the round-23 wall-clock TTL daemon ---------------------------------------
+
+T_LIFE_TOPO = CSRTopo(edge_index=T_EDGE_INDEX)
+
+
+def make_retention_engine(tsetup, **cfg_kw):
+    model, params, feat = tsetup
+    stream = StreamingTiledGraph(CSRTopo(edge_index=T_EDGE_INDEX),
+                                 edge_ts=T_BASE_TS.copy(),
+                                 reserve_frac=0.5)
+    s = GraphSageSampler(CSRTopo(edge_index=T_EDGE_INDEX), sizes=T_SIZES,
+                         mode="TPU", seed=5, dedup=False, max_deg=T_MAXD)
+    s.bind_temporal(stream, recency=0.02)
+    cfg_kw.setdefault("max_batch", 8)
+    cfg_kw.setdefault("buckets", (8,))
+    cfg_kw.setdefault("max_delay_ms", 1e9)
+    return TemporalServeEngine(model, params, s, feat,
+                               ServeConfig(**cfg_kw), t_quantum=4.0)
+
+
+def test_retention_daemon_pass_deterministic_clock(tsetup):
+    """`_retention_pass` under an injected clock: two engines replaying
+    the same clock readings expire identical edge counts at identical
+    graph versions — the daemon is the fenced `expire_edges` on a
+    timer, nothing more."""
+    # BASE_TS is uniform(0, 50): t=60 expires ts<30, the repeat is the
+    # monotone-clock no-op, t=80 expires the [30, 50) remainder
+    readings = [60.0, 60.0, 80.0]
+
+    def run():
+        ticks = iter(readings)
+        eng = make_retention_engine(
+            tsetup, stream_retention_window=30.0,
+            stream_retention_every_s=0.0,  # no thread: driven directly
+            stream_retention_clock=lambda: next(ticks),
+        )
+        out = []
+        for _ in readings:
+            r = eng._retention_pass()
+            out.append((r["edges_expired"], eng.graph_version))
+        assert eng.retention_passes == len(readings)
+        return out
+
+    a, b = run(), run()
+    assert a == b
+    assert a[0][0] > 0, "first pass at t=60 expired nothing"
+    assert a[1][0] == 0, "same reading must be a no-op (monotone clock)"
+    assert a[2][0] > 0, "advanced clock expired nothing"
+
+
+def test_retention_daemon_thread_lifecycle(tsetup):
+    """start() spawns the quiver-serve-retention daemon only when
+    configured; stop() retires it."""
+    eng = make_retention_engine(tsetup, stream_retention_window=30.0,
+                                stream_retention_every_s=0.05)
+    eng.start()
+    daemons = [t for t in eng._threads
+               if t.name == "quiver-serve-retention"]
+    assert daemons, "retention daemon not spawned"
+    eng.stop(drain=True)
+    # the loop checks _running after each period sleep (the compactor's
+    # shutdown contract): give it one wake to exit
+    daemons[0].join(timeout=5.0)
+    assert not daemons[0].is_alive()
+    # off by default: no daemon without the knob
+    eng2 = make_retention_engine(tsetup, stream_retention_window=30.0)
+    eng2.start()
+    assert "quiver-serve-retention" not in [t.name for t in eng2._threads]
+    eng2.stop(drain=True)

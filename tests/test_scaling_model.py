@@ -295,6 +295,54 @@ def test_serve_table_one_vs_two_dispatch_overhead():
         serve_table(**kw, dispatches_per_flush=0)
 
 
+def test_serve_table_owner_fanout_pricing():
+    """The round-23 host-mode routed term: ``owner_fanout=None`` keeps
+    every row byte-identical to the collective pricing; with a fan-out
+    the routed dispatch costs ceil(H/F) legs + merge and carries zero
+    exchange bytes — F=1 is the sequential router's Σ(legs), F>=H is
+    max(legs)."""
+    from quiver_tpu.parallel.scaling import (
+        format_serve_markdown,
+        serve_table,
+    )
+
+    kw = dict(t_sample_s=0.01, t_gather_s=0.0, t_forward_s=0.01,
+              ref_batch=100, buckets=(100,), hit_rates=(0.0,),
+              unique_frac=1.0, max_delay_ms=2.0, hosts=4, out_dim=8,
+              bandwidths={"dcn_bytes_per_s": 25e9})
+    base = serve_table(**kw)
+    default = serve_table(**kw, owner_fanout=None)
+    assert [r._asdict() for r in base] == [r._asdict() for r in default]
+    assert base[0].owner_fanout == 0 and base[0].leg_merge_us == 0.0
+
+    seq = serve_table(**kw, owner_fanout=1)[0]
+    fan = serve_table(**kw, owner_fanout=4)[0]
+    over = serve_table(**kw, owner_fanout=8)[0]  # capped at ceil(H/F)=1
+    # dispatch_s stays the per-shard leg cost; the leg count rides the
+    # flush wall (qps + latency floor). F=1 pays all H legs serially,
+    # F>=H pays exactly one.
+    assert seq.dispatch_s == pytest.approx(fan.dispatch_s)
+    assert fan.qps == pytest.approx(seq.qps * 4)
+    assert over.qps == pytest.approx(fan.qps)
+    assert (seq.floor_p50_ms - fan.floor_p50_ms
+            == pytest.approx(3 * fan.dispatch_s * 1e3))
+    # routed legs ship no collective payload
+    assert fan.exchange_bytes == 0.0 and fan.exchange_s == 0.0
+    assert base[0].exchange_bytes > 0.0
+    # the merge term is additive on the flush wall
+    merged = serve_table(**kw, owner_fanout=4, leg_merge_us=500.0)[0]
+    assert (merged.floor_p50_ms - fan.floor_p50_ms
+            == pytest.approx(0.5))
+    assert merged.qps < fan.qps
+    assert merged.leg_merge_us == 500.0 and merged.owner_fanout == 4
+    # hosts=1 never prices a fan-out (there is one leg, no merge)
+    one = serve_table(**{**kw, "hosts": 1}, owner_fanout=4,
+                      leg_merge_us=500.0)[0]
+    assert one.owner_fanout == 0 and one.leg_merge_us == 0.0
+    md = format_serve_markdown([seq, fan, merged])
+    assert "round 23" in md and "owner_fanout=1" in md
+
+
 def test_median_min_max():
     from quiver_tpu.trace import median_min_max
 
