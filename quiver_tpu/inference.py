@@ -490,12 +490,24 @@ class BucketPrograms:
                     _SERVE_EXE_CACHE.popitem(last=False)
         self._exes[bucket] = exe
 
-    def __call__(self, bucket: int, params, key, seeds, *extra) -> jax.Array:
+    def binding(self):
+        """The persistent-argument triple ``(table, index_map, graph)``
+        CURRENTLY bound — an epoch snapshot. Zero-stall engines capture
+        this at seal time and pass it back as ``binding=`` so a flush
+        dispatches against the graph arrays of ITS dispatch index even
+        when a commit rebinds mid-flight (the arrays are immutable; a
+        rebind swaps references, never bits)."""
+        return (self._table, self._map, self._graph)
+
+    def __call__(self, bucket: int, params, key, seeds, *extra,
+                 binding=None) -> jax.Array:
         """ONE execute call: the whole sample+gather+forward for a padded
         seed batch at ``bucket``. Misses compile lazily before `seal()`,
         raise RuntimeError after. Temporal programs take one ``extra``
         argument — the padded per-seed query-time vector, float32
-        ``[bucket]`` (the engine pads it exactly like the seeds)."""
+        ``[bucket]`` (the engine pads it exactly like the seeds).
+        ``binding=`` (a `binding()` snapshot) overrides the live
+        table/map/graph arguments — the epoch-pinning hook."""
         if len(extra) != self._n_extra:
             raise TypeError(
                 f"this serve program takes {self._n_extra} extra "
@@ -526,9 +538,11 @@ class BucketPrograms:
         extra = tuple(
             jnp.asarray(np.asarray(e, np.float32)) for e in extra
         )
-        return exe(
-            params, key, seeds, self._table, self._map, self._graph, *extra
+        table, imap, graph = (
+            binding if binding is not None
+            else (self._table, self._map, self._graph)
         )
+        return exe(params, key, seeds, table, imap, graph, *extra)
 
 
 def time_eval_split(

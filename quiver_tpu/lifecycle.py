@@ -1,7 +1,13 @@
 """Graph lifecycle (round 21, ROADMAP item 2): the policy layer that
 makes a `stream.StreamingTiledGraph` live forever — deletes, TTL
 retention, background tile compaction, and reserve re-provisioning, all
-riding the existing fenced `update_graph` machinery on both engines.
+riding the `update_graph` commit machinery on both engines. Since round
+24 those commits are ZERO-STALL by default: the post-commit device
+arrays build off-fence (``defer_publish=True`` staging) and flip under
+the engine's dispatch lock only — retention expiry and compaction ride
+the same staged flip, while re-provisioning (an executable aval swap)
+always takes the full fenced path. ``fenced_commits=True`` restores the
+round-23 drain.
 
 The mechanisms live in `quiver_tpu.stream` (they mutate tile state and
 must share its lock); this module holds the DETERMINISTIC POLICIES that
@@ -32,7 +38,9 @@ commit stream alone:
 Every policy is a pure function of observable state (commit clock,
 reserve report) with no wall-clock or RNG input, which is what keeps
 deletion-era dispatch logs replayable: `replay_fleet_oracle`/
-`replay_temporal_log` snapshot topology per version, and the policies
+`replay_temporal_log` snapshot topology per version — since round 24
+each dispatch-log row carries its sealed ``graph_version`` stamp, so
+commits racing in-flight flushes replay per epoch — and the policies
 re-derive the same expiry/compaction decisions from the same stream.
 """
 

@@ -1198,6 +1198,8 @@ class DeltaPrediction(NamedTuple):
     # round-21 lifecycle terms (default 0: the round-17 table unchanged)
     churn_s: float = 0.0         # per-commit delete/expiry lane rewrites
     compact_amort_s: float = 0.0  # compaction wall amortized per commit
+    # round-24: which commit discipline priced the stall column
+    fence_mode: str = "fenced"   # "fenced" (drain) | "zerostall" (flip)
 
 
 def delta_table(
@@ -1209,6 +1211,8 @@ def delta_table(
     delete_s_per_edge: float = 0.0,
     compact_s_per_pass: float = 0.0,
     compact_every_commits: float = 0.0,
+    commit_stall_us: Optional[float] = None,
+    fence_mode: str = "fenced",
 ) -> List[DeltaPrediction]:
     """Price streaming-graph ingest (round 17) from MEASURED per-edge
     costs: "at edge rate R with a commit every ``commit_period_s``, what
@@ -1234,6 +1238,16 @@ def delta_table(
     ``stream_compact_s``) every ``compact_every_commits`` commits is
     amortized into the duty — the steady-state price of a stream that
     lives forever instead of only growing.
+
+    Round-24 zero-stall pricing: ``fence_mode="zerostall"`` decouples
+    the DUTY (the commit work still costs the same host/device wall,
+    it just runs off-fence) from the SERVING STALL, which collapses to
+    the measured flip hold — pass it as ``commit_stall_us`` (the
+    engine's ``commit_stall`` histogram mean, serve_probe
+    ``--stream-stall``). With ``fence_mode="fenced"`` (default) the
+    stall stays equal to the whole commit wall and ``commit_stall_us``
+    is ignored — the drain-vs-flip comparison the Round-24 SCALING.md
+    section tabulates.
     """
     if append_s_per_edge < 0 or swap_s_per_commit < 0:
         raise ValueError("per-edge/per-commit costs must be >= 0")
@@ -1241,6 +1255,17 @@ def delta_table(
         raise ValueError("commit_period_s must be > 0")
     if delete_frac < 0 or delete_s_per_edge < 0 or compact_s_per_pass < 0:
         raise ValueError("lifecycle costs must be >= 0")
+    if fence_mode not in ("fenced", "zerostall"):
+        raise ValueError(
+            f"fence_mode must be 'fenced' or 'zerostall', got {fence_mode!r}"
+        )
+    if fence_mode == "zerostall" and commit_stall_us is None:
+        raise ValueError(
+            "zerostall pricing needs the measured flip hold: pass "
+            "commit_stall_us (serve_probe --stream-stall measures it)"
+        )
+    if commit_stall_us is not None and commit_stall_us < 0:
+        raise ValueError("commit_stall_us must be >= 0")
     compact_amort = (compact_s_per_pass / compact_every_commits
                      if compact_every_commits > 0 else 0.0)
     rows: List[DeltaPrediction] = []
@@ -1252,6 +1277,10 @@ def delta_table(
         churn = per_commit * delete_frac * delete_s_per_edge
         commit_s = per_commit * append_s_per_edge + swap_s_per_commit + churn
         duty = (commit_s + compact_amort) / commit_period_s
+        # zero-stall: the commit WORK is unchanged (duty identical) but
+        # the serving stall is the measured flip hold, not the wall
+        stall_s = (commit_stall_us * 1e-6 if fence_mode == "zerostall"
+                   else commit_s)
         rows.append(
             DeltaPrediction(
                 name=str(name),
@@ -1259,10 +1288,11 @@ def delta_table(
                 edges_per_commit=per_commit,
                 commit_s=commit_s,
                 duty_frac=duty,
-                fence_stall_s=commit_s,
+                fence_stall_s=stall_s,
                 sustainable=duty < 1.0,
                 churn_s=churn,
                 compact_amort_s=compact_amort,
+                fence_mode=fence_mode,
             )
         )
     return rows
@@ -1270,25 +1300,29 @@ def delta_table(
 
 def format_delta_markdown(rows: Sequence[DeltaPrediction]) -> str:
     lifecycle = any(r.churn_s or r.compact_amort_s for r in rows)
+    zerostall = any(r.fence_mode == "zerostall" for r in rows)
+    stall_col = "commit stall ms" if zerostall else "fence stall ms"
     if lifecycle:
         lines = [
             "| case | edges/s | edges/commit | commit ms | churn ms "
-            "| compact ms | fence stall ms | duty | sustainable |",
+            f"| compact ms | {stall_col} | duty | sustainable |",
             "|---|---|---|---|---|---|---|---|---|",
         ]
     else:
         lines = [
-            "| case | edges/s | edges/commit | commit ms | fence stall ms "
+            f"| case | edges/s | edges/commit | commit ms | {stall_col} "
             "| duty | sustainable |",
             "|---|---|---|---|---|---|---|",
         ]
     for r in rows:
         mid = (f"| {r.churn_s*1e3:.2f} | {r.compact_amort_s*1e3:.2f} "
                if lifecycle else "")
+        stall = (f"{r.fence_stall_s*1e3:.4f}" if r.fence_mode == "zerostall"
+                 else f"{r.fence_stall_s*1e3:.2f}")
         lines.append(
             f"| {r.name} | {r.edges_per_s:.0f} | {r.edges_per_commit:.0f} "
             f"| {r.commit_s*1e3:.2f} {mid}"
-            f"| {r.fence_stall_s*1e3:.2f} "
+            f"| {stall} "
             f"| {r.duty_frac:.1%} | {'yes' if r.sustainable else 'NO'} |"
         )
     lines.append("")
@@ -1298,8 +1332,14 @@ def format_delta_markdown(rows: Sequence[DeltaPrediction]) -> str:
         + (", stream_delete_s per lane rewrite, stream_compact_s per "
            "background pass" if lifecycle else "")
         + "). "
-        "The commit runs fenced, so its wall is the per-commit serving "
-        "stall; longer commit periods amortize the swap at the cost of "
+        + ("Zero-stall commits: the commit WORK still costs the same "
+           "wall (duty unchanged) but builds off-fence, so the serving "
+           "stall collapses to the measured flip hold "
+           "(serve_probe --stream-stall commit_stall_us). "
+           if zerostall else
+           "The commit runs fenced, so its wall is the per-commit "
+           "serving stall; ")
+        + "longer commit periods amortize the swap at the cost of "
         "delta visibility lag — the round-17 ingest planning table"
         + (" with the round-21 lifecycle churn/compaction terms."
            if lifecycle else ".")

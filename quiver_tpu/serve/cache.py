@@ -20,11 +20,18 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Hashable, Optional
+from typing import Dict, Hashable, Optional, Set
 
 import numpy as np
 
 from ..trace import HitRateCounter
+
+
+def _key_node(key: Hashable) -> Hashable:
+    """The node element of a cache key — composite temporal keys
+    (``(node, t_bucket)`` tuples) index on their first element, plain
+    int keys on themselves."""
+    return key[0] if isinstance(key, tuple) else key
 
 
 class EmbeddingCache:
@@ -51,14 +58,37 @@ class EmbeddingCache:
         self.workload = None
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
-        # True once any composite (tuple) key was inserted — the flag
-        # that lets `invalidate_nodes` skip its full-cache scan on
-        # plain int-keyed engines (guarded by _lock; never reset — a
-        # temporal engine stays temporal)
+        # True once any composite (tuple) key was inserted (guarded by
+        # _lock; never reset — a temporal engine stays temporal)
         self._tuple_keys = False
+        # per-node resident-key index (round 24): node -> the set of
+        # full keys currently resident for it. Makes `invalidate_nodes`
+        # O(touched keys) instead of O(resident) on composite-keyed
+        # caches; maintained at every insert/delete/evict under _lock
+        self._node_index: Dict[Hashable, Set[Hashable]] = {}
+        # zero-stall commit support: per-node graph-version FLOORS. A
+        # put stamped with a graph version below its node's floor is
+        # silently dropped — that is the writeback gate that replaces
+        # the round-17 drain: an old-epoch in-flight flush resolving
+        # AFTER a commit can no longer re-insert a stale row. Entries
+        # carry their gv stamp; `raise_floor` both sets the floor and
+        # drops already-resident below-floor entries eagerly.
+        self._floor: Dict[Hashable, int] = {}
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    # -- node-index maintenance (caller holds _lock) -------------------
+    def _index_add(self, key: Hashable) -> None:
+        self._node_index.setdefault(_key_node(key), set()).add(key)
+
+    def _index_drop(self, key: Hashable) -> None:
+        node = _key_node(key)
+        s = self._node_index.get(node)
+        if s is not None:
+            s.discard(key)
+            if not s:
+                del self._node_index[node]
 
     def get(self, node_id: Hashable, version: int) -> Optional[np.ndarray]:
         """Value for ``node_id`` at exactly ``version``, else None. A hit
@@ -72,9 +102,11 @@ class EmbeddingCache:
                 if wl is not None:
                     wl.observe_cache(node_id, False)
                 return None
-            ver, value = ent
-            if ver != version:
+            ver, value, gv = ent
+            if (ver != version
+                    or gv < self._floor.get(_key_node(node_id), 0)):
                 del self._entries[node_id]
+                self._index_drop(node_id)
                 self.counters.evict()
                 self.counters.miss()
                 if wl is not None:
@@ -102,6 +134,7 @@ class EmbeddingCache:
             if not d and wl is None:
                 self.counters.miss(len(node_ids))
                 return out
+            floors = self._floor
             for ix, node_id in enumerate(node_ids):
                 ent = d.get(node_id)
                 if ent is None:
@@ -109,9 +142,12 @@ class EmbeddingCache:
                     if wl is not None:
                         wl.observe_cache(node_id, False)
                     continue
-                ver, value = ent
-                if ver != version:
+                ver, value, gv = ent
+                if (ver != version
+                        or (floors and gv < floors.get(
+                            _key_node(node_id), 0))):
                     del d[node_id]
+                    self._index_drop(node_id)
                     evictions += 1
                     misses += 1
                     if wl is not None:
@@ -130,20 +166,34 @@ class EmbeddingCache:
             self.counters.evict(evictions)
         return out
 
-    def put(self, node_id: Hashable, version: int, value: np.ndarray) -> None:
+    def put(self, node_id: Hashable, version: int, value: np.ndarray,
+            gv: int = 0) -> None:
+        """Insert at ``(params) version`` stamped with graph version
+        ``gv``. A put below its node's graph-version FLOOR is silently
+        dropped — the zero-stall writeback gate (an old-epoch flush
+        resolving after a commit must not re-insert the stale row);
+        fenced engines never raise floors, so the default ``gv=0``
+        always lands."""
         if self.capacity == 0:
             return
         with self._lock:
             if isinstance(node_id, tuple):
                 self._tuple_keys = True
+            if (self._floor
+                    and gv < self._floor.get(_key_node(node_id), 0)):
+                return
             if node_id in self._entries:
                 del self._entries[node_id]
-            self._entries[node_id] = (version, value)
+            else:
+                self._index_add(node_id)
+            self._entries[node_id] = (version, value, gv)
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                k, _ = self._entries.popitem(last=False)
+                self._index_drop(k)
                 self.counters.evict()
 
-    def put_many(self, node_ids, version: int, values) -> None:
+    def put_many(self, node_ids, version: int, values,
+                 gv: int = 0) -> None:
         """Batch :meth:`put` (round 22) — `get_many`'s writeback twin:
         ONE lock hold and ONE version for the whole batch (the resolve
         path's update_params fence guarantees every row in a flush was
@@ -162,14 +212,20 @@ class EmbeddingCache:
         with self._lock:
             d = self._entries
             cap = self.capacity
+            floors = self._floor
             for k, v in zip(node_ids, values):
                 if isinstance(k, tuple):
                     self._tuple_keys = True
+                if floors and gv < floors.get(_key_node(k), 0):
+                    continue  # below-floor writeback: see put()
                 if k in d:
                     del d[k]
-                d[k] = (version, v)
+                else:
+                    self._index_add(k)
+                d[k] = (version, v, gv)
                 while len(d) > cap:
-                    d.popitem(last=False)
+                    ek, _ = d.popitem(last=False)
+                    self._index_drop(ek)
                     evictions += 1
         if evictions:
             self.counters.evict(evictions)
@@ -196,6 +252,7 @@ class EmbeddingCache:
         with self._lock:
             n = len(self._entries)
             self._entries.clear()
+            self._node_index.clear()
             self.invalidations += 1
             return n
 
@@ -207,11 +264,9 @@ class EmbeddingCache:
         invalidation surface: a changed row staleness-taints a seed's
         cached result at EVERY query time (any cached t could have
         sampled the changed row's past), so all its t-entries drop
-        together. Cost: O(keys) exact deletes on a plain int-keyed cache
-        (identical to `invalidate_keys` — a round-17 streaming
-        deployment pays nothing new); the O(resident) scan runs only
-        when a composite key was ever inserted (temporal engines), which
-        is commit-grain work there. Exact-key paths (placement moves,
+        together. Cost: O(touched keys) via the per-node resident-key
+        index (round 24 — previously composite-keyed caches paid an
+        O(resident) scan per commit). Exact-key paths (placement moves,
         replica refreshes) keep `invalidate_keys`. Returns entries
         dropped."""
         nodes = {int(x) for x in node_ids}
@@ -220,16 +275,57 @@ class EmbeddingCache:
         n = 0
         with self._lock:
             for node in nodes:
-                if self._entries.pop(node, None) is not None:
+                keys = self._node_index.pop(node, None)
+                if not keys:
+                    continue
+                for k in keys:
+                    del self._entries[k]
                     n += 1
-            if self._tuple_keys:
-                for k in list(self._entries):
-                    if isinstance(k, tuple) and k[0] in nodes:
-                        del self._entries[k]
-                        n += 1
             if n:
                 self.invalidations += 1
         return n
+
+    def raise_floor(self, node_ids, floor: int) -> int:
+        """Zero-stall invalidation (round 24): for each given node, set
+        its graph-version floor to ``floor`` and eagerly drop resident
+        entries stamped BELOW it (entries written by flushes already
+        sealed at the new version survive). From then on the floor gates
+        late writebacks from old-epoch in-flight flushes — the lazy
+        miss-at-new-version semantics the drain used to provide
+        synchronously. Returns entries dropped."""
+        floor = int(floor)
+        n = 0
+        with self._lock:
+            for node in node_ids:
+                node = int(node)
+                if self._floor.get(node, 0) < floor:
+                    self._floor[node] = floor
+                keys = self._node_index.get(node)
+                if not keys:
+                    continue
+                for k in list(keys):
+                    if self._entries[k][2] < floor:
+                        del self._entries[k]
+                        keys.discard(k)
+                        n += 1
+                if not keys:
+                    del self._node_index[node]
+            if n:
+                self.invalidations += 1
+        return n
+
+    def graph_floor(self, node_id: Hashable) -> int:
+        """A node's current graph-version floor (0 when never raised) —
+        inspection only."""
+        with self._lock:
+            return self._floor.get(int(node_id), 0)
+
+    def entry_graph_version(self, node_id: Hashable) -> Optional[int]:
+        """The graph version an entry's row was computed under, or None
+        — inspection only, `entry_version`'s graph-axis twin."""
+        with self._lock:
+            ent = self._entries.get(node_id)
+            return None if ent is None else ent[2]
 
     def invalidate_keys(self, node_ids) -> int:
         """Drop the entries for specific nodes (round 14: a placement
@@ -241,6 +337,7 @@ class EmbeddingCache:
         with self._lock:
             for k in node_ids:
                 if self._entries.pop(k, None) is not None:
+                    self._index_drop(k)
                     n += 1
             if n:
                 self.invalidations += 1

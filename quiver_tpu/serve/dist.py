@@ -824,6 +824,13 @@ class DistServeConfig:
     # order regardless, so the bound changes scheduling, never results
     # — leg_fanout=1 is the sequential pass on a worker thread.
     leg_fanout: int = 0
+    # -- round-24 zero-stall commits (see ServeConfig.fenced_commits) ------
+    # False (default) = fleet update_graph plans/preflights outside the
+    # router fence, owner engines run their own zero-stall commits, and
+    # the router-grain flip (graph_version bump + replica retire) runs
+    # under the router _seq only. True = the drain-ordered round-17..23
+    # fence, bit-identical, propagated to every owner engine.
+    fenced_commits: bool = False
 
     def resolved_shard_config(self) -> ServeConfig:
         if self.shard_config is not None:
@@ -850,6 +857,7 @@ class DistServeConfig:
             # holds end-to-end, not just at router admission. None (no
             # QoS) leaves owner engines byte-identical to round 15.
             tenant_weights=self.tenant_weights,
+            fenced_commits=self.fenced_commits,
         )
 
 
@@ -919,6 +927,12 @@ class DistServeStats:
     latency: LatencyHistogram = field(default_factory=LatencyHistogram)
     tenant_latency: Dict[str, LatencyHistogram] = field(default_factory=dict)
     spans: SpanRecorder = field(default_factory=SpanRecorder)
+    # round-24: per-commit routed-serving stall in MICROSECONDS (the
+    # histogram is unit-agnostic; µs keeps sub-ms flips resolvable).
+    # Fenced: the whole drain+apply hold; zero-stall: the _seq flip.
+    commit_stall: LatencyHistogram = field(
+        default_factory=lambda: LatencyHistogram(min_ms=1e-2, max_ms=1e9)
+    )
 
     def tenant_hist(self, tenant: str) -> LatencyHistogram:
         from .engine import tenant_latency_hist
@@ -968,6 +982,7 @@ class DistServeStats:
             "exchange_logit_bytes": self.exchange_logit_bytes,
             "router_cache": self.router_cache.snapshot(),
             "latency": self.latency.snapshot(),
+            "commit_stall_us": self.commit_stall.snapshot(),
             "tenant_latency": {
                 t: self.tenant_latency[t].snapshot()
                 for t in sorted(self.tenant_latency)
@@ -989,12 +1004,18 @@ class _RoutedFlush:
     other slot resolves normally, and `flush()` does not re-raise."""
 
     __slots__ = ("keys", "slots", "split", "bucket", "error", "slot_errors",
-                 "fid", "tenants", "extra", "ids", "rids", "tenant_ix")
+                 "fid", "tenants", "extra", "ids", "rids", "tenant_ix",
+                 "graph_version")
 
     def __init__(self, keys, slots, split):
         self.keys = keys
         self.slots = slots
         self.split = split  # [(host, ids ndarray, positions ndarray)]
+        # ROUTER graph epoch this flush sealed against (round 24): stamped
+        # under _seq at seal, so a zero-stall fleet commit flipping the
+        # router version mid-flight never mixes epochs within one flush.
+        # Cache writebacks carry it as their floor-gate stamp.
+        self.graph_version = 0
         # array-native slot views (round 20, sealed — see _Flush): seed
         # ids (int64), journal rids (int64, -1 = journal off) and wire
         # tenant indices (int32, the collective's registry; -1 =
@@ -1154,6 +1175,12 @@ class DistServeEngine:
             self.cache.workload = self.workload
         self.params_version = 0
         self.dispatch_log: List[Tuple[np.ndarray, List[Tuple[int, np.ndarray]]]] = []
+        # ROUTER graph epoch per dispatch-log entry (round 24), a parallel
+        # aligned list (the log's tuple shape is pinned by tests and the
+        # round-21 CI smoke): dispatch_graph_versions[i] is the router
+        # graph_version entry i sealed against — the epoch filter
+        # `replay_fleet_oracle(graph_version=...)` selects rows by
+        self.dispatch_graph_versions: List[int] = []
         # per-OWNER pending queues (round 20): the stripe hint is the
         # BUILD-TIME ownership snapshot, deliberately NOT the live
         # global2host — scale()/rebalance() mutate placement in place, and
@@ -1235,6 +1262,15 @@ class DistServeEngine:
         # dicts mid-mutation or capture a mid-rollback graph. Ordering:
         # router fence lock -> _mat_lock, never the reverse.
         self._mat_lock = threading.Lock()
+        # zero-stall commits (round 24): serializes WHOLE fleet commits
+        # (plan + preflight + owner flips) against each other without
+        # fencing traffic — the flip itself happens under _seq only.
+        # Ordering: _commit_lock -> _mat_lock and _commit_lock -> _seq;
+        # never taken while holding _seq.
+        self._commit_lock = threading.RLock()
+        # per-commit counter samples for the Chrome-trace counter lane
+        # (graph_version staircase + commit_stall_us), observe-only
+        self._commit_samples = collections.deque(maxlen=4096)
         # one range handoff is atomic under this lock; stop() takes it
         # before draining, so an open range always completes or rolls
         # back first and no seed is ever stranded ownerless
@@ -1810,6 +1846,11 @@ class DistServeEngine:
             # _seq) — replay-deterministic, never wall time
             self.workload.tick()
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
+        # epoch pin (round 24): the router version this flush seals
+        # against. Zero-stall commits flip graph_version under _seq (the
+        # lock the caller holds here), so the stamp and the routing it
+        # governs belong to ONE epoch, never a mix.
+        fl.graph_version = self.graph_version
         try:
             arr = np.asarray(fl.keys, np.int64)
             fl.tenants = [s.tenant for s in fl.slots]
@@ -1846,6 +1887,7 @@ class DistServeEngine:
                 self.dispatch_log.append(
                     (arr.copy(), [(h, ids.copy()) for h, ids, _ in fl.split])
                 )
+                self.dispatch_graph_versions.append(fl.graph_version)
             if self.config.tier_prefetch:
                 # round-18: flush-ahead prefetch PER OWNER off the routed
                 # sub-batches — one window earlier than each owner's own
@@ -2408,7 +2450,8 @@ class DistServeEngine:
                     err = fl.error or fl.slot_errors.get(i)
                     if err is None:
                         if slot.version == self.params_version:
-                            self.cache.put(k, slot.version, rows[i])
+                            self.cache.put(k, slot.version, rows[i],
+                                           gv=fl.graph_version)
                         slot.resolve(rows[i])
                     else:
                         slot.resolve(None, error=err)
@@ -2687,13 +2730,172 @@ class DistServeEngine:
         if inv_hops is None:
             inv_hops = hops
         m_feat = np.asarray(m["feat"], np.float32)
+        if self.config.fenced_commits:
+            return self._update_graph_fenced(
+                delta, src, dst, rsrc, rdst, from_pending,
+                hops, feat_hops, inv_hops, m_feat)
+        return self._update_graph_zerostall(
+            delta, src, dst, rsrc, rdst, from_pending,
+            hops, feat_hops, inv_hops, m_feat)
+
+    def _plan_commit_window(self, delta, src, dst, rsrc, rdst,
+                            from_pending, hops, feat_hops, inv_hops):
+        """The tentative-adjacency window (add -> plan/preflight ->
+        commit-or-rollback), shared by the fenced and zero-stall commit
+        paths. Caller holds ``_mat_lock`` (and either the router fence or
+        ``_commit_lock``). On success the shared adjacency carries the
+        post-append, post-removal graph, ``_materials_stale`` is set, and
+        ``(affected, plans, fb_delta)`` comes back; on ANY failure the
+        adjacency is rolled back, a pending-origin delta is re-staged,
+        and the error re-raises — the whole fleet untouched."""
+        from ..stream import GraphDelta
+
+        adj = self._stream_adj
+        adj.add_edges(src, dst)  # validates ids first
+        # plan + preflight EVERY consumer over the updated adjacency
+        # before mutating ANY owner — a capacity error must leave the
+        # whole fleet (and the adjacency, rolled back below) untouched,
+        # never one owner committed and the next one not
+        try:
+            # invalidation seeds: append sources UNION removal
+            # sources — a removal changes its src row's draws
+            # too. The reverse closure runs over the POST-
+            # append, PRE-removal adjacency: reverse reach is
+            # a superset there (removals only shrink forward
+            # lists), so we over-invalidate, never under
+            inv_seeds = (np.unique(np.concatenate([src, rsrc]))
+                         if rsrc.size else np.unique(src))
+            affected = adj.reverse_closure(inv_seeds, inv_hops)
+            plans = []
+            for h in sorted(self.engines):
+                stream_h = self._owner_streams.get(h)
+                if stream_h is None:
+                    continue
+                topo_mask, feat_mask = self._owner_masks[h]
+                # fixpoint over delta chains: an edge whose
+                # src entered the mask via an EARLIER delta
+                # edge of this batch extends it further.
+                # EVERY dst of an in-mask src seeds a BFS —
+                # including dsts already in the mask: a node
+                # previously at the closure BOUNDARY (row
+                # kept, own closure not) can now be reached
+                # at a shallower depth and gets EXPANDED, so
+                # its k-hop closure must enter the mask too
+                # (the >=3-layer under-extension case; a
+                # superset costs reserve rows, never
+                # correctness)
+                new_topo = topo_mask.copy()
+                while True:
+                    seeds = np.unique(dst[new_topo[src]])
+                    if seeds.size == 0:
+                        break
+                    add = adj.forward_closure(seeds, hops)
+                    if not (add & ~new_topo).any():
+                        break
+                    new_topo |= add
+                feat_seeds = np.unique(dst[new_topo[src]])
+                new_feat = feat_mask | new_topo
+                if feat_seeds.size:
+                    # one hop deeper than the adjacency
+                    # closure (leaves gathered, never
+                    # expanded)
+                    new_feat |= adj.forward_closure(
+                        feat_seeds, feat_hops
+                    )
+                topo_new = np.nonzero(new_topo & ~topo_mask)[0]
+                installs = [(int(nd), adj.neighbors(int(nd)))
+                            for nd in topo_new]
+                rel = topo_mask[src]
+                owner_delta = GraphDelta(src[rel], dst[rel])
+                if rsrc.size:
+                    # filter removals by the NEW mask: install
+                    # rows are snapshotted from the shared
+                    # adjacency BEFORE removals apply (below),
+                    # so a freshly-installed row still carries
+                    # the doomed edge — every owner holding
+                    # the row (old or just-installed) must
+                    # delete it locally
+                    rel_r = new_topo[rsrc]
+                    owner_delta.remove_edges(rsrc[rel_r],
+                                             rdst[rel_r])
+                feat_new = np.nonzero(new_feat & ~feat_mask)[0]
+                stream_h.preflight(owner_delta,
+                                   installs=installs)
+                if feat_new.size:
+                    self._owner_feats[h].preflight_install(
+                        feat_new
+                    )
+                plans.append((h, new_topo, new_feat, installs,
+                              owner_delta, feat_new))
+            fb_delta = GraphDelta(src, dst)
+            if rsrc.size:
+                fb_delta.remove_edges(rsrc, rdst)
+            fb_stream = (getattr(self.fallback._sampler,
+                                 "stream", None)
+                         if self.fallback is not None
+                         else None)
+            if fb_stream is not None:
+                fb_stream.preflight(fb_delta)
+        except BaseException:
+            adj.pop_edges(src, dst)
+            if from_pending:
+                # a failed commit must not DROP staged
+                # arrivals (ServeEngine.update_graph's
+                # contract): re-staged ahead of anything
+                # staged meanwhile — arrival order is the
+                # replay order. _lock guards pending_delta
+                # against a concurrent stage_edges (which
+                # never takes the fence)
+                with self._lock:
+                    if self.pending_delta is not None:
+                        delta.extend(self.pending_delta)
+                    self.pending_delta = delta
+            raise
+        # every preflight passed: apply removals to the shared
+        # adjacency (cannot fail — existence was validated
+        # upfront and the batch's appends just landed). Owner
+        # install rows above were snapshotted pre-removal; the
+        # filtered owner_delta removals bring them in line
+        for u, v in zip(rsrc.tolist(), rdst.tolist()):
+            adj.remove_one(int(u), int(v))
+        self._materials_stale = True
+        return affected, plans, fb_delta
+
+    def _sync_fleet_epoch(self) -> None:
+        """Align every LIVE engine's ``graph_version`` with the router's
+        (round 24). An owner whose slice of a commit was empty (no delta
+        edges in its closure, no installs) never sees an `update_graph`
+        call and would lag the fleet epoch — but its arrays are
+        unchanged across the commit, so its draws are identical at
+        either version and the stamp realignment is bit-harmless. Owners
+        that DID commit just bumped to exactly this value. Retired
+        engines keep their historical stamps (their logs end at the
+        epoch they served)."""
+        v = self.graph_version
+        for h in sorted(self.engines):
+            self.engines[h].graph_version = v
+        if self.fallback is not None:
+            self.fallback.graph_version = v
+        rep = self.replica
+        if rep is not None:
+            rep.engine.graph_version = v
+
+    def _update_graph_fenced(self, delta, src, dst, rsrc, rdst,
+                             from_pending, hops, feat_hops, inv_hops,
+                             m_feat):
+        """The round-23 parity twin (``fenced_commits=True``): drain the
+        routed window under the fence, then plan + mutate + invalidate
+        synchronously inside the quiet period. Served bits are identical
+        to the zero-stall path; what this buys is the simpler ordering
+        argument (nothing in flight ever observes a commit) at the cost
+        of stalling admission for the whole drain + plan + apply."""
         stale_replica_ids = None
         installs_total = 0
         with self._seq:
+            t_stall0 = self._clock()
             with self._fence:
                 while self._inflight_flushes:
                     self._fence.wait()
-                adj = self._stream_adj
                 # _mat_lock covers the whole tentative-adjacency window
                 # (add -> plan/preflight -> commit-or-rollback): a
                 # background replica refresh / migration build
@@ -2702,115 +2904,9 @@ class DistServeEngine:
                 # graph that is about to roll back (ordering: router
                 # fence -> _mat_lock, per the lock's contract)
                 with self._mat_lock:
-                    adj.add_edges(src, dst)  # validates ids first
-                    # plan + preflight EVERY consumer over the updated
-                    # adjacency before mutating ANY owner — a capacity
-                    # error must leave the whole fleet (and the
-                    # adjacency, rolled back below) untouched, never one
-                    # owner committed and the next one not
-                    try:
-                        # invalidation seeds: append sources UNION removal
-                        # sources — a removal changes its src row's draws
-                        # too. The reverse closure runs over the POST-
-                        # append, PRE-removal adjacency: reverse reach is
-                        # a superset there (removals only shrink forward
-                        # lists), so we over-invalidate, never under
-                        inv_seeds = (np.unique(np.concatenate([src, rsrc]))
-                                     if rsrc.size else np.unique(src))
-                        affected = adj.reverse_closure(inv_seeds, inv_hops)
-                        plans = []
-                        for h in sorted(self.engines):
-                            stream_h = self._owner_streams.get(h)
-                            if stream_h is None:
-                                continue
-                            topo_mask, feat_mask = self._owner_masks[h]
-                            # fixpoint over delta chains: an edge whose
-                            # src entered the mask via an EARLIER delta
-                            # edge of this batch extends it further.
-                            # EVERY dst of an in-mask src seeds a BFS —
-                            # including dsts already in the mask: a node
-                            # previously at the closure BOUNDARY (row
-                            # kept, own closure not) can now be reached
-                            # at a shallower depth and gets EXPANDED, so
-                            # its k-hop closure must enter the mask too
-                            # (the >=3-layer under-extension case; a
-                            # superset costs reserve rows, never
-                            # correctness)
-                            new_topo = topo_mask.copy()
-                            while True:
-                                seeds = np.unique(dst[new_topo[src]])
-                                if seeds.size == 0:
-                                    break
-                                add = adj.forward_closure(seeds, hops)
-                                if not (add & ~new_topo).any():
-                                    break
-                                new_topo |= add
-                            feat_seeds = np.unique(dst[new_topo[src]])
-                            new_feat = feat_mask | new_topo
-                            if feat_seeds.size:
-                                # one hop deeper than the adjacency
-                                # closure (leaves gathered, never
-                                # expanded)
-                                new_feat |= adj.forward_closure(
-                                    feat_seeds, feat_hops
-                                )
-                            topo_new = np.nonzero(new_topo & ~topo_mask)[0]
-                            installs = [(int(nd), adj.neighbors(int(nd)))
-                                        for nd in topo_new]
-                            rel = topo_mask[src]
-                            owner_delta = GraphDelta(src[rel], dst[rel])
-                            if rsrc.size:
-                                # filter removals by the NEW mask: install
-                                # rows are snapshotted from the shared
-                                # adjacency BEFORE removals apply (below),
-                                # so a freshly-installed row still carries
-                                # the doomed edge — every owner holding
-                                # the row (old or just-installed) must
-                                # delete it locally
-                                rel_r = new_topo[rsrc]
-                                owner_delta.remove_edges(rsrc[rel_r],
-                                                         rdst[rel_r])
-                            feat_new = np.nonzero(new_feat & ~feat_mask)[0]
-                            stream_h.preflight(owner_delta,
-                                               installs=installs)
-                            if feat_new.size:
-                                self._owner_feats[h].preflight_install(
-                                    feat_new
-                                )
-                            plans.append((h, new_topo, new_feat, installs,
-                                          owner_delta, feat_new))
-                        fb_delta = GraphDelta(src, dst)
-                        if rsrc.size:
-                            fb_delta.remove_edges(rsrc, rdst)
-                        fb_stream = (getattr(self.fallback._sampler,
-                                             "stream", None)
-                                     if self.fallback is not None
-                                     else None)
-                        if fb_stream is not None:
-                            fb_stream.preflight(fb_delta)
-                    except BaseException:
-                        adj.pop_edges(src, dst)
-                        if from_pending:
-                            # a failed commit must not DROP staged
-                            # arrivals (ServeEngine.update_graph's
-                            # contract): re-staged ahead of anything
-                            # staged meanwhile — arrival order is the
-                            # replay order. _lock guards pending_delta
-                            # against a concurrent stage_edges (which
-                            # never takes the fence)
-                            with self._lock:
-                                if self.pending_delta is not None:
-                                    delta.extend(self.pending_delta)
-                                self.pending_delta = delta
-                        raise
-                    # every preflight passed: apply removals to the shared
-                    # adjacency (cannot fail — existence was validated
-                    # upfront and the batch's appends just landed). Owner
-                    # install rows above were snapshotted pre-removal; the
-                    # filtered owner_delta removals bring them in line
-                    for u, v in zip(rsrc.tolist(), rdst.tolist()):
-                        adj.remove_one(int(u), int(v))
-                    self._materials_stale = True
+                    affected, plans, fb_delta = self._plan_commit_window(
+                        delta, src, dst, rsrc, rdst, from_pending,
+                        hops, feat_hops, inv_hops)
                 self.graph_version += 1
                 for (h, new_topo, new_feat, installs, owner_delta,
                      feat_new) in plans:
@@ -2846,6 +2942,7 @@ class DistServeEngine:
                         int(x) for x in stale_replica_ids
                     )
                     self.stats.replica_delta_invalidations += 1
+                self._sync_fleet_epoch()
                 # node-keyed drop (not exact keys): temporal router-cache
                 # entries are (node, t)-keyed; identical behavior for the
                 # plain int keys of this engine (see
@@ -2858,6 +2955,15 @@ class DistServeEngine:
                 self.stats.edges_deleted += int(rsrc.size)
                 self.stats.delta_cache_invalidated += invalidated
                 self.stats.delta_closure_installs += installs_total
+                # per-commit serving stall = the whole _seq hold: drain
+                # wait + plan + owner commits + invalidation (round 24)
+                t_now = self._clock()
+                stall_us = (t_now - t_stall0) * 1e6
+                self.stats.commit_stall.record_ms(stall_us)
+                self._commit_samples.append(
+                    ("graph_version", t_now, self.graph_version))
+                self._commit_samples.append(
+                    ("commit_stall_us", t_now, stall_us))
         self.journal.emit("delta_commit", -1, self.graph_version,
                           int(src.size), invalidated)
         if rsrc.size:
@@ -2869,10 +2975,136 @@ class DistServeEngine:
                "cache_invalidated": invalidated,
                "affected_seeds": int(affected.size),
                "closure_installs": installs_total,
-               "replica_invalidated": stale_replica_ids is not None}
+               "replica_invalidated": stale_replica_ids is not None,
+               "commit_stall_us": stall_us}
         if stale_replica_ids is not None and self.config.stream_replica_rebuild:
             # rebuild OUTSIDE the fence (AOT warmup costs seconds;
             # refresh_replicas takes the fence itself for the swap)
+            out["replica_refresh"] = self.refresh_replicas(
+                ids=stale_replica_ids
+            )
+        return out
+
+    def _update_graph_zerostall(self, delta, src, dst, rsrc, rdst,
+                                from_pending, hops, feat_hops, inv_hops,
+                                m_feat):
+        """Round-24 tentpole: the fleet commit with NO window drain. The
+        plan/preflight window and every owner's array build run entirely
+        off-fence under ``_commit_lock`` (owner engines flip under their
+        OWN ``_seq`` via their zero-stall `update_graph`); the router's
+        flip — version bump + replica retire — holds ``_seq`` only long
+        enough for a few reference assignments. Routed flushes sealed
+        before the flip complete against the arrays (and owner routing)
+        they pinned at seal; flushes sealed after serve the new epoch.
+        Invalidation is the post-flip `EmbeddingCache.raise_floor` pass:
+        resident pre-commit rows for affected seeds drop eagerly, and
+        the per-node floor gates the late writeback of any old-epoch
+        flush still in the air — the lazy equivalent of the fenced
+        path's synchronous `invalidate_nodes`. The visibility contract
+        is unchanged: an appended edge is visible to the next routed
+        sample after this returns; a flush RACING the commit may serve
+        either epoch (its stamp says which)."""
+        stale_replica_ids = None
+        installs_total = 0
+        with self._commit_lock:
+            # same tentative window as the fenced path, minus the fence:
+            # _mat_lock alone serializes the shared-adjacency mutation
+            # against background replica/migration materializes
+            with self._mat_lock:
+                affected, plans, fb_delta = self._plan_commit_window(
+                    delta, src, dst, rsrc, rdst, from_pending,
+                    hops, feat_hops, inv_hops)
+            new_version = self.graph_version + 1
+            # owner commits BEFORE the router flip: each is itself
+            # zero-stall (propagated `fenced_commits`), flipping under
+            # its own _seq after building off-fence. Until the router
+            # flip lands, routed flushes seal at the OLD router version
+            # while an already-flipped owner serves new-epoch draws —
+            # exactly the commit race window the epoch stamps resolve
+            # (each owner flush replays against its own stamp)
+            for (h, new_topo, new_feat, installs, owner_delta,
+                 feat_new) in plans:
+                if feat_new.size:
+                    # reserve rows are fresh (never yet gathered), so
+                    # concurrent owner traffic cannot observe the write
+                    self._owner_feats[h].install_rows(
+                        feat_new, m_feat[feat_new]
+                    )
+                if len(owner_delta) or installs:
+                    self.engines[h].update_graph(
+                        owner_delta, installs=installs,
+                        invalidate=affected,
+                    )
+                    installs_total += len(installs)
+                self._owner_masks[h] = (new_topo, new_feat)
+            if self.fallback is not None:
+                self.fallback.update_graph(
+                    fb_delta, invalidate=affected
+                )
+            # THE router flip: O(1) assignments under _seq — no drain,
+            # no in-flight wait. _seal_assembled stamps and routes under
+            # this same lock, so version, replica routing and the stamp
+            # stay one epoch per flush.
+            with self._seq:
+                t_stall0 = self._clock()
+                self.graph_version = new_version
+                rep = self.replica
+                if (rep is not None and rep.ids.size
+                        and np.intersect1d(rep.ids, affected).size):
+                    # consumer (b), deferred flavor: the stale replica
+                    # unroutes AT the flip; in-flight replica legs
+                    # complete against the retired engine's pinned
+                    # arrays and replay under their old-epoch stamp
+                    stale_replica_ids = rep.ids
+                    if rep.engine.config.record_dispatches:
+                        self._retired_replicas.append(rep.engine)
+                    else:
+                        self._retired_stats.merge(rep.engine.stats)
+                    self.replica = None
+                    self.replica_version += 1
+                t_now = self._clock()
+                stall_us = (t_now - t_stall0) * 1e6
+            self._sync_fleet_epoch()
+            # post-flip deferred invalidation (consumer (a)): floors gate
+            # stale writebacks from old-epoch in-flight flushes; the
+            # replica's exact keys drop conservatively as before
+            if stale_replica_ids is not None:
+                self.cache.invalidate_keys(
+                    int(x) for x in stale_replica_ids
+                )
+            invalidated = self.cache.raise_floor(
+                (int(x) for x in affected), new_version
+            )
+            with self._lock:
+                if stale_replica_ids is not None:
+                    self.stats.replica_delta_invalidations += 1
+                self.stats.graph_deltas += 1
+                self.stats.delta_edges += int(src.size)
+                self.stats.edges_deleted += int(rsrc.size)
+                self.stats.delta_cache_invalidated += invalidated
+                self.stats.delta_closure_installs += installs_total
+                self.stats.commit_stall.record_ms(stall_us)
+                self._commit_samples.append(
+                    ("graph_version", t_now, new_version))
+                self._commit_samples.append(
+                    ("commit_stall_us", t_now, stall_us))
+        self.journal.emit("delta_commit", -1, self.graph_version,
+                          int(src.size), invalidated)
+        if rsrc.size:
+            self.journal.emit("edge_delete", -1, self.graph_version,
+                              int(rsrc.size))
+        out = {"edges": int(src.size),
+               "edges_deleted": int(rsrc.size),
+               "graph_version": self.graph_version,
+               "cache_invalidated": invalidated,
+               "affected_seeds": int(affected.size),
+               "closure_installs": installs_total,
+               "replica_invalidated": stale_replica_ids is not None,
+               "commit_stall_us": stall_us}
+        if stale_replica_ids is not None and self.config.stream_replica_rebuild:
+            # rebuild outside the commit lock's critical tail (AOT
+            # warmup costs seconds; refresh_replicas fences itself for
+            # the swap)
             out["replica_refresh"] = self.refresh_replicas(
                 ids=stale_replica_ids
             )
@@ -3025,6 +3257,10 @@ class DistServeEngine:
                 m["model"], params_snapshot, sampler, feat_r,
                 m["shard_config"],
             )
+            # a mid-run engine is born AT the current fleet epoch: its
+            # dispatch-log stamps must line up with the router's (round
+            # 24 epoch-filtered replay)
+            eng.graph_version = self.graph_version
             eng.warmup()
         with self._seq:
             with self._fence:
@@ -3167,6 +3403,8 @@ class DistServeEngine:
         eng = ServeEngine(
             m["model"], params_snapshot, sampler, feat_r, m["shard_config"]
         )
+        # born at the current fleet epoch (round-24 stamp alignment)
+        eng.graph_version = self.graph_version
         eng.warmup()
         return eng, (new_topo, new_feat), params_snapshot, new_stream, feat_r
 
@@ -3583,8 +3821,14 @@ class DistServeEngine:
                      "committed ownership range flips", labels)
         reg.gauge_fn(f"{prefix}_graph_version",
                      lambda: self.graph_version,
-                     "fenced streaming-graph delta commits applied",
+                     "streaming-graph delta commits applied (the fleet "
+                     "epoch routed flushes pin against)",
                      labels)
+        reg.histogram(f"{prefix}_commit_stall_us",
+                      "per-commit routed-serving stall, µs (fenced: the "
+                      "whole drain+apply hold; zero-stall: the _seq "
+                      "flip)", labels,
+                      fn=lambda: self.stats.commit_stall)
         reg.gauge_fn(f"{prefix}_delta_pending_edges",
                      lambda: (len(self.pending_delta)
                               if self.pending_delta is not None else 0),
@@ -3811,6 +4055,14 @@ class DistServeEngine:
         rec = comm_mod.EXCHANGE_SPANS
         if rec is not None and len(rec):
             sources.append(("comm.exchange", rec))
+        if self._commit_samples:
+            # round-24 counter lane: the fleet graph-version staircase +
+            # per-commit stall, rendered as ph:"C" tracks
+            from .engine import _CommitCounterSource
+
+            sources.append(
+                ("router.commits", _CommitCounterSource(self._commit_samples))
+            )
         sources.extend(extra_sources)
         return _export_chrome_trace(path, sources, metadata)
 
@@ -3995,6 +4247,7 @@ def replay_fleet_oracle(
     params,
     full_sampler_factory: Callable[[], object],
     full_feature,
+    graph_version: Optional[int] = None,
 ) -> Dict[int, List[np.ndarray]]:
     """`replay_shard_oracle` extended over the WHOLE fleet: owners + the
     hot-set replica + the full-graph fallback + every engine RETIRED by a
@@ -4013,7 +4266,17 @@ def replay_fleet_oracle(
     bit-matches one candidate, which is exactly the fault-parity
     acceptance the probe and tests/test_faults.py assert: faults and
     failovers change WHO computes, never change any completed bit away
-    from an offline full-graph replay."""
+    from an offline full-graph replay.
+
+    Round 24 — epoch-aware replay: with ``graph_version=v`` set,
+    ``full_sampler_factory`` must birth a sampler over the graph AS OF
+    fleet epoch ``v``; every engine's WHOLE log still replays through it
+    (the key stream must advance exactly as the live run's did), but
+    only rows whose aligned ``dispatch_graph_versions`` stamp equals
+    ``v`` are collected. Under zero-stall commits a run's log spans
+    epochs — each completed row is bit-equal to the oracle of the epoch
+    it SEALED against, which is exactly what the per-epoch sweep
+    (one call per version, candidates unioned) asserts."""
     from ..inference import _cached_apply, batch_logits
 
     apply = _cached_apply(model)
@@ -4034,10 +4297,19 @@ def replay_fleet_oracle(
     served: Dict[int, List[np.ndarray]] = {}
     for h in sorted(engines, key=str):
         sampler = full_sampler_factory()
-        for padded, nvalid in engines[h].dispatch_log:
+        eng = engines[h]
+        gvs = getattr(eng, "dispatch_graph_versions", None)
+        for ix, (padded, nvalid) in enumerate(eng.dispatch_log):
+            # the replay ALWAYS computes (each batch advances the
+            # sampler's key stream exactly like the live dispatch did);
+            # the epoch filter only gates collection
             logits = np.asarray(
                 batch_logits(apply, params, sampler, full_feature, padded)
             )
+            if graph_version is not None and (
+                    gvs is None or ix >= len(gvs)
+                    or gvs[ix] != graph_version):
+                continue
             for i in range(nvalid):
                 served.setdefault(int(padded[i]), []).append(logits[i])
     return served

@@ -721,6 +721,16 @@ class ServeConfig:
     # inject a deterministic sequence here; production maps wall time to
     # stream event time.
     stream_retention_clock: Optional[Callable[[], float]] = None
+    # round-24 zero-stall commits: False (default) = `update_graph` and
+    # the lifecycle commits build the post-commit device arrays OFF the
+    # fence and flip them under _seq only — no in-flight drain; flushes
+    # are epoch-pinned (each seals against the graph arrays of its
+    # dispatch index, logs its graph_version) and the fence's three
+    # consumers go version-aware (cache graph-version floors, post-flip
+    # replica retire, post-flip adapt_tiers). True = the round-17..23
+    # drain-ordered fence, bit-identical, kept as the parity twin.
+    # Re-provisioning (a shape change) always drains in either mode.
+    fenced_commits: bool = False
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         if self.buckets is None:
@@ -1044,6 +1054,13 @@ class ServeStats:
     # per-tenant p99 the admission work is judged by
     tenant_latency: Dict[str, LatencyHistogram] = field(default_factory=dict)
     spans: SpanRecorder = field(default_factory=SpanRecorder)
+    # round-24 per-commit serving stall, in MICROSECONDS (the histogram
+    # is unit-agnostic; µs keeps flip-only stalls resolvable): fenced
+    # mode records the whole drain+fenced-work hold, zero-stall mode the
+    # _seq flip hold — the drain-vs-flip evidence `delta_table` prices
+    commit_stall: LatencyHistogram = field(
+        default_factory=lambda: LatencyHistogram(min_ms=1e-2, max_ms=1e9)
+    )
 
     def tenant_hist(self, tenant: str) -> LatencyHistogram:
         """The tenant's latency histogram, created on first use. Callers
@@ -1098,6 +1115,7 @@ class ServeStats:
         self.cache.merge(other.cache)
         self.latency.merge(other.latency)
         self.spans.merge(other.spans)
+        self.commit_stall.merge(other.commit_stall)
         return self
 
     def snapshot(self) -> Dict[str, object]:
@@ -1137,6 +1155,7 @@ class ServeStats:
                 for t in sorted(self.tenant_latency)
             },
             "overlap": self.spans.overlap_summary(),
+            "commit_stall_us": self.commit_stall.snapshot(),
         }
 
 
@@ -1161,7 +1180,7 @@ class _Flush:
 
     __slots__ = ("keys", "slots", "params", "seeds", "bucket", "ds", "key",
                  "padded", "extra", "error", "fid", "ids", "rids",
-                 "tenant_ix")
+                 "tenant_ix", "graph_version", "binding")
 
     def __init__(self, keys, slots, params):
         self.keys = keys
@@ -1172,6 +1191,12 @@ class _Flush:
         self.ds = None
         self.key = None
         self.padded = None
+        # round-24 epoch pin, stamped at seal (under _seq): the graph
+        # version this flush dispatches against, plus the fused program's
+        # persistent-argument snapshot (table, map, graph) of that epoch —
+        # a zero-stall commit rebinding mid-flight cannot retarget it
+        self.graph_version = 0
+        self.binding = None
         self.ids = None        # int64 [n] seed ids (sealed)
         self.rids = None       # int64 [n] journal rids (sealed)
         self.tenant_ix = None  # int32 [n] interned tenant indices (sealed)
@@ -1435,7 +1460,8 @@ def _resolve_block(eng, fl, logits: np.ndarray, now: float) -> None:
     _pop_inflight_many(eng, fl.keys)
     rows = list(logits[:n])  # n row views, made at C speed
     if eng.cache.capacity != 0:
-        eng.cache.put_many(fl.keys, eng.params_version, rows)
+        eng.cache.put_many(fl.keys, eng.params_version, rows,
+                           gv=fl.graph_version)
     for slot, row in zip(slots, rows):
         slot.value = row
         slot.resolved = True
@@ -1443,6 +1469,20 @@ def _resolve_block(eng, fl, logits: np.ndarray, now: float) -> None:
         if ev is not None:
             ev.set()
     _record_waiter_latency(eng, slots, now)
+
+
+class _CommitCounterSource:
+    """`counter_samples()` adapter over an engine's per-commit sample
+    ring — `trace.chrome_trace_events` renders any source bearing
+    ``counter_samples()`` as ``ph:"C"`` counter tracks, so the
+    graph-version staircase and the per-commit stall ride the trace's
+    counter lane (observe-only; round 24)."""
+
+    def __init__(self, samples):
+        self._samples = samples
+
+    def counter_samples(self):
+        return list(self._samples)
 
 
 class ServeEngine:
@@ -1593,6 +1633,12 @@ class ServeEngine:
         else:
             self.retention = None
         self.dispatch_log: List[Tuple[np.ndarray, int]] = []
+        # round-24 epoch stamps, index-aligned with dispatch_log: entry i
+        # is the graph_version flush i sealed (and dispatched) against —
+        # the replay tooling's per-epoch filter. A parallel list, not a
+        # tuple-shape change: the log entry tuples are pinned by tests
+        # and the round-21 CI smoke.
+        self.dispatch_graph_versions: List[int] = []
         # queue state (round 20): _pending is the STRIPED pending store —
         # per-stripe dicts of slots not yet flushed (merged arrival order
         # = the rounds-8–19 FIFO, bit for bit), per-stripe locks so
@@ -1607,6 +1653,10 @@ class ServeEngine:
         # tenant, node_id)] — a bounded ring: sustained overload (when it
         # fills) must not leak
         self.shed_log = collections.deque(maxlen=65536)
+        # round-24 per-commit counter samples (name, t, value) for the
+        # Chrome-trace counter lane: graph_version + commit_stall_us at
+        # every commit flip. Bounded ring; observe-only.
+        self._commit_samples = collections.deque(maxlen=4096)
         # round-20 array-native flush internals: per-engine tenant-name
         # interning for the flush-level tenant-index arrays (grown on
         # demand at seal; order = first-seen)
@@ -1627,6 +1677,12 @@ class ServeEngine:
         self._window = threading.BoundedSemaphore(self.config.max_in_flight)
         self._inflight_flushes = 0             # guarded by _lock
         self._dispatch_index = 0               # guarded by _seq
+        # round-24 commit serialization: one zero-stall commit at a time
+        # (update_graph / expire_edges / compact_graph / the lifecycle
+        # daemons) — the off-fence build phase must not interleave with
+        # another commit's. RLock: a commit's retention pass may re-enter.
+        # Traffic never takes it; it orders only commit vs commit.
+        self._commit_lock = threading.RLock()
         # parity escape hatch: True forces the pre-round-22 per-slot
         # resolve loop — the reference the bit-parity tests (and
         # bench_frontend's in-run parity legs) compare the block
@@ -2072,13 +2128,21 @@ class ServeEngine:
                 fl.extra = tuple(
                     pad_seed_batch(e, fl.bucket) for e in extras
                 )
+            # round-24 epoch pin (caller holds _seq — the commit flip
+            # also runs under _seq, so the stamp, the binding snapshot,
+            # and the upcoming key draw are all of ONE epoch)
+            fl.graph_version = self.graph_version
             if self.config.record_dispatches:
                 self.dispatch_log.append(self._dispatch_log_entry(fl, padded))
+                self.dispatch_graph_versions.append(fl.graph_version)
             if self._programs is not None:
                 # fused path: draw the key in dispatch order, defer the
-                # sample into the one-program dispatch stage
+                # sample into the one-program dispatch stage; the binding
+                # snapshot pins the graph arrays this flush will execute
+                # against even if a zero-stall commit rebinds mid-flight
                 fl.key = draw_sample_key(self._sampler)
                 fl.padded = padded
+                fl.binding = self._programs.binding()
             else:
                 fl.ds = self._split_sample(fl, padded)
         except BaseException as exc:  # resolved (with the error) by stage 3
@@ -2110,7 +2174,7 @@ class ServeEngine:
         if fl.ds is None and self._programs is not None:
             logits = np.asarray(
                 self._programs(fl.bucket, fl.params, fl.key, fl.padded,
-                               *(fl.extra or ()))
+                               *(fl.extra or ()), binding=fl.binding)
             )
             n_exec = 1
         else:
@@ -2162,7 +2226,8 @@ class ServeEngine:
                     if fl.error is None:
                         row = logits[i]
                         if slot.version == self.params_version:
-                            self.cache.put(k, slot.version, row)
+                            self.cache.put(k, slot.version, row,
+                                           gv=fl.graph_version)
                         slot.resolve(row)
                     else:
                         slot.resolve(None, error=fl.error)
@@ -2478,6 +2543,10 @@ class ServeEngine:
         reg.histogram(f"{prefix}_latency_ms",
                       "end-to-end request latency (submit -> resolve)",
                       labels, fn=lambda: self.stats.latency)
+        reg.histogram(f"{prefix}_commit_stall_us",
+                      "per-commit serving stall, µs (fenced: whole "
+                      "drain; zero-stall: the _seq flip hold)",
+                      labels, fn=lambda: self.stats.commit_stall)
         if self.workload is not None:
             self.workload.register_metrics(
                 reg, prefix=f"{prefix}_workload", labels=labels, owners=(0,)
@@ -2498,6 +2567,13 @@ class ServeEngine:
         sources: List = [("serve.spans", self.stats.spans)]
         if self.journal.enabled:
             sources.append(("serve.journal", self.journal))
+        if self._commit_samples:
+            # round-24 counter lane: graph_version staircase + per-commit
+            # stall alongside the flush lanes
+            sources.append(
+                ("serve.commits",
+                 _CommitCounterSource(self._commit_samples))
+            )
         if self.workload is not None and self.workload.counters is not None:
             # the round-13 counter lane: sampled workload series (head
             # coverage, observed seeds) graph under the flush lanes
@@ -2559,6 +2635,8 @@ class ServeEngine:
                     self._dispatch_index += 1
                     if self.config.record_dispatches:
                         self.dispatch_log.append((padded.copy(), 0))
+                        self.dispatch_graph_versions.append(
+                            self.graph_version)
                     ds = sample_batch(self._sampler, padded)
             np.asarray(forward_logits(self._apply, params, self._feature, ds))
             times[b] = time.perf_counter() - t0
@@ -2739,9 +2817,23 @@ class ServeEngine:
         masked ``ts -> +inf`` lane writes, and a `StreamCapacityError`
         triggers one reactive bank grow + sealed-program rebuild when
         ``stream_provision_tiles`` > 0. All under ONE fence, one version
-        bump, one closure-exact invalidation pass."""
-        from ..stream import StreamCapacityError
+        bump, one closure-exact invalidation pass.
 
+        Round 24 — with ``fenced_commits=False`` (the default) the same
+        commit is ZERO-STALL: the post-commit device arrays build fully
+        off-fence (``stream.apply(defer_publish=True)``), then flip under
+        ``_seq`` only — no in-flight drain. Flushes already in flight
+        complete against the immutable old arrays their seal pinned
+        (epoch pinning); the fence's three consumers go version-aware
+        (cache graph-version floors via `EmbeddingCache.raise_floor`,
+        post-flip replica retire in the router, post-flip adapt_tiers).
+        The visibility contract is unchanged: the delta is visible to
+        every flush sealed after this returns; a flush racing the commit
+        legitimately serves whichever epoch its seal landed in, and logs
+        it in ``dispatch_graph_versions``. Re-provisioning (a shape
+        change) always takes the full fenced path — a sealed executable
+        rebuild cannot overlap an in-flight flush bound to the old
+        shapes."""
         stream = getattr(self._sampler, "stream", None)
         if stream is None:
             raise ValueError(
@@ -2758,11 +2850,27 @@ class ServeEngine:
         if n_edges == 0 and not installs:
             return {"edges": 0, "installs": 0, "cache_invalidated": 0,
                     "affected_seeds": 0, "graph_version": self.graph_version}
+        if self.config.fenced_commits:
+            return self._update_graph_fenced(stream, delta, installs,
+                                             invalidate, n_edges,
+                                             from_pending)
+        return self._update_graph_zerostall(stream, delta, installs,
+                                            invalidate, n_edges,
+                                            from_pending)
+
+    def _update_graph_fenced(self, stream, delta, installs, invalidate,
+                             n_edges, from_pending) -> Dict[str, object]:
+        """The round-17..23 drain-ordered commit, bit-identical — the
+        ``fenced_commits=True`` parity twin (and the fallback every
+        re-provisioning commit takes in either mode)."""
+        from ..stream import StreamCapacityError
+
         applied = False
         provisioned = False
         expired = None
         try:
             with self._seq:
+                t_stall0 = self._clock()
                 with self._fence:
                     while self._inflight_flushes:
                         self._fence.wait()
@@ -2881,6 +2989,15 @@ class ServeEngine:
                     self.stats.edges_deleted += summary.get(
                         "edges_deleted", 0
                     )
+                    # µs, observe-only: the whole drain + fenced work is
+                    # serving stall in this mode (nothing seals under it)
+                    t_now = self._clock()
+                    stall_us = (t_now - t_stall0) * 1e6
+                    self.stats.commit_stall.record_ms(stall_us)
+                    self._commit_samples.append(
+                        ("graph_version", t_now, self.graph_version))
+                    self._commit_samples.append(
+                        ("commit_stall_us", t_now, stall_us))
         except BaseException:
             # `stream.apply` is atomic (preflight before any mutation),
             # so a commit that raised BEFORE apply returned left the
@@ -2920,6 +3037,168 @@ class ServeEngine:
                 self.tier_adapt_errors += 1
         return summary
 
+    def _update_graph_zerostall(self, stream, delta, installs, invalidate,
+                                n_edges, from_pending) -> Dict[str, object]:
+        """Round-24 tentpole: build everything off-fence, flip under
+        ``_seq`` only. Phases:
+
+        1. BUILD (commit lock, no fence): ``stream.apply(...,
+           defer_publish=True)`` mutates host mirrors and stages the
+           post-commit device arrays without touching what `graph()`
+           serves; retention expiry stages into the same flip; the
+           affected-closure set is computed from the updated host
+           adjacency. Traffic seals and dispatches throughout.
+        2. FLIP (``_seq`` only — the measured stall): `stream.publish()`
+           (an O(1) ref swap), the ``graph_version`` bump, `rebind` of
+           the sealed programs' graph arguments, prefetch-intent drop.
+           A flush sealing before the flip pinned the old binding and
+           stamped the old version; one sealing after gets the new —
+           never a mix (the stamp, the binding snapshot and the key draw
+           share one ``_seq`` hold in `_seal_assembled`).
+        3. POST-FLIP (no fence): the closure-touched nodes' cache
+           graph-version floors rise (`EmbeddingCache.raise_floor` —
+           eager drop of resident old-epoch entries plus the writeback
+           gate that stops an old-epoch in-flight flush from
+           re-inserting a stale row after it resolves), stats/journal,
+           and the deferred adapt_tiers pass.
+
+        In-flight correctness is the round-11 jit-argument rule: sealed
+        executables take the graph as ARGUMENTS and the stream's device
+        sync copies on write (`_scatter_rows`), so the old array objects
+        a flush pinned are immutable — it completes bit-exactly against
+        its epoch, and `replay_fleet_oracle(graph_version=...)` proves
+        it row by row. A `StreamCapacityError` (shape change needed)
+        falls back to the FULL fenced commit: reprovisioning swaps the
+        executables' graph avals, which an in-flight flush bound to the
+        old shapes must not straddle."""
+        from ..stream import StreamCapacityError
+
+        applied = False
+        expired = None
+        try:
+            with self._commit_lock:
+                try:
+                    summary = stream.apply(delta, installs=installs,
+                                           defer_publish=True)
+                except StreamCapacityError:
+                    # atomic apply: nothing moved — re-run the whole
+                    # commit fenced (it provisions + retries when
+                    # configured, or re-raises the capacity error)
+                    return self._update_graph_fenced(
+                        stream, delta, installs, invalidate, n_edges,
+                        from_pending,
+                    )
+                applied = True
+                new_version = self.graph_version + 1
+                if (self.retention is not None
+                        and getattr(stream, "temporal", False)):
+                    cut = self.retention.cutoff_for(delta.max_ts())
+                    if cut is not None:
+                        exp = stream.expire_edges(cut, defer_publish=True)
+                        self.retention.mark_expired(cut)
+                        if exp["edges_expired"]:
+                            expired = exp
+                        summary["edges_expired"] = exp["edges_expired"]
+                        summary["retention_cutoff"] = cut
+                # invalidation closure, off-fence: the host adjacency is
+                # already post-commit (only the device publish defers),
+                # so this is the same set the fenced twin computes
+                if invalidate is not None:
+                    affected = np.asarray(list(invalidate), np.int64)
+                    if expired is not None:
+                        hops = self.config.stream_invalidate_hops
+                        if hops is None:
+                            hops = max(len(self._sampler.sizes) - 1, 0)
+                        affected = np.union1d(
+                            affected,
+                            stream.affected_seeds(expired["sources"],
+                                                  hops),
+                        )
+                else:
+                    srcs = (np.asarray(delta.sources(), np.int64)
+                            if n_edges else np.array([], np.int64))
+                    if expired is not None:
+                        srcs = np.union1d(srcs, expired["sources"])
+                    if srcs.size:
+                        hops = self.config.stream_invalidate_hops
+                        if hops is None:
+                            hops = max(len(self._sampler.sizes) - 1, 0)
+                        affected = stream.affected_seeds(srcs, hops)
+                    else:
+                        affected = np.array([], np.int64)
+                table = imap = None
+                if (self._programs is not None
+                        and hasattr(self._feature, "jit_gather_spec")):
+                    from ..inference import feature_gather_spec
+
+                    table, imap = feature_gather_spec(self._feature)
+                # ---- the flip: the only serving-visible moment
+                with self._seq:
+                    t_stall0 = self._clock()
+                    stream.publish()
+                    self.graph_version = new_version
+                    if self._programs is not None:
+                        self._programs.rebind(
+                            graph=self._sampler.fused_graph_arrays(),
+                            table=table, index_map=imap,
+                        )
+                    self._cancel_prefetch()
+                    stall_us = (self._clock() - t_stall0) * 1e6
+                # ---- post-flip deferred passes
+                invalidated = self.cache.raise_floor(
+                    (int(x) for x in affected), new_version
+                )
+                with self._lock:
+                    if expired is not None:
+                        self.stats.edges_expired += (
+                            expired["edges_expired"]
+                        )
+                    self.stats.graph_deltas += 1
+                    self.stats.delta_edges += n_edges
+                    self.stats.delta_tile_writes += summary["pad_writes"]
+                    self.stats.delta_tile_spills += summary["tile_spills"]
+                    self.stats.delta_cache_invalidated += invalidated
+                    self.stats.edges_deleted += summary.get(
+                        "edges_deleted", 0
+                    )
+                    self.stats.commit_stall.record_ms(stall_us)
+                    t_now = self._clock()
+                    self._commit_samples.append(
+                        ("graph_version", t_now, new_version))
+                    self._commit_samples.append(
+                        ("commit_stall_us", t_now, stall_us))
+        except BaseException:
+            # same re-stage rule as the fenced twin: apply is atomic, so
+            # a pre-apply failure leaves the staged edges recoverable
+            if from_pending and n_edges and not applied:
+                with self._lock:
+                    if self.pending_delta is not None:
+                        delta.extend(self.pending_delta)
+                    self.pending_delta = delta
+            raise
+        self.journal.emit("delta_commit", -1, self.graph_version,
+                          n_edges, invalidated)
+        if summary.get("edges_deleted"):
+            self.journal.emit("edge_delete", -1, self.graph_version,
+                              summary["edges_deleted"])
+        if expired is not None:
+            self.journal.emit("retention_expire", -1, self.graph_version,
+                              expired["edges_expired"], expired["nodes"])
+        summary["cache_invalidated"] = invalidated
+        summary["provisioned"] = False
+        summary["affected_seeds"] = int(affected.size)
+        summary["graph_version"] = self.graph_version
+        summary["commit_stall_us"] = stall_us
+        if (self.config.stream_adapt_tiers
+                and self._tier_feature is not None
+                and self.workload is not None):
+            # consumer (c), now an explicitly post-flip deferred pass
+            try:
+                summary["tier_adapt"] = self.adapt_tiers()
+            except Exception:
+                self.tier_adapt_errors += 1
+        return summary
+
     # -- graph lifecycle (round 21; quiver_tpu.lifecycle) ------------------
 
     def expire_edges(self, t_commit=None) -> Dict[str, object]:
@@ -2949,29 +3228,62 @@ class ServeEngine:
             return {"edges_expired": 0, "nodes": 0,
                     "cache_invalidated": 0,
                     "graph_version": self.graph_version}
-        with self._seq:
-            with self._fence:
-                while self._inflight_flushes:
-                    self._fence.wait()
-                self._cancel_prefetch()
-                exp = stream.expire_edges(cut)
+        if self.config.fenced_commits:
+            with self._seq:
+                with self._fence:
+                    while self._inflight_flushes:
+                        self._fence.wait()
+                    self._cancel_prefetch()
+                    exp = stream.expire_edges(cut)
+                    self.retention.mark_expired(cut)
+                    invalidated = 0
+                    if exp["edges_expired"]:
+                        self.graph_version += 1
+                        if self._programs is not None:
+                            self._programs.rebind(
+                                graph=self._sampler.fused_graph_arrays()
+                            )
+                        hops = self.config.stream_invalidate_hops
+                        if hops is None:
+                            hops = max(len(self._sampler.sizes) - 1, 0)
+                        affected = stream.affected_seeds(exp["sources"],
+                                                         hops)
+                        invalidated = self.cache.invalidate_nodes(
+                            int(x) for x in affected
+                        )
+                        self.stats.edges_expired += exp["edges_expired"]
+                        self.stats.delta_cache_invalidated += invalidated
+        else:
+            # zero-stall retention (round 24): stage the masked lane
+            # writes off-fence, flip + rebind under _seq only, raise the
+            # expired closure's cache floors post-flip
+            with self._commit_lock:
+                exp = stream.expire_edges(cut, defer_publish=True)
                 self.retention.mark_expired(cut)
                 invalidated = 0
                 if exp["edges_expired"]:
-                    self.graph_version += 1
-                    if self._programs is not None:
-                        self._programs.rebind(
-                            graph=self._sampler.fused_graph_arrays()
-                        )
+                    new_version = self.graph_version + 1
                     hops = self.config.stream_invalidate_hops
                     if hops is None:
                         hops = max(len(self._sampler.sizes) - 1, 0)
                     affected = stream.affected_seeds(exp["sources"], hops)
-                    invalidated = self.cache.invalidate_nodes(
-                        int(x) for x in affected
+                    with self._seq:
+                        t_stall0 = self._clock()
+                        stream.publish()
+                        self.graph_version = new_version
+                        if self._programs is not None:
+                            self._programs.rebind(
+                                graph=self._sampler.fused_graph_arrays()
+                            )
+                        self._cancel_prefetch()
+                        stall_us = (self._clock() - t_stall0) * 1e6
+                    invalidated = self.cache.raise_floor(
+                        (int(x) for x in affected), new_version
                     )
-                    self.stats.edges_expired += exp["edges_expired"]
-                    self.stats.delta_cache_invalidated += invalidated
+                    with self._lock:
+                        self.stats.edges_expired += exp["edges_expired"]
+                        self.stats.delta_cache_invalidated += invalidated
+                        self.stats.commit_stall.record_ms(stall_us)
         if exp["edges_expired"]:
             self.journal.emit("retention_expire", -1, self.graph_version,
                               exp["edges_expired"], exp["nodes"])
@@ -3002,15 +3314,40 @@ class ServeEngine:
         self.journal.emit("compact_begin", -1, self.graph_version,
                           len(plan["retired"]) + len(plan["trims"]),
                           len(plan["moves"]))
-        with self._seq:
-            with self._fence:
-                while self._inflight_flushes:
-                    self._fence.wait()
-                # staged prefetch intent survives a compaction (bytes
-                # and closures are untouched) — no _cancel_prefetch
-                summary = stream.apply_compaction(plan)
-                self.stats.tiles_reclaimed += summary["tiles_reclaimed"]
-                self.stats.compactions += 1
+        if self.config.fenced_commits:
+            with self._seq:
+                with self._fence:
+                    while self._inflight_flushes:
+                        self._fence.wait()
+                    # staged prefetch intent survives a compaction (bytes
+                    # and closures are untouched) — no _cancel_prefetch
+                    summary = stream.apply_compaction(plan)
+                    self.stats.tiles_reclaimed += (
+                        summary["tiles_reclaimed"]
+                    )
+                    self.stats.compactions += 1
+        else:
+            # zero-stall (round 24): stage the relocated rows off-fence,
+            # flip under _seq. Compaction is observe-only on bits (no
+            # version bump), so there is nothing to invalidate and no
+            # rebind of contents beyond the array refs themselves.
+            with self._commit_lock:
+                summary = stream.apply_compaction(plan,
+                                                  defer_publish=True)
+                with self._seq:
+                    t_stall0 = self._clock()
+                    stream.publish()
+                    if self._programs is not None:
+                        self._programs.rebind(
+                            graph=self._sampler.fused_graph_arrays()
+                        )
+                    stall_us = (self._clock() - t_stall0) * 1e6
+                with self._lock:
+                    self.stats.tiles_reclaimed += (
+                        summary["tiles_reclaimed"]
+                    )
+                    self.stats.compactions += 1
+                    self.stats.commit_stall.record_ms(stall_us)
         self.journal.emit("compact_commit", -1, self.graph_version,
                           summary["tiles_reclaimed"], summary["moves"])
         summary["graph_version"] = self.graph_version

@@ -889,6 +889,15 @@ class StreamingTiledGraph:
         self._bd_dev = None
         self._tiles_dev = None
         self._tt_dev = None
+        # zero-stall (round 24) double buffer: commits run with
+        # defer_publish=True build the post-commit device arrays HERE
+        # (basing on staged-if-present, so apply + expire in one commit
+        # accumulate), leaving the live ``_*_dev`` refs — what `graph()`
+        # serves and in-flight flushes hold — untouched until `publish()`
+        # flips them in O(1)
+        self._staged_bd = None
+        self._staged_tiles = None
+        self._staged_tt = None
         if device_arrays:
             import jax.numpy as jnp
 
@@ -1293,6 +1302,7 @@ class StreamingTiledGraph:
 
     def apply(self, delta: GraphDelta,
               installs: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
+              defer_publish: bool = False,
               ) -> Dict[str, int]:
         """Commit one delta batch: host pad-lane writes / spills /
         installs, then ONE batched device tile swap + one bd swap.
@@ -1301,8 +1311,10 @@ class StreamingTiledGraph:
         raising apply leaves host, device, versions, and the adjacency
         untouched. Returns the commit summary. Callers serving traffic
         go through ``engine.update_graph`` (which fences in-flight
-        flushes first); the stream's own lock only orders bare
-        concurrent callers."""
+        flushes first, or — zero-stall mode — passes
+        ``defer_publish=True`` so the new device arrays stage without
+        touching what `graph()` serves until `publish()`); the stream's
+        own lock only orders bare concurrent callers."""
         src, dst = delta.edges() if delta is not None else (
             np.array([], np.int64), np.array([], np.int64)
         )
@@ -1358,8 +1370,8 @@ class StreamingTiledGraph:
             self.version += 1
             changed = np.fromiter(touched_bd, np.int64, len(touched_bd))
             self.node_version[changed] = self.version
-            n_tiles, n_bd = self._sync_device_locked(touched_tiles,
-                                                     touched_bd)
+            n_tiles, n_bd = self._sync_device_locked(
+                touched_tiles, touched_bd, defer=defer_publish)
             self.stats["pad_writes"] += pad_writes
             self.stats["tile_spills"] += spills
             self.stats["installs"] += len(installs)
@@ -1386,7 +1398,8 @@ class StreamingTiledGraph:
         return self.apply(None, installs=rows)
 
     # -------------------------------------------------- lifecycle (r21)
-    def expire_edges(self, cutoff) -> Dict[str, object]:
+    def expire_edges(self, cutoff, defer_publish: bool = False
+                     ) -> Dict[str, object]:
         """TTL retention commit: mask every edge with ``ts <= cutoff``
         by overwriting its timestamp lane with ``+inf`` — NO lane
         shifts, so the expired stream stays the exact bit-dual of the
@@ -1432,8 +1445,8 @@ class StreamingTiledGraph:
             self.version += 1
             changed = np.fromiter(touched_bd, np.int64, len(touched_bd))
             self.node_version[changed] = self.version
-            n_tiles, n_bd = self._sync_device_locked(touched_tiles,
-                                                     touched_bd)
+            n_tiles, n_bd = self._sync_device_locked(
+                touched_tiles, touched_bd, defer=defer_publish)
             self.stats["edges_expired"] += n_exp
             self.stats["tile_rows_swapped"] += n_tiles
             self.stats["bd_rows_swapped"] += n_bd
@@ -1473,7 +1486,8 @@ class StreamingTiledGraph:
             plan["moves"] = moves
             return plan
 
-    def apply_compaction(self, plan: Dict[str, object]) -> Dict[str, int]:
+    def apply_compaction(self, plan: Dict[str, object],
+                         defer_publish: bool = False) -> Dict[str, int]:
         """Apply a `plan_compaction` plan: release retired ranges, trim
         over-allocated tails, relocate planned nodes downward (verbatim
         row copies through the ``base`` indirection). STRICTLY
@@ -1530,8 +1544,8 @@ class StreamingTiledGraph:
                 touched_tiles.update(range(new, new + rows))
                 touched_bd.add(u)
                 moved += 1
-            n_tiles, n_bd = self._sync_device_locked(touched_tiles,
-                                                     touched_bd)
+            n_tiles, n_bd = self._sync_device_locked(
+                touched_tiles, touched_bd, defer=defer_publish)
             self.stats["tiles_reclaimed"] += freed
             self.stats["compactions"] += 1
             self.stats["tile_rows_swapped"] += n_tiles
@@ -1573,6 +1587,11 @@ class StreamingTiledGraph:
             if self._tiles_dev is not None:
                 import jax.numpy as jnp
 
+                # a full re-upload supersedes any staged (defer_publish)
+                # arrays — their shapes are the OLD bank size; drop them
+                self._staged_bd = None
+                self._staged_tiles = None
+                self._staged_tt = None
                 self._tiles_dev = jnp.asarray(self.tiles)
                 if self.ttiles is not None:
                     self._tt_dev = jnp.asarray(self.ttiles)
@@ -1770,31 +1789,81 @@ class StreamingTiledGraph:
             finite = ts_row[np.isfinite(ts_row)]
             self._min_ts[node] = finite.min() if finite.size else np.inf
 
-    def _sync_device_locked(self, touched_tiles, touched_bd):
+    def _sync_device_locked(self, touched_tiles, touched_bd,
+                            defer: bool = False):
         n_tiles, n_bd = len(touched_tiles), len(touched_bd)
         if self._tiles_dev is None or (not n_tiles and not n_bd):
             return n_tiles, n_bd
         import jax.numpy as jnp
 
+        if not defer and self._staged_tiles is not None:
+            # a deferred commit was never published (defensive — engine
+            # commit locks serialize this away): fold it in first so the
+            # scatter below bases on the newest bits
+            self._publish_locked()
+        if defer:
+            # base on staged-if-present: apply + retention-expire inside
+            # one zero-stall commit accumulate into ONE flip
+            base_tiles = (self._staged_tiles if self._staged_tiles
+                          is not None else self._tiles_dev)
+            base_tt = (self._staged_tt if self._staged_tt is not None
+                       else self._tt_dev)
+            base_bd = (self._staged_bd if self._staged_bd is not None
+                       else self._bd_dev)
+        else:
+            base_tiles, base_tt, base_bd = (
+                self._tiles_dev, self._tt_dev, self._bd_dev
+            )
         if n_tiles:
             idx = np.fromiter(touched_tiles, np.int64, n_tiles)
             idx.sort()
             pos, rows = _bucketed(idx, self.tiles[idx], self.m_cap)
-            self._tiles_dev = _scatter_rows(
-                self._tiles_dev, jnp.asarray(pos), jnp.asarray(rows)
+            base_tiles = _scatter_rows(
+                base_tiles, jnp.asarray(pos), jnp.asarray(rows)
             )
-            if self._tt_dev is not None:
+            if base_tt is not None:
                 # the timestamp payload swaps the SAME touched rows in the
                 # same commit — a draw can never see an edge without its ts
                 tpos, trows = _bucketed(idx, self.ttiles[idx], self.m_cap)
-                self._tt_dev = _scatter_rows(
-                    self._tt_dev, jnp.asarray(tpos), jnp.asarray(trows)
+                base_tt = _scatter_rows(
+                    base_tt, jnp.asarray(tpos), jnp.asarray(trows)
                 )
         if n_bd:
             idx = np.fromiter(touched_bd, np.int64, n_bd)
             idx.sort()
             pos, rows = _bucketed(idx, self.bd[idx], self.n)
-            self._bd_dev = _scatter_rows(
-                self._bd_dev, jnp.asarray(pos), jnp.asarray(rows)
+            base_bd = _scatter_rows(
+                base_bd, jnp.asarray(pos), jnp.asarray(rows)
             )
+        if defer:
+            self._staged_tiles = base_tiles
+            self._staged_tt = base_tt
+            self._staged_bd = base_bd
+        else:
+            self._tiles_dev = base_tiles
+            self._tt_dev = base_tt
+            self._bd_dev = base_bd
         return n_tiles, n_bd
+
+    def _publish_locked(self) -> bool:
+        if self._staged_tiles is None and self._staged_bd is None:
+            return False
+        if self._staged_tiles is not None:
+            self._tiles_dev = self._staged_tiles
+            self._tt_dev = self._staged_tt
+        if self._staged_bd is not None:
+            self._bd_dev = self._staged_bd
+        self._staged_bd = None
+        self._staged_tiles = None
+        self._staged_tt = None
+        return True
+
+    def publish(self) -> bool:
+        """Flip the staged (defer_publish) device arrays live: O(1) ref
+        assignment under the stream lock — the zero-stall commit's only
+        serving-visible moment. Flushes sealed before the flip keep the
+        old array objects (immutable; `_scatter_rows` copies on write)
+        and complete bit-exactly against their epoch. Returns True when
+        something was staged."""
+        with self._lock:
+            return self._publish_locked()

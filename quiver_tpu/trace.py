@@ -1217,7 +1217,10 @@ def chrome_trace_events(
     ``counter_samples()`` method yielding (name, t, value) tuples
     (`quiver_tpu.obs.CounterSeries`): each counter name renders as a
     Chrome ``ph: "C"`` track, so sampled series (workload head coverage,
-    owner imbalance) graph alongside the flush lanes. Each source becomes
+    owner imbalance, and — round 24 — the engines' per-commit
+    ``graph_version`` staircase / ``commit_stall_us`` lane under the
+    ``serve.commits`` / ``router.commits`` pids) graph alongside the
+    flush lanes. Each source becomes
     one pid; stage names (and journal flush lanes) become named tids. All
     sources must share one monotonic clock (the serve stack's
     engines/journals/comm spans all do); ``time_origin`` (default:

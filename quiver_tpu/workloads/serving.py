@@ -586,6 +586,10 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
         if self.workload is not None:
             self.workload.tick()
         self.journal.emit("seal", -1, fl.fid, len(fl.keys), fl.bucket)
+        # epoch pin (round 24), mirroring the base seal — the temporal
+        # router is frozen-graph in v1 so the stamp is constant 0, but
+        # the aligned-list invariant holds fleet-wide
+        fl.graph_version = self.graph_version
         try:
             arr = np.asarray([k[0] for k in fl.keys], np.int64)
             tvec = np.asarray([k[1] for k in fl.keys], np.float32)
@@ -617,6 +621,7 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
                      [(h, ids.copy()) for h, ids, _ in fl.split],
                      tvec.copy())
                 )
+                self.dispatch_graph_versions.append(fl.graph_version)
             if self.config.tier_prefetch:
                 for h, ids, _ in fl.split:
                     eng = self.engines.get(h)
@@ -759,21 +764,34 @@ class TemporalDistServeEngine(_PairServing, DistServeEngine):
 
 
 def replay_temporal_log(log, model, params, sampler, feature,
-                        served: Optional[Dict] = None) -> Dict:
+                        served: Optional[Dict] = None,
+                        versions: Optional[Sequence[int]] = None,
+                        only_version: Optional[int] = None) -> Dict:
     """Replay one temporal dispatch log — entries ``(padded_seeds,
     n_valid, padded_t)`` — through a FRESH temporal-bound ``sampler``
     (same seed as the serving one: its key stream then matches draw for
     draw) and the offline gather+forward. Returns ``{(node, t):
     [candidate rows]}`` with ``t`` the float32 query time the dispatch
-    actually carried."""
+    actually carried.
+
+    Round 24 — epoch-aware replay: ``versions`` is the engine's aligned
+    ``dispatch_graph_versions`` list and ``only_version`` selects which
+    epoch's rows to COLLECT. Every entry still computes (the key stream
+    must advance exactly as the live run's did); entries stamped with a
+    different epoch are skipped at collection. ``sampler`` must then be
+    bound to the graph AS OF ``only_version``."""
     from ..inference import _cached_apply, lookup_features
 
     apply = _cached_apply(model)
     served = {} if served is None else served
-    for padded, nvalid, tvec in log:
+    for ix, (padded, nvalid, tvec) in enumerate(log):
         ds = sampler.sample_dense(padded, t=tvec)
         x = lookup_features(feature, ds.n_id)
         logits = np.asarray(apply(params, x, ds.adjs))
+        if only_version is not None and (
+                versions is None or ix >= len(versions)
+                or versions[ix] != only_version):
+            continue
         for i in range(nvalid):
             served.setdefault(
                 (int(padded[i]), float(np.float32(tvec[i]))), []
@@ -783,18 +801,27 @@ def replay_temporal_log(log, model, params, sampler, feature,
 
 def replay_temporal_fleet_oracle(dist: TemporalDistServeEngine, model,
                                  params, full_sampler_factory,
-                                 full_feature) -> Dict:
+                                 full_feature,
+                                 graph_version: Optional[int] = None
+                                 ) -> Dict:
     """`replay_fleet_oracle`'s temporal shape: every owner engine's
     temporal dispatch log replays through a fresh FULL-graph temporal
     sampler (``full_sampler_factory`` must birth it with the serving
     seed and the full-graph `TemporalTiledGraph` binding) over the full
     feature table. A served row is correct iff it bit-matches a
     candidate at its ``(node, t)`` — the acceptance pin
-    ``serve_probe --temporal`` asserts for the split-owner LP leg."""
+    ``serve_probe --temporal`` asserts for the split-owner LP leg.
+    ``graph_version`` filters collection to one fleet epoch's rows (see
+    `replay_temporal_log`); the factory must then produce the sampler
+    of that epoch's graph."""
     served: Dict = {}
     for h in sorted(dist.engines):
+        eng = dist.engines[h]
         replay_temporal_log(
-            dist.engines[h].dispatch_log, model, params,
+            eng.dispatch_log, model, params,
             full_sampler_factory(), full_feature, served=served,
+            versions=(getattr(eng, "dispatch_graph_versions", None)
+                      if graph_version is not None else None),
+            only_version=graph_version,
         )
     return served

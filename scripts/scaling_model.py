@@ -92,6 +92,16 @@ def main():
                          "eventually expires)")
     ap.add_argument("--compact-every-commits", type=float, default=10.0,
                     help="commits between background compaction passes")
+    # round-24 zero-stall commit pricing: the drain-vs-flip comparison.
+    # The stall input is MEASURED by serve_probe --stream-stall
+    # (STREAM_r02.json commit_stall_us, the _seq flip hold)
+    ap.add_argument("--stream-commit-stall-us", type=float, default=None,
+                    help="measured zero-stall per-commit flip hold (us; "
+                         "serve_probe --stream-stall commit_stall_us)")
+    ap.add_argument("--fence-mode", choices=("fenced", "zerostall"),
+                    default="zerostall",
+                    help="commit discipline for the round-24 stall "
+                         "re-pricing under --lifecycle")
     # round-19 link-prediction pricing (lp_table): measured fused
     # temporal step + per-pair head costs from bench.py's workloads leg
     # (context temporal_step_s / lp_head_s, picked up via --bench)
@@ -602,6 +612,44 @@ def main():
             "flat reserve\noccupancy, in-run oracle parity).\n\n"
             + format_delta_markdown(lifecycle_rows)
         )
+        # -- round-24: drain-vs-flip commit-stall re-pricing -------------
+        if args.fence_mode == "zerostall":
+            stall_us = (100.0 if args.stream_commit_stall_us is None
+                        else args.stream_commit_stall_us)
+            stall_source = (
+                "measured serve_probe --stream-stall commit_stall_us"
+                if args.stream_commit_stall_us is not None else
+                "analytic placeholder flip hold (pass "
+                "--stream-commit-stall-us from STREAM_r02.json)"
+            )
+            zerostall_rows = delta_table(
+                [("feed_trickle", 100), ("feed_busy", 2_000),
+                 ("fraud_burst", 20_000), ("ingest_storm", 200_000)],
+                append_s_per_edge=append_s, swap_s_per_commit=swap_s,
+                commit_period_s=args.stream_commit_s,
+                delete_frac=args.delete_frac,
+                delete_s_per_edge=delete_s,
+                compact_s_per_pass=compact_s,
+                compact_every_commits=args.compact_every_commits,
+                commit_stall_us=stall_us,
+                fence_mode="zerostall",
+            )
+            lifecycle_md += (
+                "\n\n## Zero-stall commits: drain vs flip pricing "
+                "(round 24)\n\n"
+                f"Stall source: {stall_source}; churn terms as the "
+                "lifecycle table above.\nThe fenced twin's per-commit "
+                "stall is the whole drain+apply hold (the\nfence stall "
+                "column above); zero-stall commits build off-fence and "
+                "only\nhold the dispatch lock for the pointer flip, so "
+                "duty is unchanged and\nthe stall column collapses to "
+                "the flip hold.\nMeasured counterpart: "
+                "scripts/serve_probe.py --stream-stall -> "
+                "STREAM_r02.json\n(commit storm under saturated Zipf "
+                "traffic, fenced-vs-zero-stall stall\nratio, on-commit "
+                "p99, epoch-aware oracle parity).\n\n"
+                + format_delta_markdown(zerostall_rows)
+            )
     # -- round-19: link-prediction pricing (lp_table) --------------------
     lp_step_s = (2e-3 if args.lp_step_ms is None else args.lp_step_ms / 1e3)
     lp_head_s = (1e-6 if args.lp_head_us is None else args.lp_head_us / 1e6)

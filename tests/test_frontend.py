@@ -521,10 +521,11 @@ from quiver_tpu.trace import LatencyHistogram
 
 
 def _cache_state(c):
-    """Resident (key, version, value-bytes) in LRU order plus counter
-    movement — everything `put_many` could have perturbed."""
+    """Resident (key, version, value-bytes, graph-version) in LRU order
+    plus counter movement — everything `put_many` could have perturbed."""
     with c._lock:
-        items = [(k, v, val.tobytes()) for k, (v, val) in c._entries.items()]
+        items = [(k, v, val.tobytes(), gv)
+                 for k, (v, val, gv) in c._entries.items()]
     return items, c.counters.evictions, c._tuple_keys
 
 
@@ -673,7 +674,7 @@ def test_block_resolve_under_update_params_fence(setup):
     assert _cache_state(a.cache) == _cache_state(b.cache)
     assert a.params_version == b.params_version == 1
     # every resident entry was computed under the post-bump version
-    assert all(v == 1 for _, (v, _) in a.cache._entries.items())
+    assert all(v == 1 for _, (v, _, _) in a.cache._entries.items())
 
 
 def test_vector_admission_parity(setup):

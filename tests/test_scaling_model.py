@@ -452,3 +452,42 @@ def test_delta_table_prices_streaming_ingest():
         delta_table([("x", 1.0)], append_s, swap_s, commit_period_s=0.0)
     md = format_delta_markdown(rows)
     assert "storm" in md and "sustainable" in md
+
+
+def test_delta_table_commit_stall_pricing():
+    """Round-24 drain-vs-flip pricing: fence_mode="zerostall" keeps the
+    commit WORK (duty) identical — the build just runs off-fence — and
+    collapses the serving stall to the measured flip hold."""
+    from quiver_tpu.parallel.scaling import delta_table, format_delta_markdown
+
+    append_s, swap_s = 2e-6, 5e-3
+    cases = [("idle", 0.0), ("feed", 1e3), ("storm", 1e5)]
+    fenced = delta_table(cases, append_s, swap_s, commit_period_s=1.0)
+    zs = delta_table(cases, append_s, swap_s, commit_period_s=1.0,
+                     commit_stall_us=1.2, fence_mode="zerostall")
+    for f, z in zip(fenced, zs):
+        # same work, same sustainability frontier...
+        assert z.commit_s == pytest.approx(f.commit_s)
+        assert z.duty_frac == pytest.approx(f.duty_frac)
+        assert z.sustainable == f.sustainable
+        # ...but the stall is the flip hold, decoupled from edge rate
+        assert z.fence_stall_s == pytest.approx(1.2e-6)
+        assert f.fence_stall_s == pytest.approx(f.commit_s)
+        assert z.fence_mode == "zerostall" and f.fence_mode == "fenced"
+    # the fenced stall grows with rate; the zero-stall one does not
+    assert fenced[2].fence_stall_s > fenced[1].fence_stall_s
+    assert zs[2].fence_stall_s == zs[1].fence_stall_s
+    # zerostall pricing demands a measurement — no invented constants
+    with pytest.raises(ValueError):
+        delta_table(cases, append_s, swap_s, fence_mode="zerostall")
+    with pytest.raises(ValueError):
+        delta_table(cases, append_s, swap_s, fence_mode="zerostall",
+                    commit_stall_us=-1.0)
+    with pytest.raises(ValueError):
+        delta_table(cases, append_s, swap_s, fence_mode="drain")
+    # fenced mode ignores a stray commit_stall_us (stall == wall)
+    stray = delta_table(cases, append_s, swap_s, commit_stall_us=99.0)
+    assert stray[1].fence_stall_s == pytest.approx(stray[1].commit_s)
+    # flip hold renders at µs precision (1.2 µs -> 0.0012 ms)
+    md = format_delta_markdown(zs)
+    assert "commit stall ms" in md and "0.0012" in md

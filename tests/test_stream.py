@@ -327,9 +327,12 @@ def test_delta_replay_determinism_single_host(setup, mif):
 
 
 def test_update_graph_fences_inflight_flush(setup):
-    """`update_graph` must drain in-flight flushes before touching the
+    """With ``fenced_commits=True`` (the round-23 parity twin)
+    `update_graph` must drain in-flight flushes before touching the
     tiles — no flush ever straddles a delta commit (the update_params
-    fence, third consumer set or not)."""
+    fence, third consumer set or not). The zero-stall default
+    deliberately does NOT drain; its racing-commit behavior is pinned in
+    test_zerostall_commits.py."""
     from test_serve import _GateFeature
 
     model, params, feat = setup
@@ -338,7 +341,8 @@ def test_update_graph_fences_inflight_flush(setup):
     eng = ServeEngine(
         model, params, make_sampler(stream=stream), gate,
         ServeConfig(max_batch=4, buckets=(4,), max_delay_ms=1e9,
-                    max_in_flight=2, record_dispatches=True),
+                    max_in_flight=2, record_dispatches=True,
+                    fenced_commits=True),
     )
     eng.warmup()
     gate.delays = [1.5]

@@ -1,0 +1,436 @@
+"""Round-24 zero-stall commit tests: epoch-pinned double-buffered
+`update_graph` that never drains the in-flight window.
+
+The acceptance contract (ISSUE 20 / docs/api.md "Zero-stall commits"):
+
+- PARITY MATRIX: for one deterministic delta-interleaved schedule, the
+  `fenced_commits=True` drain discipline (bit-identical to round-23) and
+  the zero-stall flip serve identical logits over identical dispatch
+  logs and epoch stamps — at max_in_flight 1/2, hosts 1/2, node and
+  temporal traffic, with and without a seeded owner kill;
+- a commit CANNOT land between a flush's assemble and its seal: both run
+  under one `_seq` hold, so the commit orders after the seal and the
+  flush stays entirely one epoch (its stamped `graph_version` is the
+  pre-commit version and its row replays against that epoch);
+- the commit-storm loopback is run-twice bit-identical (logits, dispatch
+  logs, epoch stamps, byte for byte) and every served row bit-matches a
+  candidate from the per-version fleet oracle of an epoch <= its
+  serve-time version (epoch-aware `replay_fleet_oracle(graph_version=)`);
+- the indexed `EmbeddingCache.invalidate_nodes` is O(touched) without
+  perturbing LRU order, and graph-version floors gate late writebacks.
+"""
+
+import itertools
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_random_graph
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.pyg.sage_sampler import GraphSageSampler
+from quiver_tpu.serve import (
+    DistServeConfig,
+    DistServeEngine,
+    EmbeddingCache,
+    FaultInjector,
+    FaultSpec,
+    ServeConfig,
+    ServeEngine,
+    delta_interleaved_trace,
+    replay_fleet_oracle,
+    zipfian_trace,
+)
+from quiver_tpu.stream import GraphDelta, StreamingTiledGraph
+from quiver_tpu.workloads import TemporalServeEngine
+
+N_NODES = 200
+DIM = 16
+SIZES = [4, 4]
+SAMPLER_SEED = 3
+EDGE_INDEX = make_random_graph(N_NODES, 1200, seed=0)
+
+
+def make_topo():
+    return CSRTopo(edge_index=EDGE_INDEX)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    feat = rng.standard_normal((N_NODES, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=16, out_dim=5, num_layers=2, dropout=0.0)
+    sampler = GraphSageSampler(make_topo(), sizes=SIZES, mode="TPU",
+                               seed=SAMPLER_SEED)
+    ds0 = sampler.sample_dense(np.arange(8, dtype=np.int64))
+    x0 = jnp.zeros((ds0.n_id.shape[0], DIM), jnp.float32)
+    params = model.init(jax.random.key(0), x0, ds0.adjs)
+    return model, params, feat
+
+
+def make_dist(setup, hosts, mif, fenced, kill):
+    model, params, feat = setup
+    kw = dict(
+        hosts=hosts, max_batch=8, max_delay_ms=1e9,
+        record_dispatches=True, exchange="host", streaming=True,
+        stream_reserve_frac=1.0, max_in_flight=mif,
+        fenced_commits=fenced,
+    )
+    if kill:
+        kw.update(
+            fault_injector=FaultInjector(
+                [FaultSpec(owner=0, fid=2, kind="kill")]
+            ),
+            full_graph_fallback=True,
+        )
+    dist = DistServeEngine.build(
+        model, params, make_topo(), feat, SIZES, hosts=hosts,
+        config=DistServeConfig(**kw), sampler_seed=SAMPLER_SEED,
+    )
+    dist.warmup()
+    return dist
+
+
+SCHEDULE = delta_interleaved_trace(N_NODES, 32, alpha=1.1, seed=21,
+                                   edge_every=8, edges_per_event=2)
+
+
+def drive_node(dist):
+    """Deterministic sequential drive of the shared schedule: rows (or
+    the exception a request completed with), serve-time versions."""
+    rows, vers = [], []
+    for ev in SCHEDULE.events():
+        if ev[0] == "edges":
+            dist.stage_edges(ev[1], ev[2])
+            dist.update_graph()
+        else:
+            h = dist.submit(int(ev[2]))
+            while dist._drainable():
+                dist.flush()
+            try:
+                rows.append(np.asarray(h.result(60)))
+            except Exception as exc:
+                rows.append(exc)
+            vers.append(dist.graph_version)
+    return rows, vers
+
+
+def assert_rows_equal(rows_a, rows_b):
+    assert len(rows_a) == len(rows_b)
+    for a, b in zip(rows_a, rows_b):
+        if isinstance(a, Exception) or isinstance(b, Exception):
+            assert type(a) is type(b), (a, b)
+        else:
+            assert np.array_equal(a, b)
+
+
+def assert_same_logs(eng_a, eng_b):
+    """Dispatch logs (node 2-tuples or temporal 3-tuples) plus the
+    aligned round-24 epoch stamps, bit for bit."""
+    la, lb = eng_a.dispatch_log, eng_b.dispatch_log
+    assert len(la) == len(lb)
+    for ea, eb in zip(la, lb):
+        assert len(ea) == len(eb)
+        for xa, xb in zip(ea, eb):
+            assert np.array_equal(np.asarray(xa), np.asarray(xb))
+    assert (eng_a.dispatch_graph_versions
+            == eng_b.dispatch_graph_versions)
+    assert len(eng_a.dispatch_graph_versions) == len(la)
+
+
+# -- the parity matrix: fenced twin == zero-stall, node traffic --------------
+
+@pytest.mark.parametrize(
+    "hosts,mif,kill", list(itertools.product([1, 2], [1, 2], [False, True]))
+)
+def test_zerostall_fenced_parity_matrix_node(setup, hosts, mif, kill):
+    """fenced_commits=True (the round-23 drain, byte-preserved) and the
+    zero-stall flip must be indistinguishable on a deterministic
+    schedule: same served rows, same dispatch logs, same epoch stamps,
+    same final version — including requests hedged around a seeded
+    owner kill."""
+    dist_f = make_dist(setup, hosts, mif, fenced=True, kill=kill)
+    rows_f, vers_f = drive_node(dist_f)
+    dist_z = make_dist(setup, hosts, mif, fenced=False, kill=kill)
+    rows_z, vers_z = drive_node(dist_z)
+    if kill:
+        # the fallback hedge must have completed every request
+        assert not any(isinstance(r, Exception) for r in rows_z)
+    assert_rows_equal(rows_f, rows_z)
+    assert vers_f == vers_z
+    assert dist_f.graph_version == dist_z.graph_version > 0
+    for h in dist_f.engines:
+        assert_same_logs(dist_f.engines[h], dist_z.engines[h])
+    assert dist_f.dispatch_graph_versions == dist_z.dispatch_graph_versions
+    # the zero-stall run surfaced its flip hold, and it is a stall the
+    # fenced run's full drain+apply hold dominates
+    assert dist_z.stats.commit_stall.snapshot()["count"] > 0
+
+
+# -- the parity matrix: temporal traffic -------------------------------------
+
+def make_temporal(setup, mif, fenced, base_ts):
+    model, params, feat = setup
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=1.0,
+                                 edge_ts=base_ts)
+    s = GraphSageSampler(make_topo(), sizes=SIZES, mode="TPU",
+                         seed=SAMPLER_SEED, dedup=False, max_deg=256)
+    s.bind_temporal(stream, recency=0.02)
+    eng = TemporalServeEngine(
+        model, params, s, feat,
+        ServeConfig(max_batch=8, buckets=(8,), max_delay_ms=1e9,
+                    record_dispatches=True, max_in_flight=mif,
+                    fenced_commits=fenced),
+        t_quantum=0.05,
+    )
+    eng.warmup()
+    return eng
+
+
+@pytest.mark.parametrize("mif", [1, 2])
+def test_zerostall_fenced_parity_matrix_temporal(setup, mif):
+    """Temporal traffic through a streaming temporal graph: timestamped
+    commits interleave with (node, t) queries; the fenced and zero-stall
+    twins must serve identical rows over identical (padded, nvalid,
+    tvals) logs and epoch stamps."""
+    rng = np.random.default_rng(7)
+    E = EDGE_INDEX.shape[1]
+    base_ts = rng.uniform(0.0, 50.0, E).astype(np.float32)
+    qry = zipfian_trace(N_NODES, 24, alpha=1.1, seed=5)
+    esrc = zipfian_trace(N_NODES, 12, alpha=1.1, seed=6)
+    edst = rng.integers(0, N_NODES, 12)
+
+    def run(fenced):
+        eng = make_temporal(setup, mif, fenced, base_ts)
+        rows = []
+        for k in range(3):
+            nodes_k = qry[k * 8:(k + 1) * 8]
+            tq = 50.0 + k + 0.5
+            hs = [eng.submit(int(x), t=tq) for x in nodes_k]
+            while eng._drainable():
+                eng.flush()
+            rows.extend(np.asarray(h.result(60)) for h in hs)
+            lo = k * 4
+            ts_k = (50.0 + k + (np.arange(4) + 1.0) / 4.0).astype(
+                np.float32)
+            eng.stage_edges(esrc[lo:lo + 4], edst[lo:lo + 4], ts=ts_k)
+            eng.update_graph()
+        return eng, rows
+
+    eng_f, rows_f = run(True)
+    eng_z, rows_z = run(False)
+    assert_rows_equal(rows_f, rows_z)
+    assert_same_logs(eng_f, eng_z)
+    assert eng_f.graph_version == eng_z.graph_version == 3
+
+
+# -- a commit landing between assemble and seal ------------------------------
+
+def test_commit_blocks_between_assemble_and_seal(setup):
+    """Both assemble and seal run under ONE `_seq` hold, and the
+    zero-stall flip takes `_seq` — so a commit arriving between them
+    blocks until the seal lands. The flush is entirely one epoch: its
+    stamp is the pre-commit version and its row bit-matches a twin that
+    never saw the commit."""
+    model, params, feat = setup
+    stream = StreamingTiledGraph(make_topo(), reserve_frac=1.0)
+    s = GraphSageSampler(make_topo(), sizes=SIZES, mode="TPU",
+                         seed=SAMPLER_SEED)
+    s.bind_stream(stream)
+    eng = ServeEngine(
+        model, params, s, feat,
+        ServeConfig(max_batch=8, buckets=(8,), max_delay_ms=1e9,
+                    record_dispatches=True),
+    )
+    eng.warmup()
+    # pre-warm the commit path (first delta compiles scatter shapes —
+    # keep compile walls out of the bounded race waits below)
+    d0 = GraphDelta()
+    d0.add_edge(11, 13)
+    eng.update_graph(d0)
+    assert eng.graph_version == 1
+
+    assembled, proceed, committed = (threading.Event(), threading.Event(),
+                                     threading.Event())
+    orig_seal = eng._seal_assembled
+
+    def patched_seal(fl):
+        assembled.set()
+        proceed.wait(10.0)  # hold the assemble->seal window open
+        return orig_seal(fl)
+
+    eng._seal_assembled = patched_seal
+    h = eng.submit(3)
+    flusher = threading.Thread(target=eng.flush)
+    flusher.start()
+    assert assembled.wait(10.0)
+
+    def committer():
+        d = GraphDelta()
+        d.add_edge(3, 7)
+        eng.update_graph(d)
+        committed.set()
+
+    tc = threading.Thread(target=committer)
+    tc.start()
+    # the commit must NOT flip while the flush sits between assemble and
+    # seal (the build may run off-fence; the flip needs _seq)
+    assert not committed.wait(0.5)
+    assert eng.graph_version == 1
+    proceed.set()
+    flusher.join(30)
+    tc.join(30)
+    assert committed.is_set() and eng.graph_version == 2
+    row = np.asarray(h.result(60))
+    # sealed against the pre-commit epoch...
+    assert eng.dispatch_graph_versions[-1] == 1
+    # ...and bit-equal to a twin whose graph never advanced past it
+    stream_t = StreamingTiledGraph(make_topo(), reserve_frac=1.0)
+    st = GraphSageSampler(make_topo(), sizes=SIZES, mode="TPU",
+                          seed=SAMPLER_SEED)
+    st.bind_stream(stream_t)
+    twin = ServeEngine(
+        model, params, st, feat,
+        ServeConfig(max_batch=8, buckets=(8,), max_delay_ms=1e9,
+                    record_dispatches=True),
+    )
+    twin.warmup()
+    d0 = GraphDelta()
+    d0.add_edge(11, 13)
+    twin.update_graph(d0)
+    h_t = twin.submit(3)
+    twin.flush()
+    assert np.array_equal(row, np.asarray(h_t.result(60)))
+
+
+# -- run-twice bit-identity + epoch-aware oracle parity on the storm ---------
+
+def test_commit_storm_run_twice_and_epoch_oracle(setup):
+    """The hosts=2 / mif=2 zero-stall commit storm replays bit-
+    identically run to run (logits, dispatch logs, epoch stamps), and
+    every served row bit-matches a per-version fleet-oracle candidate
+    from an epoch <= its serve-time version (a row computed before a
+    commit may legally be served after it — its epoch is its stamp, and
+    an un-invalidated cache entry is a pre-commit row whose closure no
+    commit touched)."""
+    model, params, feat = setup
+
+    def run():
+        dist = make_dist(setup, hosts=2, mif=2, fenced=False, kill=False)
+        rows, vers = [], []
+        topo_vs = [make_topo()]
+        for ev in SCHEDULE.events():
+            if ev[0] == "edges":
+                dist.stage_edges(ev[1], ev[2])
+                dist.update_graph()
+                topo_vs.append(dist._stream_adj.to_csr_topo())
+            else:
+                h = dist.submit(int(ev[2]))
+                while dist._drainable():
+                    dist.flush()
+                rows.append(np.asarray(h.result(60)))
+                vers.append(dist.graph_version)
+        return dist, rows, vers, topo_vs
+
+    dist_a, rows_a, vers_a, topo_vs = run()
+    dist_b, rows_b, vers_b, _ = run()
+    assert vers_a == vers_b
+    for a, b in zip(rows_a, rows_b):
+        assert a.tobytes() == b.tobytes()
+    for h in dist_a.engines:
+        assert_same_logs(dist_a.engines[h], dist_b.engines[h])
+    assert dist_a.dispatch_graph_versions == dist_b.dispatch_graph_versions
+    # epoch stamps never run ahead of the fleet version at dispatch and
+    # are monotonically non-decreasing down the log
+    for eng in dist_a.engines.values():
+        gvs = eng.dispatch_graph_versions
+        assert all(a <= b for a, b in zip(gvs, gvs[1:]))
+        assert all(0 <= v <= dist_a.graph_version for v in gvs)
+    # epoch-aware oracle parity
+    oracles = {}
+    for v, tv in enumerate(topo_vs):
+        def mk(tv=tv):
+            return GraphSageSampler(tv, sizes=SIZES, mode="TPU",
+                                    seed=SAMPLER_SEED)
+        oracles[v] = replay_fleet_oracle(dist_a, model, params, mk, feat,
+                                         graph_version=v)
+    nodes = [ev[2] for ev in SCHEDULE.events() if ev[0] == "request"]
+    assert len(nodes) == len(rows_a)
+    for node, row, v in zip(nodes, rows_a, vers_a):
+        assert any(
+            any(np.array_equal(row, c)
+                for c in oracles[v2].get(int(node), []))
+            for v2 in range(v + 1)
+        ), f"epoch parity violation at node {int(node)} version {v}"
+
+
+# -- satellite 2: indexed invalidate + graph-version floors ------------------
+
+def _lru_keys(c):
+    with c._lock:
+        return list(c._entries.keys())
+
+
+def test_invalidate_nodes_preserves_lru_order():
+    """The per-node key index makes invalidate_nodes O(touched): only
+    the named nodes' entries leave, every survivor keeps its exact LRU
+    position, and subsequent evictions pop in the preserved order."""
+    c = EmbeddingCache(capacity=8)
+    rng = np.random.default_rng(0)
+    vals = {k: rng.standard_normal(3).astype(np.float32) for k in range(6)}
+    for k in range(6):
+        c.put(k, 1, vals[k])
+    c.get(1, 1)          # touch: order is now 0,2,3,4,5,1
+    assert _lru_keys(c) == [0, 2, 3, 4, 5, 1]
+    dropped = c.invalidate_nodes([2, 4])
+    assert dropped == 2
+    assert _lru_keys(c) == [0, 3, 5, 1]
+    # untouched survivors still hit, bitwise intact
+    for k in (0, 3, 5, 1):
+        assert np.array_equal(c.get(k, 1), vals[k])
+    # capacity pressure evicts in the preserved order (0 is oldest)
+    small = EmbeddingCache(capacity=3)
+    for k in (10, 11, 12):
+        small.put(k, 1, vals[0])
+    small.get(10, 1)      # order: 11,12,10
+    small.invalidate_nodes([12])
+    small.put(13, 1, vals[1])
+    small.put(14, 1, vals[2])   # evicts 11 (oldest survivor)
+    assert _lru_keys(small) == [10, 13, 14]
+    # composite (node, t, pv) tuple keys ride the same index
+    ct = EmbeddingCache(capacity=8)
+    ct.put((5, 1.0, 0), 1, vals[0])
+    ct.put((5, 2.0, 0), 1, vals[1])
+    ct.put((6, 1.0, 0), 1, vals[2])
+    assert ct.invalidate_nodes([5]) == 2
+    assert _lru_keys(ct) == [(6, 1.0, 0)]
+
+
+def test_graph_version_floor_gates_late_writeback():
+    """raise_floor is the zero-stall replacement for the drain: a
+    writeback stamped below a node's floor (an in-flight flush resolving
+    after the commit that invalidated its epoch) must NOT enter the
+    cache, while writebacks at or above the floor do."""
+    c = EmbeddingCache(capacity=8)
+    v = np.ones(3, np.float32)
+    c.put(7, 1, v, gv=0)
+    assert c.entry_graph_version(7) == 0
+    # the flip: nodes touched by commit 1 get their floor raised
+    assert c.raise_floor([7], 1) == 1      # resident below-floor entry dropped
+    assert c.get(7, 1) is None
+    assert c.graph_floor(7) == 1
+    c.put(7, 1, v, gv=0)                   # late writeback from epoch 0
+    assert c.get(7, 1) is None             # gated: never became resident
+    c.put(7, 1, 2 * v, gv=1)               # current-epoch writeback lands
+    assert np.array_equal(c.get(7, 1), 2 * v)
+    # floors are monotonic: a stale raise cannot lower one
+    assert c.raise_floor([7], 1) == 0
+    assert c.graph_floor(7) == 1
+    # untouched nodes never grow a floor
+    c.put(9, 1, v, gv=0)
+    assert c.graph_floor(9) == 0 and np.array_equal(c.get(9, 1), v)
